@@ -1,0 +1,21 @@
+"""pio-tpu: a TPU-native machine-learning server.
+
+A from-scratch rebuild of the capabilities of PredictionIO 0.9.4
+(reference: tpoljak/PredictionIO): REST event collection into a pluggable
+event store, DASE engines (DataSource -> Preparator -> Algorithm(s) ->
+Serving) trained/evaluated by a `pio`-compatible CLI, and per-engine HTTP
+query deployment with a feedback loop -- with the Spark/MLlib compute
+substrate replaced by JAX/XLA on a TPU device mesh.
+
+Layer map (mirrors SURVEY.md section 1):
+  L0  predictionio_tpu.data.storage   -- event store + metadata DAOs
+  L1  predictionio_tpu.data.api       -- event-collection REST server
+  L2  predictionio_tpu.data.store     -- engine-facing event access
+  L3  predictionio_tpu.core           -- DASE controller API
+  L4  predictionio_tpu.workflow/serving -- train/eval/deploy runtime
+  L5  predictionio_tpu.ops / models   -- algorithm library (JAX kernels)
+  L6  predictionio_tpu.tools          -- `pio` CLI + ops servers
+  --  predictionio_tpu.parallel       -- mesh / sharding / collectives
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+"""Data layer: event model, property maps, storage backends, event APIs."""
+
+from predictionio_tpu.data.event import Event, EventValidation
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+
+__all__ = ["Event", "EventValidation", "DataMap", "PropertyMap"]

@@ -1,0 +1,132 @@
+"""Property aggregation monoid tests (mirrors reference LEventAggregatorSpec)."""
+
+import datetime as dt
+import itertools
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.aggregator import (EventOp, aggregate_properties,
+                                              merge_aggregations)
+
+UTC = dt.timezone.utc
+
+
+def t(sec):
+    return dt.datetime(2026, 1, 1, 0, 0, sec, tzinfo=UTC)
+
+
+def set_ev(eid, props, sec):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=DataMap(props), event_time=t(sec))
+
+
+def unset_ev(eid, keys, sec):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=DataMap({k: None for k in keys}), event_time=t(sec))
+
+
+def delete_ev(eid, sec):
+    return Event(event="$delete", entity_type="user", entity_id=eid,
+                 event_time=t(sec))
+
+
+class TestAggregate:
+    def test_latest_set_wins(self):
+        out = aggregate_properties([
+            set_ev("u1", {"a": 1, "b": 1}, 1),
+            set_ev("u1", {"a": 2}, 3),
+            set_ev("u1", {"b": 0}, 2),
+        ])
+        pm = out["u1"]
+        assert pm.fields == {"a": 2, "b": 0}
+        assert pm.first_updated == t(1)
+        assert pm.last_updated == t(3)
+
+    def test_unset_drops_older_set(self):
+        out = aggregate_properties([
+            set_ev("u1", {"a": 1, "b": 1}, 1),
+            unset_ev("u1", ["a"], 2),
+        ])
+        assert out["u1"].fields == {"b": 1}
+
+    def test_set_after_unset_restores(self):
+        out = aggregate_properties([
+            set_ev("u1", {"a": 1}, 1),
+            unset_ev("u1", ["a"], 2),
+            set_ev("u1", {"a": 3}, 3),
+        ])
+        assert out["u1"].fields == {"a": 3}
+
+    def test_unset_at_same_time_wins(self):
+        out = aggregate_properties([
+            set_ev("u1", {"a": 1}, 2),
+            unset_ev("u1", ["a"], 2),
+        ])
+        assert out["u1"].fields == {}
+
+    def test_delete_entity(self):
+        out = aggregate_properties([
+            set_ev("u1", {"a": 1}, 1),
+            delete_ev("u1", 2),
+        ])
+        assert "u1" not in out
+
+    def test_set_after_delete_resurrects(self):
+        out = aggregate_properties([
+            set_ev("u1", {"a": 1}, 1),
+            delete_ev("u1", 2),
+            set_ev("u1", {"b": 2}, 3),
+        ])
+        # entity survives; only post-delete properties remain
+        assert out["u1"].fields == {"b": 2}
+
+    def test_plain_events_ignored(self):
+        out = aggregate_properties([
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  event_time=t(1)),
+        ])
+        assert out == {}
+
+    def test_never_set_entity_omitted(self):
+        out = aggregate_properties([unset_ev("u1", ["a"], 1)])
+        assert out == {}
+
+    def test_multiple_entities(self):
+        out = aggregate_properties([
+            set_ev("u1", {"a": 1}, 1),
+            set_ev("u2", {"a": 2}, 1),
+        ])
+        assert out["u1"].fields == {"a": 1}
+        assert out["u2"].fields == {"a": 2}
+
+
+class TestMonoid:
+    EVENTS = [
+        set_ev("u1", {"a": 1, "b": 1}, 1),
+        unset_ev("u1", ["b"], 2),
+        set_ev("u1", {"c": 9}, 2),
+        delete_ev("u1", 0),
+        set_ev("u1", {"a": 5}, 4),
+    ]
+
+    def test_order_independence(self):
+        results = set()
+        for perm in itertools.permutations(self.EVENTS):
+            out = aggregate_properties(perm)
+            results.add(frozenset(out["u1"].fields.items()))
+        assert len(results) == 1
+        # b is unset at t=2 (>= its set time t=1); delete at t=0 predates all
+        assert dict(next(iter(results))) == {"a": 5, "c": 9}
+
+    def test_partitioned_merge_matches_single_fold(self):
+        # split events across "hosts", aggregate each, merge — same answer
+        part1 = {e.entity_id: EventOp.from_event(e) for e in self.EVENTS[:1]}
+        for e in self.EVENTS[1:2]:
+            part1[e.entity_id] = part1[e.entity_id].merge(EventOp.from_event(e))
+        part2 = {}
+        for e in self.EVENTS[2:]:
+            op = EventOp.from_event(e)
+            part2[e.entity_id] = (part2[e.entity_id].merge(op)
+                                  if e.entity_id in part2 else op)
+        merged = merge_aggregations([part1, part2])
+        assert merged["u1"].to_property_map().fields == \
+            aggregate_properties(self.EVENTS)["u1"].fields

@@ -1,0 +1,59 @@
+"""BiMap / EntityIdIxMap tests (mirrors reference BiMapSpec)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap, EntityMap
+
+
+class TestBiMap:
+    def test_forward_and_inverse(self):
+        bm = BiMap({"a": 1, "b": 2})
+        assert bm["a"] == 1
+        assert bm.inverse()[2] == "b"
+        assert bm.inverse().inverse().to_map() == bm.to_map()
+
+    def test_duplicate_values_rejected(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_string_int_dense_first_occurrence(self):
+        bm = BiMap.string_int(["x", "y", "x", "z"])
+        assert bm.to_map() == {"x": 0, "y": 1, "z": 2}
+
+    def test_string_int_sorted_order_free(self):
+        a = BiMap.string_int_sorted(["c", "a", "b"])
+        b = BiMap.string_int_sorted(["b", "c", "a", "a"])
+        assert a.to_map() == b.to_map() == {"a": 0, "b": 1, "c": 2}
+
+    def test_take(self):
+        bm = BiMap.string_int(["x", "y", "z"])
+        assert bm.take(["y"]).to_map() == {"y": 1}
+
+
+class TestEntityIdIxMap:
+    def test_round_trip(self):
+        m = EntityIdIxMap.build(["u3", "u1", "u2"])
+        for eid in ["u1", "u2", "u3"]:
+            assert m.id_of(m[eid]) == eid
+        assert len(m) == 3
+
+    def test_vectorized_lookup_with_unknowns(self):
+        m = EntityIdIxMap.build(["u1", "u2"])
+        ixs = m.to_indices(["u2", "nope", "u1"])
+        assert ixs.dtype == np.int32
+        assert ixs[1] == -1
+        assert m.ids_of([ixs[0], ixs[2]]) == ["u2", "u1"]
+
+    def test_deterministic_across_input_orders(self):
+        a = EntityIdIxMap.build(["b", "a", "c"])
+        b = EntityIdIxMap.build(["c", "b", "a"])
+        assert [a.id_of(i) for i in range(3)] == [b.id_of(i) for i in range(3)]
+
+
+class TestEntityMap:
+    def test_access_by_id_and_index(self):
+        em = EntityMap({"u1": 10, "u2": 20})
+        assert em["u1"] == 10
+        ix = em.ix_map["u2"]
+        assert em.get_by_index(ix) == 20

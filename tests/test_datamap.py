@@ -1,0 +1,75 @@
+"""DataMap typed-access semantics (mirrors reference DataMapSpec)."""
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap, DataMapException
+
+
+@pytest.fixture
+def dm():
+    return DataMap({
+        "string": "a",
+        "int": 10,
+        "double": 2.5,
+        "bool": True,
+        "array": ["x", "y"],
+        "doubles": [1.0, 2],
+        "obj": {"k": 1},
+        "nullv": None,
+    })
+
+
+class TestGet:
+    def test_typed_get(self, dm):
+        assert dm.get("string", str) == "a"
+        assert dm.get("int", int) == 10
+        assert dm.get("double", float) == 2.5
+        assert dm.get("int", float) == 10.0  # int widens to float
+        assert dm.get("bool", bool) is True
+        assert dm.get_string_list("array") == ["x", "y"]
+        assert dm.get_double_list("doubles") == [1.0, 2.0]
+        assert dm.get("obj", dict) == {"k": 1}
+
+    def test_missing_raises(self, dm):
+        with pytest.raises(DataMapException):
+            dm.get("nope", str)
+
+    def test_null_required_raises(self, dm):
+        with pytest.raises(DataMapException):
+            dm.get("nullv", str)
+
+    def test_type_mismatch_raises(self, dm):
+        with pytest.raises(DataMapException):
+            dm.get("string", int)
+        with pytest.raises(DataMapException):
+            dm.get("bool", int)  # bool is not an int here
+        with pytest.raises(DataMapException):
+            dm.get("double", int)  # 2.5 not integral
+
+    def test_get_opt(self, dm):
+        assert dm.get_opt("nope") is None
+        assert dm.get_opt("nullv") is None
+        assert dm.get_opt("int", int) == 10
+
+    def test_get_or_else(self, dm):
+        assert dm.get_or_else("nope", 7) == 7
+        assert dm.get_or_else("int", 7) == 10
+
+
+class TestAlgebra:
+    def test_union_right_biased(self):
+        a = DataMap({"x": 1, "y": 1})
+        b = DataMap({"y": 2, "z": 2})
+        assert (a + b).fields == {"x": 1, "y": 2, "z": 2}
+
+    def test_minus(self):
+        a = DataMap({"x": 1, "y": 1, "z": 3})
+        assert (a - ["y", "z"]).fields == {"x": 1}
+
+    def test_json_round_trip(self, dm):
+        assert DataMap.from_json(dm.to_json()) == dm
+
+    def test_mapping_protocol(self, dm):
+        assert "int" in dm
+        assert len(dm) == 8
+        assert set(dm.key_set) == set(dm.fields)

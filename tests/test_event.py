@@ -1,0 +1,91 @@
+"""Event model + validation tests (mirrors reference EventValidation rules)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event, EventValidation
+from predictionio_tpu.data.event import (format_event_time, parse_event_time,
+                                         to_millis)
+
+UTC = dt.timezone.utc
+
+
+def ev(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u0")
+    base.update(kw)
+    return Event(**base)
+
+
+class TestValidation:
+    def test_valid_plain_event(self):
+        EventValidation.validate(ev())
+
+    def test_valid_special_events(self):
+        EventValidation.validate(ev(event="$set", properties=DataMap({"a": 1})))
+        EventValidation.validate(ev(event="$unset", properties=DataMap({"a": None})))
+        EventValidation.validate(ev(event="$delete"))
+
+    @pytest.mark.parametrize("kw", [
+        dict(event=""),
+        dict(entity_type=""),
+        dict(entity_id=""),
+        dict(target_entity_type="item"),           # target type without id
+        dict(target_entity_id="i1"),               # target id without type
+        dict(target_entity_type="", target_entity_id="i1"),
+        dict(target_entity_type="item", target_entity_id=""),
+        dict(event="$unset"),                      # empty props for $unset
+        dict(event="$other"),                      # unknown reserved event
+        dict(event="pio_custom"),                  # pio_ event prefix
+        dict(event="$set", target_entity_type="item", target_entity_id="i1"),
+        dict(entity_type="pio_user"),              # reserved entity type
+        dict(target_entity_type="pio_x", target_entity_id="i1"),
+        dict(properties=DataMap({"pio_score": 1})),  # reserved property
+    ])
+    def test_invalid(self, kw):
+        with pytest.raises(ValueError):
+            EventValidation.validate(ev(**kw))
+
+    def test_builtin_entity_type_allowed(self):
+        EventValidation.validate(ev(entity_type="pio_pr"))
+        EventValidation.validate(
+            ev(target_entity_type="pio_pr", target_entity_id="x"))
+
+
+class TestJsonRoundTrip:
+    def test_round_trip(self):
+        t = dt.datetime(2026, 1, 2, 3, 4, 5, 678000, tzinfo=UTC)
+        e = ev(event="buy", target_entity_type="item", target_entity_id="i9",
+               properties=DataMap({"rating": 4.5, "tags": ["a", "b"]}),
+               event_time=t, pr_id="pr1", tags=("x",))
+        e2 = Event.from_json(e.to_json())
+        assert e2.event == "buy"
+        assert e2.entity_id == "u0"
+        assert e2.target_entity_id == "i9"
+        assert e2.properties.get("rating", float) == 4.5
+        assert e2.event_time == t
+        assert e2.pr_id == "pr1"
+        assert list(e2.tags) == ["x"]
+
+    def test_missing_required_fields(self):
+        with pytest.raises(ValueError):
+            Event.from_dict({"event": "rate"})
+        with pytest.raises(ValueError):
+            Event.from_dict({"event": "rate", "entityType": "user"})
+
+    def test_numeric_entity_id_coerced_to_string(self):
+        e = Event.from_dict(
+            {"event": "rate", "entityType": "user", "entityId": 7})
+        assert e.entity_id == "7"
+
+
+class TestTime:
+    def test_parse_z_and_offset(self):
+        a = parse_event_time("2026-01-02T03:04:05.678Z")
+        b = parse_event_time("2026-01-02T04:04:05.678+01:00")
+        assert to_millis(a) == to_millis(b)
+
+    def test_format_is_iso_millis_utc(self):
+        t = dt.datetime(2026, 1, 2, 3, 4, 5, 678000, tzinfo=UTC)
+        assert format_event_time(t) == "2026-01-02T03:04:05.678Z"
+        assert parse_event_time(format_event_time(t)) == t
