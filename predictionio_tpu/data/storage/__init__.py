@@ -1,0 +1,16 @@
+"""Storage layer: env-driven backend registry, event stores, metadata DAOs.
+
+Mirrors the reference's `io.prediction.data.storage` package
+(reference: data/src/main/scala/io/prediction/data/storage/Storage.scala).
+"""
+
+from predictionio_tpu.data.storage.base import (AccessKey, App, Channel,
+                                                EngineInstance, EngineManifest,
+                                                EvaluationInstance, Model)
+from predictionio_tpu.data.storage import registry
+from predictionio_tpu.data.storage.registry import Storage
+
+__all__ = [
+    "App", "AccessKey", "Channel", "EngineInstance", "EngineManifest",
+    "EvaluationInstance", "Model", "Storage", "registry",
+]

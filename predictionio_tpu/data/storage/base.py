@@ -1,0 +1,379 @@
+"""Metadata records and abstract DAO / event-store interfaces.
+
+Rebuilds the reference's metadata case classes and DAO traits
+(reference: data/src/main/scala/io/prediction/data/storage/{Apps,AccessKeys,
+Channels,EngineInstances,EngineManifests,EvaluationInstances,Models}.scala)
+and the event-store traits ``LEvents`` (LEvents.scala:37) / ``PEvents``
+(PEvents.scala:35). In the TPU build there is one synchronous `Events`
+interface; bulk training reads return host numpy-friendly iterators that the
+parallel ingest layer (predictionio_tpu.parallel.dataset) shards onto the
+device mesh — the analog of PEvents returning an RDD.
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime as _dt
+import re
+from dataclasses import dataclass, field, replace
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.data.aggregator import aggregate_properties
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+
+# Sentinel for "filter requires this field to be absent" (the reference's
+# Option[Option[String]] = Some(None) case in LEvents.futureFind).
+ABSENT = object()
+
+
+# ---------------------------------------------------------------------------
+# Metadata records
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class App:
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class AccessKey:
+    key: str
+    appid: int
+    events: Sequence[str] = ()  # whitelist; empty = all events allowed
+
+
+_CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+
+
+@dataclass(frozen=True)
+class Channel:
+    id: int
+    name: str  # unique within an app
+    appid: int
+
+    NAME_CONSTRAINT = "Only alphanumeric and - characters are allowed and max length is 16."
+
+    def __post_init__(self):
+        if not Channel.is_valid_name(self.name):
+            raise ValueError(
+                f"Invalid channel name: {self.name}. {Channel.NAME_CONSTRAINT}")
+
+    @staticmethod
+    def is_valid_name(name: str) -> bool:
+        return bool(_CHANNEL_NAME_RE.match(name))
+
+
+@dataclass(frozen=True)
+class EngineInstance:
+    """One training run record (EngineInstances.scala:43-58)."""
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    spark_conf: Dict[str, str] = field(default_factory=dict)
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+    def with_(self, **kw) -> "EngineInstance":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class EngineManifest:
+    id: str
+    version: str
+    name: str
+    description: Optional[str] = None
+    files: Sequence[str] = ()
+    engine_factory: str = ""
+
+
+@dataclass(frozen=True)
+class EvaluationInstance:
+    id: str = ""
+    status: str = ""
+    start_time: _dt.datetime = field(default_factory=lambda: _dt.datetime.now(_dt.timezone.utc))
+    end_time: _dt.datetime = field(default_factory=lambda: _dt.datetime.now(_dt.timezone.utc))
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    spark_conf: Dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+    def with_(self, **kw) -> "EvaluationInstance":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class Model:
+    """Serialized trained model blob (Models.scala:30)."""
+    id: str
+    models: bytes
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAO interfaces
+# ---------------------------------------------------------------------------
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]:
+        """Insert; returns generated id when app.id == 0."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, k: AccessKey) -> Optional[str]:
+        """Insert; generates a random key when k.key is empty."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, k: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, i: EngineInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(self, engine_id: str, engine_version: str,
+                             engine_variant: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self, engine_id: str, engine_version: str,
+                      engine_variant: str) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, i: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class EngineManifests(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, m: EngineManifest) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, manifest_id: str, version: str) -> Optional[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def update(self, m: EngineManifest, upsert: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, manifest_id: str, version: str) -> bool: ...
+
+
+class EvaluationInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, i: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> List[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, i: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class Models(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> bool: ...
+
+
+# ---------------------------------------------------------------------------
+# Event store interface (LEvents + PEvents unified, synchronous)
+# ---------------------------------------------------------------------------
+
+class Events(abc.ABC):
+    """Event CRUD + query per (appId, channelId) namespace.
+
+    Covers the reference's LEvents (init/remove/insert/get/delete/find,
+    LEvents.scala:50-164) and the bulk-read role of PEvents (PEvents.scala:77).
+    """
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Initialize storage for a (app, channel) namespace."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Remove all events for a namespace."""
+
+    def close(self) -> None:
+        pass
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        """Insert one event; returns its eventId."""
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]: ...
+
+    @abc.abstractmethod
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool: ...
+
+    @abc.abstractmethod
+    def find(self, app_id: int, channel_id: Optional[int] = None,
+             start_time: Optional[_dt.datetime] = None,
+             until_time: Optional[_dt.datetime] = None,
+             entity_type: Optional[str] = None,
+             entity_id: Optional[str] = None,
+             event_names: Optional[Sequence[str]] = None,
+             target_entity_type=None,  # str | ABSENT | None
+             target_entity_id=None,    # str | ABSENT | None
+             limit: Optional[int] = None,
+             reversed_order: bool = False) -> Iterator[Event]:
+        """Query events (LEvents.futureFind semantics, LEvents.scala:164).
+
+        ``target_entity_type=ABSENT`` matches events with no target entity
+        (the reference's Some(None)); ``None`` means no filter. ``limit=-1``
+        means no limit. ``reversed_order`` sorts by eventTime descending and
+        is only allowed when entity_type/entity_id are specified (enforced by
+        callers, as in the reference).
+        """
+
+    # -- derived queries ----------------------------------------------------
+    def aggregate_properties(self, app_id: int,
+                             channel_id: Optional[int] = None,
+                             entity_type: str = "",
+                             start_time: Optional[_dt.datetime] = None,
+                             until_time: Optional[_dt.datetime] = None,
+                             required: Optional[Sequence[str]] = None
+                             ) -> Dict[str, PropertyMap]:
+        """Aggregate $set/$unset/$delete into per-entity PropertyMaps
+        (LEvents.futureAggregateProperties / PEvents.aggregateProperties)."""
+        events = self.find(
+            app_id=app_id, channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            event_names=list(aggregate_event_names()))
+        result = aggregate_properties(events)
+        if required:
+            req = set(required)
+            result = {k: v for k, v in result.items()
+                      if req.issubset(v.key_set)}
+        return result
+
+    def write(self, events: Iterable[Event], app_id: int,
+              channel_id: Optional[int] = None) -> None:
+        """Bulk write (PEvents.write, PEvents.scala:181)."""
+        self.insert_batch(list(events), app_id, channel_id)
+
+
+def aggregate_event_names():
+    return ("$set", "$unset", "$delete")
+
+
+def match_event(e: Event,
+                start_time=None, until_time=None, entity_type=None,
+                entity_id=None, event_names=None, target_entity_type=None,
+                target_entity_id=None) -> bool:
+    """Shared predicate implementing find() filter semantics; backends that
+    cannot push filters down (memory, file) use this."""
+    if start_time is not None and e.event_time < start_time:
+        return False
+    if until_time is not None and e.event_time >= until_time:
+        return False
+    if entity_type is not None and e.entity_type != entity_type:
+        return False
+    if entity_id is not None and e.entity_id != entity_id:
+        return False
+    if event_names is not None and e.event not in event_names:
+        return False
+    if target_entity_type is not None:
+        if target_entity_type is ABSENT:
+            if e.target_entity_type is not None:
+                return False
+        elif e.target_entity_type != target_entity_type:
+            return False
+    if target_entity_id is not None:
+        if target_entity_id is ABSENT:
+            if e.target_entity_id is not None:
+                return False
+        elif e.target_entity_id != target_entity_id:
+            return False
+    return True

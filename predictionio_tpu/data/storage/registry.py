@@ -1,0 +1,198 @@
+"""Env-var-driven storage backend registry.
+
+Rebuilds the reference's ``Storage`` object
+(reference: data/src/main/scala/io/prediction/data/storage/Storage.scala:112-393):
+repositories METADATA / EVENTDATA / MODELDATA are bound to named sources via
+``PIO_STORAGE_REPOSITORIES_<R>_{NAME,SOURCE}``; each source is configured via
+``PIO_STORAGE_SOURCES_<S>_{TYPE,URL,HOSTS,PORTS,...}``. Backend modules are
+looked up by TYPE in a registry (explicit, not reflection — the Doer analog).
+
+Defaults (when env is unset) give a zero-config embedded deployment:
+SQLite for metadata+events and localfs for models under ``PIO_FS_BASEDIR``
+(default ``~/.pio_store``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+_lock = threading.RLock()
+_clients: Dict[str, Any] = {}       # source name -> backend client
+_dataobjects: Dict[str, Any] = {}   # (repo, kind) -> DAO
+
+
+class StorageClientConfig:
+    """Parsed PIO_STORAGE_SOURCES_<S>_* config (Storage.scala:73)."""
+
+    def __init__(self, name: str, type_: str, properties: Dict[str, str]):
+        self.name = name
+        self.type = type_
+        self.properties = properties
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self.properties.get(key.upper(), default)
+
+    def __repr__(self):
+        return f"StorageClientConfig({self.name}, {self.type}, {self.properties})"
+
+
+def _env(key: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(key, default)
+
+
+def base_dir() -> str:
+    return _env("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+
+
+def _default_source_for(repo: str) -> StorageClientConfig:
+    if repo == "MODELDATA":
+        return StorageClientConfig(
+            "LOCALFS", "localfs",
+            {"HOSTS": os.path.join(base_dir(), "models")})
+    return StorageClientConfig(
+        "SQLITE", "sqlite", {"URL": os.path.join(base_dir(), "pio.db")})
+
+
+def source_config(source_name: str) -> Optional[StorageClientConfig]:
+    prefix = f"PIO_STORAGE_SOURCES_{source_name}_"
+    props = {k[len(prefix):].upper(): v for k, v in os.environ.items()
+             if k.startswith(prefix)}
+    type_ = props.pop("TYPE", None)
+    if type_ is None:
+        return None
+    return StorageClientConfig(source_name, type_.lower(), props)
+
+
+def repository_config(repo: str) -> StorageClientConfig:
+    source_name = _env(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE")
+    if source_name:
+        cfg = source_config(source_name)
+        if cfg is None:
+            raise StorageError(
+                f"Repository {repo} references source {source_name} but "
+                f"PIO_STORAGE_SOURCES_{source_name}_TYPE is not set.")
+        return cfg
+    return _default_source_for(repo)
+
+
+def repository_namespace(repo: str) -> str:
+    defaults = {"METADATA": "pio_meta", "EVENTDATA": "pio_event",
+                "MODELDATA": "pio_model"}
+    return _env(f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", defaults[repo])
+
+
+class StorageError(Exception):
+    pass
+
+
+def _backend_module(type_: str):
+    # Explicit registry of backend implementations, keyed by source TYPE.
+    import importlib
+    modules = {
+        "sqlite": "predictionio_tpu.data.storage.sqlite",
+        "memory": "predictionio_tpu.data.storage.memory",
+        "localfs": "predictionio_tpu.data.storage.localfs",
+        "pgsql": "predictionio_tpu.data.storage.sqlite",  # same SQL DAO family
+    }
+    if type_ not in modules:
+        raise StorageError(f"Unknown storage source type: {type_}. "
+                           f"Known types: {sorted(modules)}")
+    return importlib.import_module(modules[type_])
+
+
+def _client_for(cfg: StorageClientConfig):
+    with _lock:
+        if cfg.name not in _clients:
+            mod = _backend_module(cfg.type)
+            _clients[cfg.name] = mod.StorageClient(cfg)
+        return _clients[cfg.name]
+
+
+def get_data_object(repo: str, kind: str):
+    """kind in {apps, access_keys, channels, engine_instances,
+    engine_manifests, evaluation_instances, models, events}."""
+    key = f"{repo}/{kind}"
+    with _lock:
+        if key not in _dataobjects:
+            cfg = repository_config(repo)
+            client = _client_for(cfg)
+            namespace = repository_namespace(repo)
+            _dataobjects[key] = client.get_data_object(kind, namespace)
+        return _dataobjects[key]
+
+
+def clear_cache() -> None:
+    """Drop cached clients/DAOs (tests switch env between cases)."""
+    with _lock:
+        for c in _clients.values():
+            close = getattr(c, "close", None)
+            if close:
+                try:
+                    close()
+                except Exception:
+                    pass
+        _clients.clear()
+        _dataobjects.clear()
+
+
+class Storage:
+    """Facade matching the reference Storage object's accessors."""
+
+    @staticmethod
+    def get_meta_data_apps():
+        return get_data_object("METADATA", "apps")
+
+    @staticmethod
+    def get_meta_data_access_keys():
+        return get_data_object("METADATA", "access_keys")
+
+    @staticmethod
+    def get_meta_data_channels():
+        return get_data_object("METADATA", "channels")
+
+    @staticmethod
+    def get_meta_data_engine_instances():
+        return get_data_object("METADATA", "engine_instances")
+
+    @staticmethod
+    def get_meta_data_engine_manifests():
+        return get_data_object("METADATA", "engine_manifests")
+
+    @staticmethod
+    def get_meta_data_evaluation_instances():
+        return get_data_object("METADATA", "evaluation_instances")
+
+    @staticmethod
+    def get_model_data_models():
+        return get_data_object("MODELDATA", "models")
+
+    @staticmethod
+    def get_events():
+        """The LEvents/PEvents analog."""
+        return get_data_object("EVENTDATA", "events")
+
+    # Back-compat aliases mirroring reference names
+    get_l_events = get_events
+    get_p_events = get_events
+
+    @staticmethod
+    def verify_all_data_objects() -> Dict[str, bool]:
+        """Health check used by `pio status` (Storage.scala:325-348)."""
+        out = {}
+        for repo, kind in [("METADATA", "apps"), ("EVENTDATA", "events"),
+                           ("MODELDATA", "models")]:
+            try:
+                get_data_object(repo, kind)
+                out[repo] = True
+            except Exception:
+                out[repo] = False
+        return out
+
+    @staticmethod
+    def config_summary() -> Dict[str, str]:
+        return {repo: f"{repository_config(repo).type}"
+                for repo in REPOSITORIES}

@@ -1,0 +1,269 @@
+"""Parametrized storage spec — one spec, every backend.
+
+Mirrors the reference's LEventsSpec/PEventsSpec pattern of running the same
+specification against each event-store implementation
+(reference: data/src/test/scala/io/prediction/data/storage/LEventsSpec.scala:22-75).
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import (AccessKey, App, Channel,
+                                           EngineInstance, EngineManifest,
+                                           EvaluationInstance, Model)
+from predictionio_tpu.data.storage.base import ABSENT
+from predictionio_tpu.data.storage.localfs import StorageClient as FSClient
+from predictionio_tpu.data.storage.memory import StorageClient as MemClient
+from predictionio_tpu.data.storage.registry import StorageClientConfig
+from predictionio_tpu.data.storage.sqlite import StorageClient as SQLClient
+
+UTC = dt.timezone.utc
+
+
+def t(sec):
+    return dt.datetime(2026, 1, 1, 0, 0, sec, tzinfo=UTC)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def client(request, tmp_path):
+    if request.param == "memory":
+        c = MemClient(StorageClientConfig("TEST", "memory", {}))
+    else:
+        c = SQLClient(StorageClientConfig(
+            "TEST", "sqlite", {"URL": str(tmp_path / "t.db")}))
+    yield c
+    c.close()
+
+
+@pytest.fixture
+def events(client):
+    ev = client.get_data_object("events", "test")
+    ev.init(1)
+    return ev
+
+
+def mk(event="rate", eid="u1", sec=1, **kw):
+    return Event(event=event, entity_type="user", entity_id=eid,
+                 event_time=t(sec), **kw)
+
+
+class TestEventsCRUD:
+    def test_insert_get_delete(self, events):
+        e = mk(properties=DataMap({"rating": 5}))
+        eid = events.insert(e, 1)
+        got = events.get(eid, 1)
+        assert got.event == "rate"
+        assert got.properties.get("rating", int) == 5
+        assert got.event_id == eid
+        assert events.delete(eid, 1)
+        assert events.get(eid, 1) is None
+        assert not events.delete(eid, 1)
+
+    def test_channel_isolation(self, events):
+        events.init(1, 5)
+        eid = events.insert(mk(), 1, 5)
+        assert events.get(eid, 1) is None
+        assert events.get(eid, 1, 5).event_id == eid
+        assert list(events.find(1)) == []
+        assert len(list(events.find(1, 5))) == 1
+
+    def test_app_isolation(self, events):
+        events.init(2)
+        events.insert(mk(), 1)
+        assert list(events.find(2)) == []
+
+    def test_remove(self, events):
+        events.insert(mk(), 1)
+        events.remove(1)
+        assert list(events.find(1)) == []
+
+    def test_insert_batch(self, events):
+        eids = events.insert_batch([mk(sec=i) for i in range(5)], 1)
+        assert len(set(eids)) == 5
+        assert len(list(events.find(1))) == 5
+
+
+class TestEventsFind:
+    @pytest.fixture(autouse=True)
+    def _fill(self, events):
+        self.ev = events
+        events.insert_batch([
+            mk("rate", "u1", 1, target_entity_type="item",
+               target_entity_id="i1"),
+            mk("buy", "u1", 2, target_entity_type="item",
+               target_entity_id="i2"),
+            mk("rate", "u2", 3, target_entity_type="item",
+               target_entity_id="i1"),
+            mk("$set", "u1", 4, properties=DataMap({"a": 1})),
+        ], 1)
+
+    def test_time_range(self):
+        assert len(list(self.ev.find(1, start_time=t(2)))) == 3
+        assert len(list(self.ev.find(1, until_time=t(2)))) == 1
+        assert len(list(self.ev.find(1, start_time=t(2), until_time=t(4)))) == 2
+
+    def test_entity_filters(self):
+        assert len(list(self.ev.find(1, entity_id="u1"))) == 3
+        assert len(list(self.ev.find(1, entity_type="user"))) == 4
+        assert len(list(self.ev.find(1, entity_type="nope"))) == 0
+
+    def test_event_names(self):
+        assert len(list(self.ev.find(1, event_names=["rate"]))) == 2
+        assert len(list(self.ev.find(1, event_names=["rate", "buy"]))) == 3
+
+    def test_target_entity(self):
+        assert len(list(self.ev.find(1, target_entity_id="i1"))) == 2
+        assert len(list(self.ev.find(1, target_entity_type=ABSENT))) == 1
+        assert len(list(self.ev.find(1, target_entity_id=ABSENT))) == 1
+
+    def test_limit_and_order(self):
+        got = list(self.ev.find(1, limit=2))
+        assert [e.event_time for e in got] == [t(1), t(2)]
+        got = list(self.ev.find(1, entity_id="u1", reversed_order=True))
+        assert [e.event_time for e in got] == [t(4), t(2), t(1)]
+        assert len(list(self.ev.find(1, limit=-1))) == 4
+
+    def test_aggregate_properties_via_store(self):
+        self.ev.insert(mk("$unset", "u1", 5,
+                          properties=DataMap({"a": None})), 1)
+        self.ev.insert(mk("$set", "u3", 5, properties=DataMap({"b": 2})), 1)
+        agg = self.ev.aggregate_properties(1, entity_type="user")
+        # u1's only property was unset -> empty-but-present map (ref semantics)
+        assert agg["u1"].fields == {}
+        assert agg["u3"].fields == {"b": 2}
+        req = self.ev.aggregate_properties(1, entity_type="user",
+                                           required=["b"])
+        assert set(req) == {"u3"}
+
+
+class TestMetadataDAOs:
+    def test_apps(self, client):
+        apps = client.get_data_object("apps", "test")
+        aid = apps.insert(App(0, "myapp", "desc"))
+        assert aid is not None
+        assert apps.get(aid).name == "myapp"
+        assert apps.get_by_name("myapp").id == aid
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        assert apps.update(App(aid, "renamed", None))
+        assert apps.get(aid).name == "renamed"
+        aid2 = apps.insert(App(0, "other"))
+        assert {a.id for a in apps.get_all()} == {aid, aid2}
+        assert apps.delete(aid)
+        assert apps.get(aid) is None
+
+    def test_access_keys(self, client):
+        ak = client.get_data_object("access_keys", "test")
+        key = ak.insert(AccessKey("", 7, ["rate"]))
+        assert key and len(key) > 20
+        assert ak.get(key).appid == 7
+        assert ak.get_by_app_id(7)[0].events == ("rate",)
+        assert ak.get_by_app_id(8) == []
+        key2 = ak.insert(AccessKey("explicit", 7, []))
+        assert key2 == "explicit"
+        assert len(ak.get_all()) == 2
+        assert ak.delete(key)
+        assert ak.get(key) is None
+
+    def test_channels(self, client):
+        ch = client.get_data_object("channels", "test")
+        cid = ch.insert(Channel(0, "chan-1", 7))
+        assert ch.get(cid).name == "chan-1"
+        assert ch.insert(Channel(0, "chan-1", 7)) is None  # dup in app
+        assert ch.insert(Channel(0, "chan-1", 8)) is not None  # other app ok
+        assert len(ch.get_by_app_id(7)) == 1
+        assert ch.delete(cid)
+
+    def test_channel_name_validation(self):
+        with pytest.raises(ValueError):
+            Channel(0, "bad name!", 1)
+        with pytest.raises(ValueError):
+            Channel(0, "x" * 17, 1)
+
+    def test_engine_instances(self, client):
+        ei = client.get_data_object("engine_instances", "test")
+        base_i = EngineInstance(
+            id="", status="INIT", start_time=t(1), end_time=t(1),
+            engine_id="e1", engine_version="1", engine_variant="v1",
+            engine_factory="f", algorithms_params='[{"name":"als"}]')
+        iid = ei.insert(base_i)
+        assert ei.get(iid).status == "INIT"
+        assert ei.get_latest_completed("e1", "1", "v1") is None
+        assert ei.update(ei.get(iid).with_(status="COMPLETED"))
+        iid2 = ei.insert(base_i.with_(start_time=t(9), status="COMPLETED"))
+        latest = ei.get_latest_completed("e1", "1", "v1")
+        assert latest.id == iid2
+        assert len(ei.get_completed("e1", "1", "v1")) == 2
+        assert ei.get(iid).algorithms_params == '[{"name":"als"}]'
+        assert ei.delete(iid2)
+
+    def test_evaluation_instances(self, client):
+        dao = client.get_data_object("evaluation_instances", "test")
+        iid = dao.insert(EvaluationInstance(
+            status="INIT", start_time=t(1), end_time=t(1),
+            evaluation_class="MyEval"))
+        assert dao.get(iid).evaluation_class == "MyEval"
+        dao.update(dao.get(iid).with_(status="EVALCOMPLETED",
+                                      evaluator_results="ok"))
+        assert dao.get_completed()[0].evaluator_results == "ok"
+        assert dao.delete(iid)
+
+    def test_engine_manifests(self, client):
+        dao = client.get_data_object("engine_manifests", "test")
+        dao.insert(EngineManifest("e1", "1.0", "engine", None, ("a.py",), "F"))
+        assert dao.get("e1", "1.0").engine_factory == "F"
+        assert dao.get("e1", "2.0") is None
+        dao.update(EngineManifest("e1", "1.0", "engine2", None, (), "F2"))
+        assert dao.get("e1", "1.0").name == "engine2"
+        assert dao.delete("e1", "1.0")
+
+    def test_models(self, client):
+        dao = client.get_data_object("models", "test")
+        dao.insert(Model("m1", b"\x00\x01binary"))
+        assert dao.get("m1").models == b"\x00\x01binary"
+        assert dao.get("m2") is None
+        assert dao.delete("m1")
+        assert not dao.delete("m1")
+
+
+class TestLocalFSModels:
+    def test_round_trip(self, tmp_path):
+        c = FSClient(StorageClientConfig(
+            "FS", "localfs", {"PATH": str(tmp_path)}))
+        dao = c.get_data_object("models", "ns")
+        dao.insert(Model("m/odd id", b"blob" * 1000))
+        assert dao.get("m/odd id").models == b"blob" * 1000
+        assert dao.delete("m/odd id")
+        assert dao.get("m/odd id") is None
+
+
+class TestRegistry:
+    def test_env_driven_resolution(self, tmp_env):
+        from predictionio_tpu.data.storage import Storage
+        apps = Storage.get_meta_data_apps()
+        aid = apps.insert(App(0, "regapp"))
+        # same DAO instance comes back from the cache
+        assert Storage.get_meta_data_apps().get(aid).name == "regapp"
+        ev = Storage.get_events()
+        ev.init(aid)
+        ev.insert(mk(), aid)
+        assert len(list(ev.find(aid))) == 1
+        assert all(Storage.verify_all_data_objects().values())
+        assert Storage.config_summary()["METADATA"] == "sqlite"
+
+    def test_defaults_without_env(self, tmp_path, monkeypatch):
+        for k in list(__import__("os").environ):
+            if k.startswith("PIO_STORAGE") or k == "PIO_FS_BASEDIR":
+                monkeypatch.delenv(k, raising=False)
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path / "store"))
+        from predictionio_tpu.data.storage import registry
+        registry.clear_cache()
+        try:
+            assert registry.repository_config("METADATA").type == "sqlite"
+            assert registry.repository_config("MODELDATA").type == "localfs"
+            models = registry.Storage.get_model_data_models()
+            models.insert(Model("m", b"x"))
+            assert models.get("m").models == b"x"
+        finally:
+            registry.clear_cache()
