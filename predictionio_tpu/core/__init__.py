@@ -1,0 +1,43 @@
+"""Core controller API: the DASE component model and engine pipelines.
+
+Rebuilds the reference's `io.prediction.controller` / `io.prediction.core`
+packages (reference: core/src/main/scala/io/prediction/controller/).
+"""
+
+from predictionio_tpu.core.params import (EmptyParams, Params, params_to_json,
+                                          params_from_json)
+from predictionio_tpu.core.base import (Algorithm, DataSource, FirstServing,
+                                        AverageServing, IdentityPreparator,
+                                        LAlgorithm, P2LAlgorithm, PAlgorithm,
+                                        Preparator, SanityCheck, Serving)
+from predictionio_tpu.core.persistence import (PersistentModel,
+                                               PersistentModelLoader,
+                                               PersistentModelManifest,
+                                               RETRAIN)
+from predictionio_tpu.core.engine import (Engine, EngineFactory, EngineParams,
+                                          SimpleEngine, TrainResult,
+                                          WorkflowParams)
+from predictionio_tpu.core.metrics import (AverageMetric, Metric,
+                                           OptionAverageMetric,
+                                           OptionStdevMetric, StdevMetric,
+                                           SumMetric, ZeroMetric)
+from predictionio_tpu.core.evaluation import (Evaluation,
+                                              EngineParamsGenerator,
+                                              MetricEvaluator,
+                                              MetricEvaluatorResult)
+from predictionio_tpu.core.fast_eval import FastEvalEngine
+
+__all__ = [
+    "Params", "EmptyParams", "params_to_json", "params_from_json",
+    "DataSource", "Preparator", "IdentityPreparator", "Algorithm",
+    "LAlgorithm", "P2LAlgorithm", "PAlgorithm", "Serving", "FirstServing",
+    "AverageServing", "SanityCheck",
+    "PersistentModel", "PersistentModelLoader", "PersistentModelManifest",
+    "RETRAIN",
+    "Engine", "EngineFactory", "EngineParams", "SimpleEngine", "TrainResult",
+    "WorkflowParams",
+    "Metric", "AverageMetric", "OptionAverageMetric", "StdevMetric",
+    "OptionStdevMetric", "SumMetric", "ZeroMetric",
+    "Evaluation", "EngineParamsGenerator", "MetricEvaluator",
+    "MetricEvaluatorResult", "FastEvalEngine",
+]

@@ -1,0 +1,198 @@
+"""DASE base components: DataSource, Preparator, Algorithm, Serving.
+
+Rebuilds the reference's controller component hierarchy
+(reference: core/src/main/scala/io/prediction/core/Base*.scala and
+controller/{PDataSource,LDataSource,PPreparator,LPreparator,PAlgorithm,
+P2LAlgorithm,LAlgorithm,LServing}.scala).
+
+The reference's L / P2L / P taxonomy encodes *where the model lives* in a
+Spark cluster (driver-local / local-after-cluster-train / RDD-distributed).
+The TPU-native translation (SURVEY.md section 2.9) is model *placement*:
+
+  - ``LAlgorithm``   -> model in host RAM; predict runs on host.
+  - ``P2LAlgorithm`` -> model trained on the mesh, gathered to one
+                        device/host; predict is a jitted single-device call.
+  - ``PAlgorithm``   -> model stays sharded across the mesh (jax.Arrays with
+                        non-replicated sharding); predict is a jitted gather
+                        on the mesh.
+
+All three share one Python base class; the placement split shows up in
+``placement`` and in how ``make_persistent_model`` treats the model, not in
+the train/predict call signatures (XLA makes single- and multi-device code
+identical at this layer).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Generic, Iterable, List, Optional, Sequence, Tuple, TypeVar
+
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.core.persistence import (PersistentModel,
+                                               PersistentModelManifest,
+                                               RETRAIN)
+
+TD = TypeVar("TD")  # training data
+EI = TypeVar("EI")  # evaluation info
+PD = TypeVar("PD")  # prepared data
+M = TypeVar("M")    # model
+Q = TypeVar("Q")    # query
+P = TypeVar("P")    # predicted result
+A = TypeVar("A")    # actual result
+
+
+class Doer:
+    """Component instantiation: ctor(params) if accepted, else ctor()
+    (reference: core/AbstractDoer.scala:43-65 — registry call, not JVM
+    reflection)."""
+
+    @staticmethod
+    def apply(cls, params: Optional[Params] = None):
+        if params is None:
+            return cls()
+        try:
+            return cls(params)
+        except TypeError:
+            return cls()
+
+
+class SanityCheck(abc.ABC):
+    """Optional per-stage data check (controller/SanityCheck.scala:24-29),
+    invoked by Engine.train after each stage."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None: ...
+
+
+class DataSource(Generic[TD, EI, Q, A], abc.ABC):
+    """Reads training and evaluation data from the event store
+    (controller/PDataSource.scala:34-56). TPU note: return host-side
+    structures or already-sharded arrays; the parallel.dataset helpers
+    build mesh-sharded jax.Arrays from event streams."""
+
+    def __init__(self, params: Optional[Params] = None):
+        self.params = params
+
+    @abc.abstractmethod
+    def read_training(self) -> TD: ...
+
+    def read_eval(self) -> List[Tuple[TD, EI, Iterable[Tuple[Q, A]]]]:
+        """Eval sets: (trainingData, evalInfo, [(query, actual)])."""
+        return []
+
+
+class Preparator(Generic[TD, PD], abc.ABC):
+    """(controller/PPreparator.scala:30)"""
+
+    def __init__(self, params: Optional[Params] = None):
+        self.params = params
+
+    @abc.abstractmethod
+    def prepare(self, training_data: TD) -> PD: ...
+
+
+class IdentityPreparator(Preparator):
+    """(controller/IdentityPreparator.scala:31)"""
+
+    def prepare(self, training_data):
+        return training_data
+
+
+class Algorithm(Generic[PD, M, Q, P], abc.ABC):
+    """One trainable + queryable model (core/BaseAlgorithm.scala:55-123).
+
+    ``placement`` declares where the trained model lives:
+      'host' (L), 'device' (P2L), 'mesh' (P).
+    """
+
+    placement: str = "device"
+
+    def __init__(self, params: Optional[Params] = None):
+        self.params = params
+
+    @abc.abstractmethod
+    def train(self, prepared_data: PD) -> M: ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P: ...
+
+    def batch_predict(self, model: M, queries: Sequence[Tuple[int, Q]]
+                      ) -> List[Tuple[int, P]]:
+        """Bulk predict for evaluation. Default maps predict() — the
+        P2LAlgorithm.batchPredict default (controller/P2LAlgorithm.scala:43).
+        TPU algorithms override this with a single jitted batched call."""
+        return [(ix, self.predict(model, q)) for ix, q in queries]
+
+    # -- persistence contract (core/BaseAlgorithm.scala:108) ----------------
+    def make_persistent_model(self, model: M):
+        """Decide the persistence mode for a trained model. Returns either
+        the model itself (serialized automatically), a
+        PersistentModelManifest (model saved itself; reflect loader at
+        deploy), or RETRAIN (re-train at deploy time)."""
+        if isinstance(model, PersistentModel):
+            return model  # engine core will call .save() and store a manifest
+        if self.placement == "mesh":
+            # a sharded model can't be naively pickled; default to retrain
+            # unless it manages its own persistence (PAlgorithm.scala:109)
+            return RETRAIN
+        return model
+
+    @property
+    def query_class(self):
+        """Query type for JSON decode at serve time; None = raw dict."""
+        return getattr(self, "QUERY_CLASS", None)
+
+
+class LAlgorithm(Algorithm[PD, M, Q, P]):
+    """Model lives in host RAM (controller/LAlgorithm.scala:42-129)."""
+    placement = "host"
+
+
+class P2LAlgorithm(Algorithm[PD, M, Q, P]):
+    """Mesh-trained, single-device model (controller/P2LAlgorithm.scala)."""
+    placement = "device"
+
+
+class PAlgorithm(Algorithm[PD, M, Q, P]):
+    """Model sharded across the mesh (controller/PAlgorithm.scala:44-125)."""
+    placement = "mesh"
+
+    def batch_predict(self, model, queries):
+        raise NotImplementedError(
+            "PAlgorithm does not support batch_predict by default "
+            "(controller/PAlgorithm.scala:44); override it for evaluation.")
+
+
+class Serving(Generic[Q, P], abc.ABC):
+    """Combines predictions of all algorithms into one result
+    (controller/LServing.scala:27-51)."""
+
+    def __init__(self, params: Optional[Params] = None):
+        self.params = params
+
+    def supplement(self, query: Q) -> Q:
+        """Pre-process query before algorithms see it."""
+        return query
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P: ...
+
+
+class FirstServing(Serving):
+    """Serve the first algorithm's prediction
+    (controller/LFirstServing.scala:25)."""
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """Average numeric predictions (controller/LAverageServing.scala:25)."""
+
+    def serve(self, query, predictions):
+        return sum(predictions) / len(predictions)
+
+
+def run_sanity_check(obj: Any, enabled: bool) -> None:
+    if enabled and isinstance(obj, SanityCheck):
+        obj.sanity_check()

@@ -1,0 +1,66 @@
+"""Typed component parameters.
+
+Rebuilds the reference's ``Params`` marker + JSON extraction
+(reference: core/src/main/scala/io/prediction/controller/Params.scala:23,
+workflow/WorkflowUtils.scala:132-204 `extractParams`). Components declare a
+``@dataclass`` subclassing ``Params``; engine.json ``params`` blocks are
+deserialized into them by field name (the Doer/reflection analog, but via
+dataclass introspection instead of JVM reflection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """Marker base class for component parameters."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    pass
+
+
+def params_from_dict(cls: Optional[Type[Params]], d: Optional[Dict[str, Any]]):
+    """Build a Params instance from a JSON dict, tolerating missing optional
+    fields and rejecting unknown ones (matching json4s strict extraction)."""
+    if cls is None or cls is EmptyParams:
+        return EmptyParams()
+    d = d or {}
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"params class {cls} must be a dataclass")
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - names
+    if unknown:
+        raise ValueError(
+            f"Unknown parameter(s) {sorted(unknown)} for {cls.__name__}; "
+            f"expected subset of {sorted(names)}")
+    missing = [f.name for f in dataclasses.fields(cls)
+               if f.name not in d and f.default is dataclasses.MISSING
+               and f.default_factory is dataclasses.MISSING]
+    if missing:
+        raise ValueError(
+            f"Missing required parameter(s) {missing} for {cls.__name__}")
+    return cls(**d)
+
+
+def params_to_dict(p: Optional[Params]) -> Dict[str, Any]:
+    if p is None:
+        return {}
+    if dataclasses.is_dataclass(p):
+        return dataclasses.asdict(p)
+    if isinstance(p, dict):
+        return dict(p)
+    raise TypeError(f"cannot serialize params {p!r}")
+
+
+def params_to_json(p: Optional[Params]) -> str:
+    return json.dumps(params_to_dict(p), sort_keys=True)
+
+
+def params_from_json(cls: Optional[Type[Params]], s: str):
+    return params_from_dict(cls, json.loads(s) if s else {})

@@ -1,0 +1,90 @@
+"""Model persistence contracts — the three checkpoint modes.
+
+Rebuilds the reference's persistence design (SURVEY.md section 5
+"Checkpoint / resume"; reference: controller/PersistentModel.scala:64+,
+workflow/PersistentModelManifest.scala:18, controller/Engine.scala:208-230):
+
+  1. automatic  — the trained model object is serialized by the framework
+                  into the MODELDATA repository (the Kryo analog is pickle;
+                  device arrays are converted to host numpy first).
+  2. manual     — the model implements PersistentModel.save(); only a
+                  PersistentModelManifest naming its loader is stored, and
+                  the loader restores it at deploy (the orbax/tensorstore-
+                  style sharded-checkpoint path for mesh models).
+  3. retrain    — make_persistent_model returns RETRAIN; deploy re-runs
+                  read/prepare/train.
+"""
+
+from __future__ import annotations
+
+import abc
+import importlib
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+class _Retrain:
+    """Sentinel: do not persist; re-train at deploy (the Unit-model case)."""
+
+    _instance: Optional["_Retrain"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "RETRAIN"
+
+
+RETRAIN = _Retrain()
+
+
+@dataclass(frozen=True)
+class PersistentModelManifest:
+    """Stored in place of the model blob when the model persists itself
+    (workflow/PersistentModelManifest.scala:18). ``loader`` is the
+    fully-qualified name of a PersistentModelLoader subclass or of the
+    model class itself (which must expose ``load``)."""
+    loader: str
+
+
+class PersistentModel(abc.ABC):
+    """Mix-in for models that manage their own storage
+    (controller/PersistentModel.scala:64)."""
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, params: Any) -> bool:
+        """Persist; return True to store only a manifest, False to fall back
+        to automatic serialization (PersistentModel.scala docs)."""
+
+    @classmethod
+    def loader_name(cls) -> str:
+        return f"{cls.__module__}.{cls.__qualname__}"
+
+
+class PersistentModelLoader(abc.ABC):
+    """Restores a PersistentModel at deploy time
+    (controller/PersistentModel.scala PersistentModelLoader)."""
+
+    @abc.abstractmethod
+    def load(self, instance_id: str, params: Any) -> Any: ...
+
+
+def resolve_loader(qualname: str):
+    """Import the loader named by a manifest (the reflection analog;
+    workflow/WorkflowUtils.scala:350 getPersistentModel)."""
+    module_name, _, attr = qualname.rpartition(".")
+    obj = getattr(importlib.import_module(module_name), attr)
+    return obj
+
+
+def load_persistent_model(manifest: PersistentModelManifest,
+                          instance_id: str, params: Any):
+    loader = resolve_loader(manifest.loader)
+    if isinstance(loader, type) and issubclass(loader, PersistentModelLoader):
+        return loader().load(instance_id, params)
+    load = getattr(loader, "load", None)
+    if load is None:
+        raise TypeError(f"{manifest.loader} has no load()")
+    return load(instance_id, params)

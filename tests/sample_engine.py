@@ -1,0 +1,195 @@
+"""Fake DASE components that record exact dataflow.
+
+Mirrors the reference's SampleEngine fixture backbone
+(reference: core/src/test/scala/io/prediction/controller/SampleEngine.scala:12-180):
+numbered fake components stamp their ids into the data they produce so tests
+can assert precisely which component, with which params, touched each stage.
+"""
+
+from dataclasses import dataclass, field as dc_field
+from typing import List, Optional, Tuple
+
+from predictionio_tpu.core import (Algorithm, DataSource, Params, PAlgorithm,
+                                   Preparator, SanityCheck, Serving)
+from predictionio_tpu.core.persistence import (PersistentModel,
+                                               PersistentModelLoader)
+
+# Simple value types stamped with provenance ids
+
+
+@dataclass(frozen=True)
+class TrainingData:
+    id: int
+    error: bool = False
+
+    def __post_init__(self):
+        pass
+
+
+class SanityTrainingData(TrainingData, SanityCheck):
+    def sanity_check(self):
+        if self.error:
+            raise ValueError(f"TrainingData {self.id} failed sanity check")
+
+
+@dataclass(frozen=True)
+class ProcessedData:
+    id: int
+    td: TrainingData
+
+
+@dataclass(frozen=True)
+class Query:
+    id: int
+    supplemented: bool = False
+
+
+@dataclass(frozen=True)
+class Prediction:
+    id: int          # algorithm id
+    q: Query
+    models: Optional[object] = None
+
+
+@dataclass(frozen=True)
+class Actual:
+    id: int
+
+
+@dataclass(frozen=True)
+class EvalInfo:
+    id: int
+
+
+@dataclass(frozen=True)
+class DSParams(Params):
+    id: int = 0
+    error: bool = False
+    n_eval_sets: int = 0
+
+
+class DataSource0(DataSource):
+    PARAMS_CLASS = DSParams
+
+    def __init__(self, params=None):
+        super().__init__(params or DSParams())
+
+    def read_training(self):
+        return SanityTrainingData(self.params.id, self.params.error)
+
+    def read_eval(self):
+        out = []
+        for s in range(self.params.n_eval_sets):
+            td = SanityTrainingData(self.params.id)
+            qa = [(Query(q), Actual(q)) for q in range(3)]
+            out.append((td, EvalInfo(self.params.id), qa))
+        return out
+
+
+@dataclass(frozen=True)
+class PParams(Params):
+    id: int = 0
+
+
+class Preparator0(Preparator):
+    PARAMS_CLASS = PParams
+
+    def __init__(self, params=None):
+        super().__init__(params or PParams())
+
+    def prepare(self, td):
+        return ProcessedData(self.params.id, td)
+
+
+@dataclass(frozen=True)
+class AParams(Params):
+    id: int = 0
+
+
+@dataclass(frozen=True)
+class AModel:
+    id: int
+    pd: ProcessedData
+
+
+class Algo0(Algorithm):
+    PARAMS_CLASS = AParams
+
+    def __init__(self, params=None):
+        super().__init__(params or AParams())
+
+    def train(self, pd):
+        return AModel(self.params.id, pd)
+
+    def predict(self, model, query):
+        return Prediction(self.params.id, query, models=model)
+
+
+class PAlgo0(PAlgorithm):
+    """Mesh-placement algorithm: defaults to retrain-on-deploy."""
+    PARAMS_CLASS = AParams
+
+    def __init__(self, params=None):
+        super().__init__(params or AParams())
+
+    def train(self, pd):
+        return AModel(self.params.id, pd)
+
+    def predict(self, model, query):
+        return Prediction(self.params.id, query, models=model)
+
+    def batch_predict(self, model, queries):
+        return [(ix, self.predict(model, q)) for ix, q in queries]
+
+
+class PersistentModel0(PersistentModel):
+    saved = {}  # (instance_id) -> model; class-level store for tests
+
+    def __init__(self, id, pd):
+        self.id = id
+        self.pd = pd
+
+    def save(self, instance_id, params):
+        PersistentModel0.saved[instance_id] = self
+        return True
+
+    @classmethod
+    def load(cls, instance_id, params):
+        return cls.saved[instance_id]
+
+
+class PersistentLoader0(PersistentModelLoader):
+    def load(self, instance_id, params):
+        return PersistentModel0.saved[instance_id]
+
+
+class PersistentAlgo0(Algorithm):
+    """Algorithm whose model persists itself and restores via loader."""
+    PARAMS_CLASS = AParams
+
+    def __init__(self, params=None):
+        super().__init__(params or AParams())
+
+    def train(self, pd):
+        return PersistentModel0(self.params.id, pd)
+
+    def predict(self, model, query):
+        return Prediction(self.params.id, query, models=model)
+
+
+@dataclass(frozen=True)
+class SParams(Params):
+    id: int = 0
+
+
+class Serving0(Serving):
+    PARAMS_CLASS = SParams
+
+    def __init__(self, params=None):
+        super().__init__(params or SParams())
+
+    def supplement(self, query):
+        return Query(query.id, supplemented=True)
+
+    def serve(self, query, predictions):
+        return predictions[0]

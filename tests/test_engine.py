@@ -1,0 +1,202 @@
+"""Engine train/eval/persist/prepare_deploy pipeline tests.
+
+Mirrors the reference's EngineTest coverage
+(reference: core/src/test/scala/io/prediction/controller/EngineTest.scala).
+"""
+
+import pytest
+
+from predictionio_tpu.core import (Engine, EngineParams, SimpleEngine,
+                                   WorkflowParams)
+from predictionio_tpu.core.engine import (StopAfterPrepareInterruption,
+                                          StopAfterReadInterruption)
+from predictionio_tpu.core.persistence import (RETRAIN,
+                                               PersistentModelManifest)
+from tests.sample_engine import (Algo0, AModel, AParams, DataSource0,
+                                 DSParams, PAlgo0, PersistentAlgo0,
+                                 PersistentModel0, PParams, Preparator0,
+                                 Query, Serving0, SParams)
+
+
+def make_engine(algo_map=None):
+    return Engine(
+        {"": DataSource0}, {"": Preparator0},
+        algo_map or {"algo": Algo0}, {"": Serving0})
+
+
+def make_params(ds_id=1, p_id=2, algo_ids=(3,), s_id=4, algo_name="algo",
+                **ds_kw):
+    return EngineParams(
+        data_source_params=("", DSParams(id=ds_id, **ds_kw)),
+        preparator_params=("", PParams(id=p_id)),
+        algorithm_params_list=[(algo_name, AParams(id=i)) for i in algo_ids],
+        serving_params=("", SParams(id=s_id)))
+
+
+class TestTrain:
+    def test_dataflow_provenance(self):
+        engine = make_engine()
+        result = engine.train(make_params(ds_id=7, p_id=8, algo_ids=(9, 10)))
+        assert len(result.models) == 2
+        for model, expected in zip(result.models, (9, 10)):
+            assert model.id == expected
+            assert model.pd.id == 8          # preparator id
+            assert model.pd.td.id == 7       # data source id
+
+    def test_sanity_check_fires(self):
+        engine = make_engine()
+        with pytest.raises(ValueError, match="sanity"):
+            engine.train(make_params(error=True))
+        # skipping sanity check suppresses the error
+        result = engine.train(make_params(error=True),
+                              WorkflowParams(skip_sanity_check=True))
+        assert result.models[0].pd.td.error
+
+    def test_stop_gates(self):
+        engine = make_engine()
+        with pytest.raises(StopAfterReadInterruption):
+            engine.train(make_params(), WorkflowParams(stop_after_read=True))
+        with pytest.raises(StopAfterPrepareInterruption):
+            engine.train(make_params(),
+                         WorkflowParams(stop_after_prepare=True))
+
+    def test_unknown_component_name(self):
+        engine = make_engine()
+        with pytest.raises(KeyError):
+            engine.train(make_params(algo_name="nope"))
+
+
+class TestEval:
+    def test_eval_joins_queries_predictions_actuals(self):
+        engine = make_engine()
+        ep = make_params(ds_id=1, algo_ids=(5,), n_eval_sets=2)
+        results = engine.eval(ep)
+        assert len(results) == 2
+        for eval_info, qpa in results:
+            assert eval_info.id == 1
+            assert len(qpa) == 3
+            for q, p, a in qpa:
+                assert q.id == a.id
+                assert p.id == 5                    # algo id
+                assert p.q.supplemented             # went through supplement
+                assert p.q.id == q.id
+
+    def test_multi_algo_serving_gets_all(self):
+        served = []
+
+        class RecordingServing(Serving0):
+            def serve(self, query, predictions):
+                served.append(len(predictions))
+                return predictions[0]
+
+        engine = Engine({"": DataSource0}, {"": Preparator0},
+                        {"algo": Algo0}, {"": RecordingServing})
+        engine.eval(make_params(algo_ids=(1, 2, 3), n_eval_sets=1))
+        assert served == [3, 3, 3]
+
+    def test_batch_eval(self):
+        engine = make_engine()
+        eps = [make_params(algo_ids=(i,), n_eval_sets=1) for i in (1, 2)]
+        out = engine.batch_eval(eps)
+        assert len(out) == 2
+        assert out[0][0] is eps[0]
+
+
+class TestPersistence:
+    def test_plain_model_roundtrip(self):
+        engine = make_engine()
+        ep = make_params()
+        tr = engine.train(ep)
+        ser = engine.make_serializable_models(tr, "inst1", ep)
+        blob = engine.serialize_models(ser)
+        restored = engine.deserialize_models(blob)
+        deploy = engine.prepare_deploy(ep, restored, "inst1")
+        assert deploy.models[0] == tr.models[0]
+        # and predict works on restored model
+        p = deploy.algorithms[0].predict(deploy.models[0], Query(1))
+        assert p.id == 3
+
+    def test_mesh_model_defaults_to_retrain(self):
+        engine = Engine({"": DataSource0}, {"": Preparator0},
+                        {"algo": PAlgo0}, {"": Serving0})
+        ep = make_params()
+        tr = engine.train(ep)
+        ser = engine.make_serializable_models(tr, "inst2", ep)
+        assert ser[0] is RETRAIN
+        blob = engine.serialize_models(ser)
+        deploy = engine.prepare_deploy(ep, engine.deserialize_models(blob),
+                                       "inst2")
+        assert isinstance(deploy.models[0], AModel)  # retrained fresh
+
+    def test_persistent_model_manifest_path(self):
+        engine = Engine({"": DataSource0}, {"": Preparator0},
+                        {"algo": PersistentAlgo0}, {"": Serving0})
+        ep = make_params()
+        tr = engine.train(ep)
+        ser = engine.make_serializable_models(tr, "inst3", ep)
+        assert isinstance(ser[0], PersistentModelManifest)
+        blob = engine.serialize_models(ser)
+        deploy = engine.prepare_deploy(ep, engine.deserialize_models(blob),
+                                       "inst3")
+        assert isinstance(deploy.models[0], PersistentModel0)
+
+    def test_mixed_algorithms(self):
+        engine = Engine({"": DataSource0}, {"": Preparator0},
+                        {"plain": Algo0, "mesh": PAlgo0}, {"": Serving0})
+        ep = EngineParams(
+            data_source_params=("", DSParams(id=1)),
+            preparator_params=("", PParams(id=2)),
+            algorithm_params_list=[("plain", AParams(id=3)),
+                                   ("mesh", AParams(id=4))],
+            serving_params=("", SParams()))
+        tr = engine.train(ep)
+        ser = engine.make_serializable_models(tr, "inst4", ep)
+        assert isinstance(ser[0], AModel) and ser[1] is RETRAIN
+        deploy = engine.prepare_deploy(
+            ep, engine.deserialize_models(engine.serialize_models(ser)),
+            "inst4")
+        assert deploy.models[0].id == 3
+        assert deploy.models[1].id == 4
+
+
+class TestEngineJson:
+    def test_json_to_engine_params(self):
+        engine = make_engine()
+        variant = {
+            "datasource": {"params": {"id": 11}},
+            "preparator": {"params": {"id": 12}},
+            "algorithms": [{"name": "algo", "params": {"id": 13}}],
+            "serving": {"params": {"id": 14}},
+        }
+        ep = engine.json_to_engine_params(variant)
+        assert ep.data_source_params[1].id == 11
+        assert ep.preparator_params[1].id == 12
+        assert ep.algorithm_params_list[0][1].id == 13
+        assert ep.serving_params[1].id == 14
+        # round-trip
+        back = engine.engine_params_to_json(ep)
+        assert back["algorithms"][0]["params"]["id"] == 13
+
+    def test_unknown_param_rejected(self):
+        engine = make_engine()
+        with pytest.raises(ValueError, match="Unknown parameter"):
+            engine.json_to_engine_params(
+                {"datasource": {"params": {"nope": 1}},
+                 "algorithms": [{"name": "algo"}]})
+
+    def test_defaults_when_blocks_missing(self):
+        engine = make_engine()
+        ep = engine.json_to_engine_params(
+            {"algorithms": [{"name": "algo"}]})
+        assert ep.data_source_params[1] == DSParams()
+
+
+class TestSimpleEngine:
+    def test_simple_engine(self):
+        engine = SimpleEngine(DataSource0, Algo0)
+        ep = EngineParams(
+            data_source_params=("", DSParams(id=1)),
+            algorithm_params_list=[("", AParams(id=2))])
+        tr = engine.train(ep)
+        assert tr.models[0].id == 2
+        assert tr.models[0].pd.id == 1  # identity preparator passes td through

@@ -1,0 +1,146 @@
+"""Metric / MetricEvaluator / FastEvalEngine tests.
+
+Mirrors reference MetricTest, EvaluatorTest and FastEvalEngineTest
+(reference: core/src/test/scala/io/prediction/controller/).
+"""
+
+import json
+import math
+
+import pytest
+
+from predictionio_tpu.core import (AverageMetric, EngineParams,
+                                   FastEvalEngine, MetricEvaluator,
+                                   OptionAverageMetric, StdevMetric,
+                                   SumMetric, ZeroMetric)
+from tests.sample_engine import (Algo0, AParams, DataSource0, DSParams,
+                                 PParams, Preparator0, Serving0, SParams)
+
+
+class QidMetric(AverageMetric):
+    def calculate_one(self, q, p, a):
+        return float(q.id)
+
+
+class PredictionIdMetric(AverageMetric):
+    """Score = the algorithm id stamped on predictions — lets tests make
+    specific params win."""
+
+    def calculate_one(self, q, p, a):
+        return float(p.id)
+
+
+class OptMetric(OptionAverageMetric):
+    def calculate_one(self, q, p, a):
+        return float(q.id) if q.id > 0 else None
+
+
+def eval_data(vals):
+    """Build a fake evalDataSet from (q,p,a) ids."""
+    from tests.sample_engine import Actual, EvalInfo, Prediction, Query
+    qpa = [(Query(v), Prediction(v, Query(v)), Actual(v)) for v in vals]
+    return [(EvalInfo(0), qpa)]
+
+
+class TestMetrics:
+    def test_average(self):
+        assert QidMetric().calculate(eval_data([1, 2, 3])) == 2.0
+
+    def test_average_empty_is_nan(self):
+        assert math.isnan(QidMetric().calculate(eval_data([])))
+
+    def test_option_average_skips_none(self):
+        assert OptMetric().calculate(eval_data([0, 2, 4])) == 3.0
+
+    def test_stdev(self):
+        class M(StdevMetric):
+            def calculate_one(self, q, p, a):
+                return float(q.id)
+        assert M().calculate(eval_data([2, 2, 2])) == 0.0
+        assert M().calculate(eval_data([1, 3])) == 1.0
+
+    def test_sum_and_zero(self):
+        class S(SumMetric):
+            def calculate_one(self, q, p, a):
+                return float(q.id)
+        assert S().calculate(eval_data([1, 2, 3])) == 6.0
+        assert ZeroMetric().calculate(eval_data([1])) == 0.0
+
+    def test_compare_nan_loses(self):
+        m = QidMetric()
+        assert m.compare(float("nan"), 1.0) < 0
+        assert m.compare(1.0, float("nan")) > 0
+        assert m.compare(2.0, 1.0) > 0
+        assert m.compare(1.0, 1.0) == 0
+
+
+def make_params(algo_id):
+    return EngineParams(
+        data_source_params=("", DSParams(id=1, n_eval_sets=2)),
+        preparator_params=("", PParams(id=2)),
+        algorithm_params_list=[("algo", AParams(id=algo_id))],
+        serving_params=("", SParams()))
+
+
+class TestMetricEvaluator:
+    def test_picks_best(self, tmp_path):
+        from predictionio_tpu.core import Engine
+        engine = Engine({"": DataSource0}, {"": Preparator0},
+                        {"algo": Algo0}, {"": Serving0})
+        evaluator = MetricEvaluator(PredictionIdMetric(),
+                                    output_path=str(tmp_path))
+        result = evaluator.evaluate_base(
+            engine, [make_params(1), make_params(5), make_params(3)])
+        assert result.best_idx == 1
+        assert result.best_score.score == 5.0
+        assert result.best_engine_params.algorithm_params_list[0][1].id == 5
+        assert "best" in result.one_liner()
+        parsed = json.loads(result.to_json(engine))
+        assert parsed["bestScore"] == 5.0
+        best = json.loads((tmp_path / "best.json").read_text())
+        assert best["algorithms"][0]["params"]["id"] == 5
+        assert "<html>" in result.to_html()
+
+
+class TestFastEvalEngine:
+    def engine(self):
+        return FastEvalEngine({"": DataSource0}, {"": Preparator0},
+                              {"algo": Algo0}, {"": Serving0})
+
+    def test_stage_cache_hit_counts(self):
+        engine = self.engine()
+        # 3 params sharing data source + preparator, differing algo
+        eps = [make_params(i) for i in (1, 2, 3)]
+        out = engine.batch_eval(eps)
+        assert len(out) == 3
+        assert engine.counters["dataSource"] == 1
+        assert engine.counters["preparator"] == 1
+        assert engine.counters["algorithms"] == 3
+        assert engine.counters["serving"] == 3
+
+    def test_datasource_change_invalidates_prefix(self):
+        engine = self.engine()
+        a = make_params(1)
+        b = EngineParams(
+            data_source_params=("", DSParams(id=99, n_eval_sets=2)),
+            preparator_params=a.preparator_params,
+            algorithm_params_list=a.algorithm_params_list,
+            serving_params=a.serving_params)
+        engine.batch_eval([a, b, a])  # a's stages cached, b misses
+        assert engine.counters["dataSource"] == 2
+        assert engine.counters["preparator"] == 2
+        assert engine.counters["algorithms"] == 2
+
+    def test_results_match_plain_engine(self):
+        from predictionio_tpu.core import Engine
+        plain = Engine({"": DataSource0}, {"": Preparator0},
+                       {"algo": Algo0}, {"": Serving0})
+        fast = self.engine()
+        ep = make_params(7)
+        plain_out = plain.eval(ep)
+        fast_out = fast.eval(ep)
+        assert len(plain_out) == len(fast_out)
+        for (ei1, qpa1), (ei2, qpa2) in zip(plain_out, fast_out):
+            assert ei1 == ei2
+            assert [(q.id, p.id, a.id) for q, p, a in qpa1] == \
+                [(q.id, p.id, a.id) for q, p, a in qpa2]
