@@ -1,0 +1,9 @@
+"""TPU compute kernels (JAX/XLA/Pallas) — the MLlib replacement.
+
+Each module is a pure-function kernel family taking host or device arrays:
+  als         — explicit/implicit alternating least squares (the MLlib
+                ALS.train / ALS.trainImplicit replacement)
+  naive_bayes — categorical naive bayes (MLlib NaiveBayes replacement)
+  similarity  — normalized-embedding cosine scoring + filtered top-k
+  ratings     — host-side preprocessing: COO ratings -> bucketed solve plans
+"""

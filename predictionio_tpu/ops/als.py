@@ -1,0 +1,239 @@
+"""Alternating least squares on the TPU mesh — the MLlib ALS replacement.
+
+Replaces `org.apache.spark.mllib.recommendation.ALS.train/trainImplicit` as
+called by the reference templates (reference:
+examples/scala-parallel-recommendation/custom-prepartor/src/main/scala/
+ALSAlgorithm.scala:55 explicit; examples/scala-parallel-similarproduct/multi/
+src/main/scala/ALSAlgorithm.scala:130 implicit).
+
+Design (ALX-style, PAPERS.md "ALX: Large Scale Matrix Factorization on
+TPUs"): instead of MLlib's factor-block shuffles, both factor tables live in
+HBM; each half-iteration sweeps bucketed [B, K] batches of entities
+(ops/ratings.build_solve_plan), gathering counterpart factors, forming the
+normal equations with batched einsums on the MXU, and solving by batched
+Cholesky. The batch dim B is sharded over the mesh `data` axis; factor
+tables are replicated (or sharded over `model` for tables larger than one
+device's HBM — GSPMD inserts the all-gathers).
+
+Math parity with MLlib 1.3:
+  explicit  — ALS-WR: minimize sum (r - x.v)^2 + lambda * (n_u |x|^2 + ...)
+              i.e. per-entity regularizer lambda * n ratings (`lambda_scaling
+              ='nratings'`, MLlib's default behavior in 1.3).
+  implicit  — Hu-Koren confidence c = 1 + alpha * r, preference p = 1(r>0),
+              solve (G + V_u^T (C_u - I) V_u + lambda*n*I) x = V_u^T C_u p
+              with G = V^T V computed once per half-sweep.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.ops.ratings import (RatingsCOO, SolvePlan,
+                                          plan_for_items, plan_for_users)
+from predictionio_tpu.parallel.mesh import MeshContext, current_mesh
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class ALSConfig:
+    rank: int = 10
+    iterations: int = 10
+    lam: float = 0.01                  # MLlib's lambda_
+    implicit_prefs: bool = False
+    alpha: float = 1.0                 # implicit confidence scale
+    lambda_scaling: str = "nratings"   # 'nratings' (ALS-WR) | 'constant'
+    seed: int = 0
+    work_budget: int = 1 << 20         # B*K per solve batch
+    compute_dtype: str = "float32"     # einsum dtype ('bfloat16' on TPU ok)
+
+
+@dataclass
+class ALSModel:
+    """Trained factorization. Arrays are host numpy after training; serving
+    re-uploads them with the sharding the query path wants."""
+    user_factors: np.ndarray   # [n_users, rank] float32
+    item_factors: np.ndarray   # [n_items, rank] float32
+    rank: int
+
+    @property
+    def n_users(self) -> int:
+        return self.user_factors.shape[0]
+
+    @property
+    def n_items(self) -> int:
+        return self.item_factors.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype"),
+    donate_argnums=(0,))
+def _solve_scatter(factors_out, counter_factors, gram, rows, idx, val, mask,
+                   lam, alpha, *, nratings_reg: bool, implicit: bool,
+                   rank: int, compute_dtype: str):
+    """Solve one [B, K] batch of normal equations and scatter results into
+    factors_out (donated). All device work for a batch lives in this one jit
+    so XLA fuses gather -> einsum -> cholesky -> scatter."""
+    import jax
+    import jax.numpy as jnp
+
+    cd = jnp.dtype(compute_dtype)
+    Vg = counter_factors[idx]                       # [B, K, R] gather
+    Vc = Vg.astype(cd)
+    if implicit:
+        conf_minus_1 = (alpha * val) * mask          # c - 1, zero on padding
+        A = gram + jnp.einsum("bk,bkr,bks->brs", conf_minus_1.astype(cd),
+                              Vc, Vc,
+                              preferred_element_type=jnp.float32)
+        b = jnp.einsum("bk,bkr->br",
+                       ((1.0 + alpha * val) * mask).astype(cd), Vc,
+                       preferred_element_type=jnp.float32)
+    else:
+        A = jnp.einsum("bk,bkr,bks->brs", mask.astype(cd), Vc, Vc,
+                       preferred_element_type=jnp.float32)
+        b = jnp.einsum("bk,bkr->br", (val * mask).astype(cd), Vc,
+                       preferred_element_type=jnp.float32)
+    n = mask.sum(axis=-1)                            # ratings per entity
+    reg = lam * jnp.maximum(n, 1.0) if nratings_reg else jnp.full_like(n, lam)
+    eye = jnp.eye(rank, dtype=jnp.float32)
+    A = A + reg[:, None, None] * eye
+    chol = jax.lax.linalg.cholesky(A)
+    x = jax.lax.linalg.triangular_solve(
+        chol, b[..., None], left_side=True, lower=True)
+    x = jax.lax.linalg.triangular_solve(
+        chol, x, left_side=True, lower=True, transpose_a=True)[..., 0]
+    # padding rows (rows == -1) scatter to a dummy tail row
+    safe_rows = jnp.where(rows < 0, factors_out.shape[0] - 1, rows)
+    return factors_out.at[safe_rows].set(x.astype(factors_out.dtype),
+                                         mode="drop")
+
+
+@functools.partial(__import__("jax").jit)
+def _gram(factors):
+    import jax.numpy as jnp
+    return jnp.einsum("ir,is->rs", factors, factors,
+                      preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Training driver
+# ---------------------------------------------------------------------------
+
+def _init_factors(n: int, rank: int, seed: int, salt: int) -> np.ndarray:
+    # MLlib seeds factors with abs(normal)/sqrt(rank) per block; we use a
+    # deterministic numpy RNG — scale keeps initial predictions O(1)
+    rng = np.random.default_rng(seed * 2654435761 % (2 ** 31) + salt)
+    f = rng.standard_normal((n + 1, rank), dtype=np.float32)
+    return np.abs(f) / np.sqrt(rank)
+
+
+def _run_side(mesh: MeshContext, plan: SolvePlan, factors, counter_factors,
+              cfg: ALSConfig, gram):
+    """One half-iteration: solve every batch of one side on the mesh."""
+    for batch in plan.batches:
+        rows = mesh.put_batch(batch.rows)
+        idx = mesh.put_batch(batch.idx)
+        val = mesh.put_batch(batch.val)
+        mask = mesh.put_batch(batch.mask)
+        factors = _solve_scatter(
+            factors, counter_factors, gram, rows, idx, val, mask,
+            np.float32(cfg.lam), np.float32(cfg.alpha),
+            nratings_reg=(cfg.lambda_scaling == "nratings"),
+            implicit=cfg.implicit_prefs, rank=cfg.rank,
+            compute_dtype=cfg.compute_dtype)
+    return factors
+
+
+def als_train(ratings: RatingsCOO, cfg: ALSConfig,
+              mesh: Optional[MeshContext] = None) -> ALSModel:
+    """Train explicit/implicit ALS. Factor tables carry one extra dummy row
+    (index n) used as the scatter target for padding; it is dropped in the
+    returned model."""
+    import jax
+    mesh = mesh or current_mesh()
+    dp = mesh.data_parallelism
+    user_plan = plan_for_users(ratings, work_budget=cfg.work_budget,
+                               batch_multiple=dp)
+    item_plan = plan_for_items(ratings, work_budget=cfg.work_budget,
+                               batch_multiple=dp)
+    logger.info(
+        "ALS: %d users, %d items, %d ratings; %d user batches %s, "
+        "%d item batches %s", ratings.n_users, ratings.n_items, ratings.nnz,
+        len(user_plan.batches), user_plan.kernel_shapes,
+        len(item_plan.batches), item_plan.kernel_shapes)
+
+    U = mesh.put_replicated(_init_factors(ratings.n_users, cfg.rank,
+                                          cfg.seed, 1))
+    V = mesh.put_replicated(_init_factors(ratings.n_items, cfg.rank,
+                                          cfg.seed, 2))
+    for it in range(cfg.iterations):
+        gram_v = _gram(V[:-1]) if cfg.implicit_prefs else None
+        U = _run_side(mesh, user_plan, U, V, cfg, gram_v)
+        gram_u = _gram(U[:-1]) if cfg.implicit_prefs else None
+        V = _run_side(mesh, item_plan, V, U, cfg, gram_u)
+    U_host = np.asarray(U)[:-1]
+    V_host = np.asarray(V)[:-1]
+    return ALSModel(user_factors=U_host, item_factors=V_host, rank=cfg.rank)
+
+
+# ---------------------------------------------------------------------------
+# Scoring / prediction
+# ---------------------------------------------------------------------------
+
+@functools.partial(__import__("jax").jit, static_argnames=("k",))
+def _topk_scores(user_vecs, item_factors, seen_mask, k: int):
+    """scores = u . V^T with seen items masked out; returns (scores, idx)."""
+    import jax.numpy as jnp
+    scores = jnp.einsum("br,ir->bi", user_vecs, item_factors,
+                        preferred_element_type=jnp.float32)
+    scores = jnp.where(seen_mask, -jnp.inf, scores)
+    import jax
+    return jax.lax.top_k(scores, k)
+
+
+def recommend_products(model: ALSModel, user_ix: int, k: int,
+                       exclude: Optional[np.ndarray] = None
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-k items for one user (MatrixFactorizationModel.recommendProducts
+    analog). Returns (scores, item_indices)."""
+    u = model.user_factors[user_ix][None, :]
+    seen = np.zeros((1, model.n_items), dtype=bool)
+    if exclude is not None and len(exclude):
+        seen[0, np.asarray(exclude, dtype=np.int64)] = True
+    k_eff = min(k, model.n_items)
+    scores, idx = _topk_scores(u, model.item_factors, seen, k_eff)
+    return np.asarray(scores)[0], np.asarray(idx)[0]
+
+
+def predict_ratings(model: ALSModel, user_ix: np.ndarray,
+                    item_ix: np.ndarray, chunk: int = 1 << 20) -> np.ndarray:
+    """Pointwise r_hat = u . v for parallel (user, item) index arrays."""
+    import jax.numpy as jnp
+    import jax
+
+    @jax.jit
+    def _dot(U, V, ui, ii):
+        return jnp.sum(U[ui] * V[ii], axis=-1)
+
+    out = np.empty(len(user_ix), dtype=np.float32)
+    for lo in range(0, len(user_ix), chunk):
+        sl = slice(lo, lo + chunk)
+        out[sl] = np.asarray(_dot(model.user_factors, model.item_factors,
+                                  np.asarray(user_ix[sl]),
+                                  np.asarray(item_ix[sl])))
+    return out
+
+
+def als_rmse(model: ALSModel, ratings: RatingsCOO) -> float:
+    pred = predict_ratings(model, ratings.user_idx, ratings.item_idx)
+    return float(np.sqrt(np.mean((pred - ratings.rating) ** 2)))

@@ -1,0 +1,177 @@
+"""Host-side ratings preprocessing: COO -> bucketed, padded solve plans.
+
+This is the ragged->fixed-shape edge (SURVEY.md hard part #3): events per
+user/item are power-law ragged, XLA wants static shapes. Entities are
+bucketed by rating count into power-of-two segment lengths K; each bucket is
+processed as [B, K] padded batches with B chosen to keep B*K work roughly
+constant, so the whole sweep compiles to ~log2(max_count) kernel shapes.
+
+Replaces the grouping/shuffle phase of MLlib's block ALS (reference consumer:
+examples/scala-parallel-recommendation/custom-prepartor/src/main/scala/
+ALSAlgorithm.scala:55 `ALS.train`), and the `((u,i),1).reduceByKey` rating
+construction of the similarproduct template
+(examples/scala-parallel-similarproduct/multi/src/main/scala/ALSAlgorithm.scala:96-133).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RatingsCOO:
+    """Deduplicated (user, item, rating) triples with dense int32 indices."""
+    user_idx: np.ndarray   # [nnz] int32
+    item_idx: np.ndarray   # [nnz] int32
+    rating: np.ndarray     # [nnz] float32
+    n_users: int
+    n_items: int
+
+    @property
+    def nnz(self) -> int:
+        return int(self.user_idx.shape[0])
+
+    def transpose(self) -> "RatingsCOO":
+        return RatingsCOO(self.item_idx, self.user_idx, self.rating,
+                          self.n_items, self.n_users)
+
+
+def dedup_ratings(user_idx, item_idx, rating, timestamps=None,
+                  policy: str = "latest") -> Tuple[np.ndarray, np.ndarray,
+                                                   np.ndarray]:
+    """Collapse duplicate (user, item) pairs.
+
+    policy:
+      'latest' — keep the rating with the greatest timestamp (the reference
+                 recommendation DataSource semantics for re-rated items);
+                 requires `timestamps` (falls back to last occurrence).
+      'sum'    — sum ratings (the similarproduct view-count semantics,
+                 `((u,i),1).reduceByKey(_+_)`).
+      'mean'   — average duplicates.
+    """
+    user_idx = np.asarray(user_idx, dtype=np.int64)
+    item_idx = np.asarray(item_idx, dtype=np.int64)
+    rating = np.asarray(rating, dtype=np.float32)
+    if user_idx.size == 0:
+        return (user_idx.astype(np.int32), item_idx.astype(np.int32), rating)
+    n_items = int(item_idx.max()) + 1
+    pair = user_idx * n_items + item_idx
+    if policy == "latest":
+        order = (np.argsort(timestamps, kind="stable")
+                 if timestamps is not None else np.arange(pair.size))
+        pair_o = pair[order]
+        # keep the last occurrence in time order
+        uniq, last_pos = np.unique(pair_o[::-1], return_index=True)
+        keep = order[::-1][last_pos]
+        keep.sort()
+        return (user_idx[keep].astype(np.int32),
+                item_idx[keep].astype(np.int32), rating[keep])
+    uniq, inv = np.unique(pair, return_inverse=True)
+    sums = np.bincount(inv, weights=rating.astype(np.float64))
+    if policy == "mean":
+        counts = np.bincount(inv)
+        sums = sums / counts
+    elif policy != "sum":
+        raise ValueError(f"unknown dedup policy {policy!r}")
+    return ((uniq // n_items).astype(np.int32),
+            (uniq % n_items).astype(np.int32),
+            sums.astype(np.float32))
+
+
+@dataclass(frozen=True)
+class SolveBatch:
+    """One fixed-shape batch of entities to solve: gather `idx` rows of the
+    counterpart factor table, weight by `val`, mask padding."""
+    rows: np.ndarray    # [B] int32 — dense indices being solved; padding = -1
+    idx: np.ndarray     # [B, K] int32 — counterpart indices; padding = 0
+    val: np.ndarray     # [B, K] float32 — ratings; padding = 0
+    mask: np.ndarray    # [B, K] float32 — 1 for real entries
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.idx.shape
+
+
+@dataclass(frozen=True)
+class SolvePlan:
+    """All batches needed to solve one side of the factorization."""
+    batches: Sequence[SolveBatch]
+    n_entities: int
+    nnz: int
+
+    @property
+    def kernel_shapes(self):
+        return sorted({b.shape for b in self.batches})
+
+
+def _next_pow2(x: int, floor: int) -> int:
+    return max(floor, 1 << int(np.ceil(np.log2(max(x, 1)))))
+
+
+def build_solve_plan(group_idx: np.ndarray, counter_idx: np.ndarray,
+                     values: np.ndarray, n_groups: int,
+                     work_budget: int = 1 << 20, min_k: int = 8,
+                     batch_multiple: int = 1) -> SolvePlan:
+    """Group COO entries by `group_idx`, bucket groups by padded segment
+    length K (power of two), and emit [B, K] batches with B ~= work_budget/K
+    rounded up to `batch_multiple` (the mesh data-parallel degree).
+
+    Vectorized host numpy — no per-entity Python loops.
+    """
+    group_idx = np.asarray(group_idx, dtype=np.int64)
+    counter_idx = np.asarray(counter_idx, dtype=np.int32)
+    values = np.asarray(values, dtype=np.float32)
+    nnz = group_idx.size
+
+    order = np.argsort(group_idx, kind="stable")
+    g_sorted = group_idx[order]
+    c_sorted = counter_idx[order]
+    v_sorted = values[order]
+    counts = np.bincount(g_sorted, minlength=n_groups).astype(np.int64)
+    starts = np.zeros(n_groups + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+
+    present = np.nonzero(counts)[0]
+    if present.size == 0:
+        return SolvePlan(batches=(), n_entities=n_groups, nnz=0)
+    ks = np.maximum(min_k, 2 ** np.ceil(
+        np.log2(np.maximum(counts[present], 1))).astype(np.int64))
+
+    batches: List[SolveBatch] = []
+    for k in np.unique(ks):
+        members = present[ks == k]  # entities padded to this K
+        b_full = max(int(work_budget // k), 1)
+        b_full = ((b_full + batch_multiple - 1) // batch_multiple
+                  ) * batch_multiple
+        for lo in range(0, members.size, b_full):
+            chunk = members[lo:lo + b_full]
+            b = ((chunk.size + batch_multiple - 1) // batch_multiple
+                 ) * batch_multiple
+            rows = np.full(b, -1, dtype=np.int32)
+            rows[:chunk.size] = chunk
+            idx = np.zeros((b, int(k)), dtype=np.int32)
+            val = np.zeros((b, int(k)), dtype=np.float32)
+            mask = np.zeros((b, int(k)), dtype=np.float32)
+            # vectorized fill: flat positions row*k + [0..count)
+            cnts = counts[chunk]
+            row_of = np.repeat(np.arange(chunk.size), cnts)
+            # position within each segment
+            pos = np.arange(row_of.size) - np.repeat(
+                np.concatenate([[0], np.cumsum(cnts)[:-1]]), cnts)
+            src = np.repeat(starts[chunk], cnts) + pos
+            idx[row_of, pos] = c_sorted[src]
+            val[row_of, pos] = v_sorted[src]
+            mask[row_of, pos] = 1.0
+            batches.append(SolveBatch(rows, idx, val, mask))
+    return SolvePlan(batches=tuple(batches), n_entities=n_groups, nnz=nnz)
+
+
+def plan_for_users(r: RatingsCOO, **kw) -> SolvePlan:
+    return build_solve_plan(r.user_idx, r.item_idx, r.rating, r.n_users, **kw)
+
+
+def plan_for_items(r: RatingsCOO, **kw) -> SolvePlan:
+    return build_solve_plan(r.item_idx, r.user_idx, r.rating, r.n_items, **kw)

@@ -1,0 +1,14 @@
+"""Distributed substrate: device mesh, shardings, host ingest.
+
+This package replaces the reference's Spark runtime entry points
+(reference: core/src/main/scala/io/prediction/workflow/WorkflowContext.scala:25-45
+SparkContext creation; tools/Runner.scala:153-193 spark-submit): a
+`jax.sharding.Mesh` over TPU devices is the cluster, GSPMD/XLA collectives
+over ICI/DCN are the shuffle, and host-parallel event reads feeding
+`jax.make_array_from_process_local_data` are the ingest edge.
+"""
+
+from predictionio_tpu.parallel.mesh import (MeshContext, current_mesh,
+                                            make_mesh, use_mesh)
+
+__all__ = ["MeshContext", "make_mesh", "current_mesh", "use_mesh"]

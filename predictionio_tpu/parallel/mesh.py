@@ -1,0 +1,158 @@
+"""Device mesh management and sharding helpers.
+
+The SparkContext analog (reference: workflow/WorkflowContext.scala:25-45).
+A `MeshContext` owns a `jax.sharding.Mesh` with two named axes:
+
+  - ``data``  — batch-dimension parallelism (rows of users/items/events);
+                the analog of Spark's RDD partitioning.
+  - ``model`` — parameter sharding (embedding-table rows, hidden dims);
+                no Spark analog (MLlib block ALS plays this role).
+
+Kernels request shardings by logical spec; XLA/GSPMD inserts the ICI/DCN
+collectives. Multi-host initialization goes through `jax.distributed` —
+`init_distributed` is the `spark-submit --master` analog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+import os
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_local = threading.local()
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (jax.distributed.initialize). No-op when
+    single-process. Driven by PIO_COORDINATOR/PIO_NUM_PROCESSES/PIO_PROCESS_ID
+    or explicit args — the env-passthrough analog of Runner.scala:105-108."""
+    jax = _jax()
+    coordinator = coordinator or os.environ.get("PIO_COORDINATOR")
+    if coordinator is None:
+        return
+    num_processes = num_processes or int(os.environ["PIO_NUM_PROCESSES"])
+    process_id = process_id or int(os.environ["PIO_PROCESS_ID"])
+    jax.distributed.initialize(coordinator, num_processes, process_id)
+    logger.info("jax.distributed initialized: process %d/%d via %s",
+                process_id, num_processes, coordinator)
+
+
+class MeshContext:
+    """A named-axis device mesh plus sharding constructors."""
+
+    DATA_AXIS = "data"
+    MODEL_AXIS = "model"
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def create(devices=None, model_parallelism: int = 1) -> "MeshContext":
+        jax = _jax()
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        if n % model_parallelism != 0:
+            raise ValueError(
+                f"model_parallelism {model_parallelism} does not divide "
+                f"device count {n}")
+        dp = n // model_parallelism
+        arr = np.array(devices).reshape(dp, model_parallelism)
+        mesh = jax.sharding.Mesh(
+            arr, (MeshContext.DATA_AXIS, MeshContext.MODEL_AXIS))
+        return MeshContext(mesh)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def n_devices(self) -> int:
+        return int(math.prod(self.mesh.devices.shape))
+
+    @property
+    def data_parallelism(self) -> int:
+        return self.mesh.shape[self.DATA_AXIS]
+
+    @property
+    def model_parallelism(self) -> int:
+        return self.mesh.shape[self.MODEL_AXIS]
+
+    # -- sharding constructors ---------------------------------------------
+    def sharding(self, *axis_per_dim) -> "object":
+        """NamedSharding with the given mesh axis (or None) per array dim."""
+        jax = _jax()
+        return jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(*axis_per_dim))
+
+    def replicated(self):
+        jax = _jax()
+        return jax.sharding.NamedSharding(self.mesh,
+                                          jax.sharding.PartitionSpec())
+
+    def batch_sharded(self, ndim: int = 1):
+        """First dim sharded over the data axis, rest replicated."""
+        return self.sharding(self.DATA_AXIS, *([None] * (ndim - 1)))
+
+    def model_sharded(self, ndim: int = 1):
+        """First dim sharded over the model axis (embedding-table rows)."""
+        return self.sharding(self.MODEL_AXIS, *([None] * (ndim - 1)))
+
+    # -- data movement ------------------------------------------------------
+    def put_batch(self, x):
+        """Host array -> device array sharded on dim 0 over the data axis.
+        dim 0 must be divisible by data_parallelism (use pad_to_multiple)."""
+        jax = _jax()
+        return jax.device_put(x, self.batch_sharded(np.ndim(x)))
+
+    def put_replicated(self, x):
+        jax = _jax()
+        return jax.device_put(x, self.replicated())
+
+    def pad_to_multiple(self, x: np.ndarray, axis: int = 0,
+                        multiple: Optional[int] = None,
+                        fill=0) -> Tuple[np.ndarray, int]:
+        """Pad so dim `axis` divides the data-axis size; returns (padded,
+        original_len). The ragged->fixed-shape edge (SURVEY hard part #3)."""
+        multiple = multiple or self.data_parallelism
+        n = x.shape[axis]
+        target = ((n + multiple - 1) // multiple) * multiple
+        if target == n:
+            return x, n
+        pad_width = [(0, 0)] * x.ndim
+        pad_width[axis] = (0, target - n)
+        return np.pad(x, pad_width, constant_values=fill), n
+
+
+def make_mesh(devices=None, model_parallelism: int = 1) -> MeshContext:
+    return MeshContext.create(devices, model_parallelism)
+
+
+def current_mesh() -> MeshContext:
+    """The active mesh; lazily creates a full-device 1x data mesh."""
+    ctx = getattr(_local, "mesh", None)
+    if ctx is None:
+        ctx = make_mesh()
+        _local.mesh = ctx
+    return ctx
+
+
+@contextlib.contextmanager
+def use_mesh(ctx: MeshContext):
+    prev = getattr(_local, "mesh", None)
+    _local.mesh = ctx
+    try:
+        yield ctx
+    finally:
+        _local.mesh = prev
