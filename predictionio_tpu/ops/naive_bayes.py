@@ -1,0 +1,189 @@
+"""Naive Bayes kernels — multinomial (MLlib parity) and categorical (e2).
+
+Replaces `org.apache.spark.mllib.classification.NaiveBayes.train` as used by
+the classification template (reference:
+examples/scala-parallel-classification/add-algorithm/src/main/scala/
+NaiveBayesAlgorithm.scala:19-25) and the string-categorical
+`CategoricalNaiveBayes` engine (reference:
+e2/src/main/scala/io/prediction/e2/engine/CategoricalNaiveBayes.scala).
+
+The Spark `combineByKey` count-aggregation becomes one-hot matmuls /
+segment-sums on device; across a mesh the per-shard count matrices reduce
+with a single psum (SURVEY.md section 7 step 4 — "NaiveBayes: one psum of
+count matrices").
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.parallel.mesh import MeshContext, current_mesh
+
+
+# ---------------------------------------------------------------------------
+# Multinomial NB (MLlib NaiveBayes.train parity)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MultinomialNBModel:
+    pi: np.ndarray      # [C] log prior
+    theta: np.ndarray   # [C, D] log likelihood
+    labels: np.ndarray  # [C] original label values (float, like MLlib)
+
+    def predict(self, x: np.ndarray) -> float:
+        scores = self.pi + self.theta @ np.asarray(x, dtype=np.float64)
+        return float(self.labels[int(np.argmax(scores))])
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("n_classes",))
+def _nb_counts(features, label_ix, n_classes: int):
+    """Per-class doc counts and feature sums via one-hot matmul (MXU-friendly;
+    under a sharded batch dim GSPMD turns the sums into a psum)."""
+    import jax.numpy as jnp
+    onehot = jnp.equal(label_ix[:, None],
+                       jnp.arange(n_classes)[None, :]).astype(jnp.float32)
+    class_counts = onehot.sum(axis=0)                      # [C]
+    feature_sums = jnp.einsum("nc,nd->cd", onehot, features,
+                              preferred_element_type=jnp.float32)
+    return class_counts, feature_sums
+
+
+def multinomial_nb_train(features: np.ndarray, labels: np.ndarray,
+                         lam: float = 1.0,
+                         mesh: Optional[MeshContext] = None
+                         ) -> MultinomialNBModel:
+    """MLlib multinomial NaiveBayes:
+      pi_c    = log((N_c + lam) / (N + C*lam))
+      theta_cd = log((sum_d + lam) / (sum_all_d + D*lam))
+    """
+    mesh = mesh or current_mesh()
+    features = np.asarray(features, dtype=np.float32)
+    labels = np.asarray(labels)
+    classes, label_ix = np.unique(labels, return_inverse=True)
+    n_classes, n_features = len(classes), features.shape[1]
+    feats_p, n = mesh.pad_to_multiple(features)
+    # padded rows get label -1 -> one-hot all-zero -> contribute nothing
+    lab_p = np.full(feats_p.shape[0], -1, dtype=np.int32)
+    lab_p[:n] = label_ix
+    class_counts, feature_sums = _nb_counts(
+        mesh.put_batch(feats_p), mesh.put_batch(lab_p), n_classes)
+    class_counts = np.asarray(class_counts, dtype=np.float64)
+    feature_sums = np.asarray(feature_sums, dtype=np.float64)
+    total = class_counts.sum()
+    pi = np.log(class_counts + lam) - math.log(total + n_classes * lam)
+    denom = np.log(feature_sums.sum(axis=1, keepdims=True)
+                   + n_features * lam)
+    theta = np.log(feature_sums + lam) - denom
+    return MultinomialNBModel(pi=pi, theta=theta,
+                              labels=classes.astype(np.float64))
+
+
+# ---------------------------------------------------------------------------
+# Categorical NB (e2 CategoricalNaiveBayes parity)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LabeledPoint:
+    """(e2/engine/LabeledPoint analog) — label + string features."""
+    label: str
+    features: Tuple[str, ...]
+
+
+@dataclass
+class CategoricalNBModel:
+    """priors[label] = log P(label); likelihoods[label][pos][value] =
+    log P(value | label) — exact counting, no smoothing, matching
+    CategoricalNaiveBayes.scala."""
+    priors: Dict[str, float]
+    likelihoods: Dict[str, List[Dict[str, float]]]
+
+    def log_score(self, point: LabeledPoint,
+                  default=None) -> Optional[float]:
+        """(CategoricalNaiveBayes.scala logScore): None when the label is
+        unknown or a feature value is unseen and no default is given;
+        `default` is a fn(featureLikelihoodMap) -> float."""
+        if point.label not in self.priors:
+            return None
+        feat_l = self.likelihoods[point.label]
+        total = self.priors[point.label]
+        for pos, value in enumerate(point.features):
+            m = feat_l[pos]
+            if value in m:
+                total += m[value]
+            elif default is not None:
+                total += default(m)
+            else:
+                return None
+        return total
+
+    def predict(self, features: Sequence[str],
+                default=None) -> Optional[str]:
+        best, best_score = None, -math.inf
+        for label in self.priors:
+            s = self.log_score(LabeledPoint(label, tuple(features)), default)
+            if s is not None and s > best_score:
+                best, best_score = label, s
+        return best
+
+
+def categorical_nb_train(points: Sequence[LabeledPoint],
+                         mesh: Optional[MeshContext] = None
+                         ) -> CategoricalNBModel:
+    """Vocabulary build on host (BiMap-style dense ranks), counting on
+    device: one [N] -> [C, P, V] scatter-count expressed as one-hot einsum."""
+    mesh = mesh or current_mesh()
+    if not points:
+        return CategoricalNBModel({}, {})
+    n_pos = len(points[0].features)
+    labels = sorted({p.label for p in points})
+    label_ix = {l: i for i, l in enumerate(labels)}
+    vocabs: List[Dict[str, int]] = []
+    for pos in range(n_pos):
+        vals = sorted({p.features[pos] for p in points})
+        vocabs.append({v: i for i, v in enumerate(vals)})
+    max_v = max(len(v) for v in vocabs)
+    n, c = len(points), len(labels)
+
+    lab = np.array([label_ix[p.label] for p in points], dtype=np.int32)
+    feat = np.zeros((n, n_pos), dtype=np.int32)
+    for j, p in enumerate(points):
+        for pos in range(n_pos):
+            feat[j, pos] = vocabs[pos][p.features[pos]]
+
+    import jax.numpy as jnp
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("c", "v"))
+    def _counts(lab, feat, c: int, v: int):
+        lab1 = jax.nn.one_hot(lab, c, dtype=jnp.float32)       # [N, C]
+        feat1 = jax.nn.one_hot(feat, v, dtype=jnp.float32)     # [N, P, V]
+        counts = jnp.einsum("nc,npv->cpv", lab1, feat1,
+                            preferred_element_type=jnp.float32)
+        return counts, lab1.sum(axis=0)
+
+    feat_p, real = mesh.pad_to_multiple(feat)
+    lab_p = np.full(feat_p.shape[0], -1, dtype=np.int32)
+    lab_p[:real] = lab
+    counts, label_counts = _counts(mesh.put_batch(lab_p),
+                                   mesh.put_batch(feat_p), c, max_v)
+    counts = np.asarray(counts, dtype=np.float64)
+    label_counts = np.asarray(label_counts, dtype=np.float64)
+
+    priors = {l: math.log(label_counts[i] / n) for l, i in label_ix.items()}
+    likelihoods: Dict[str, List[Dict[str, float]]] = {}
+    for l, i in label_ix.items():
+        per_pos = []
+        for pos in range(n_pos):
+            m = {}
+            for v, vi in vocabs[pos].items():
+                cnt = counts[i, pos, vi]
+                if cnt > 0:
+                    m[v] = math.log(cnt / label_counts[i])
+            per_pos.append(m)
+        likelihoods[l] = per_pos
+    return CategoricalNBModel(priors=priors, likelihoods=likelihoods)

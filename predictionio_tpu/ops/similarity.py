@@ -1,0 +1,78 @@
+"""Cosine-similarity scoring with business-rule filters — on-device top-k.
+
+Replaces the similarproduct template's driver-side cosine scan
+(reference: examples/scala-parallel-similarproduct/multi/src/main/scala/
+ALSAlgorithm.scala:146-190: score = sum over query items of cosine(qf, f),
+keep score > 0, apply category/white/black filters, top N) with one jitted
+masked matmul + `lax.top_k` over the whole item-factor table resident in
+HBM. Filters arrive as a packed boolean mask built on host.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("k",))
+def _cosine_topk(query_vecs, item_norms, allowed, k: int):
+    """query_vecs [Q, R] (raw), item_norms [I, R] (L2-normalized rows),
+    allowed [I] bool. Score = sum_q cos(q, item); items with score <= 0 or
+    not allowed are excluded (score -> -inf)."""
+    import jax
+    import jax.numpy as jnp
+    qn = query_vecs / jnp.maximum(
+        jnp.linalg.norm(query_vecs, axis=-1, keepdims=True), 1e-12)
+    scores = jnp.einsum("qr,ir->i", qn, item_norms,
+                        preferred_element_type=jnp.float32)
+    scores = jnp.where(allowed & (scores > 0), scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def normalize_rows(factors: np.ndarray) -> np.ndarray:
+    norms = np.linalg.norm(factors, axis=-1, keepdims=True)
+    return (factors / np.maximum(norms, 1e-12)).astype(np.float32)
+
+
+def cosine_top_k(item_factors_normalized: np.ndarray,
+                 query_vecs: np.ndarray, k: int,
+                 allowed_mask: Optional[np.ndarray] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (scores, item_indices), length <= k, excluding -inf entries."""
+    n_items = item_factors_normalized.shape[0]
+    if allowed_mask is None:
+        allowed_mask = np.ones(n_items, dtype=bool)
+    k_eff = min(k, n_items)
+    scores, idx = _cosine_topk(
+        np.asarray(query_vecs, dtype=np.float32),
+        item_factors_normalized, allowed_mask, k_eff)
+    scores = np.asarray(scores)
+    idx = np.asarray(idx)
+    keep = np.isfinite(scores)
+    return scores[keep], idx[keep]
+
+
+def build_filter_mask(n_items: int,
+                      exclude: Sequence[int] = (),
+                      white_list: Optional[Sequence[int]] = None,
+                      item_categories: Optional[Sequence[Optional[set]]] = None,
+                      categories: Optional[set] = None) -> np.ndarray:
+    """Host-side candidate mask implementing isCandidateItem
+    (ALSAlgorithm.scala:192+): whitelist wins, blacklist/query items
+    excluded, category intersection required when given."""
+    mask = np.ones(n_items, dtype=bool)
+    if white_list is not None:
+        mask[:] = False
+        wl = np.asarray(list(white_list), dtype=np.int64)
+        wl = wl[(wl >= 0) & (wl < n_items)]
+        mask[wl] = True
+    ex = np.asarray(list(exclude), dtype=np.int64)
+    ex = ex[(ex >= 0) & (ex < n_items)]
+    mask[ex] = False
+    if categories is not None and item_categories is not None:
+        cat = np.array([bool(c and (c & categories))
+                        for c in item_categories], dtype=bool)
+        mask &= cat
+    return mask

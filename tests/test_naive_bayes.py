@@ -1,0 +1,100 @@
+"""Naive Bayes kernel tests: MLlib-formula parity + e2 categorical parity."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.naive_bayes import (CategoricalNBModel,
+                                              LabeledPoint,
+                                              categorical_nb_train,
+                                              multinomial_nb_train)
+
+
+class TestMultinomialNB:
+    def np_reference(self, X, y, lam):
+        classes = np.unique(y)
+        C, D = len(classes), X.shape[1]
+        pi = np.zeros(C)
+        theta = np.zeros((C, D))
+        N = len(y)
+        for ci, c in enumerate(classes):
+            sel = y == c
+            pi[ci] = math.log((sel.sum() + lam) / (N + C * lam))
+            sums = X[sel].sum(axis=0)
+            theta[ci] = np.log(sums + lam) - math.log(sums.sum() + D * lam)
+        return pi, theta, classes
+
+    def test_matches_mllib_formulas(self, mesh8):
+        rng = np.random.default_rng(0)
+        X = rng.integers(0, 5, size=(97, 4)).astype(np.float32)
+        y = rng.integers(0, 4, size=97).astype(np.float64)
+        model = multinomial_nb_train(X, y, lam=1.0, mesh=mesh8)
+        pi, theta, classes = self.np_reference(X, y, 1.0)
+        np.testing.assert_allclose(model.pi, pi, rtol=1e-5)
+        np.testing.assert_allclose(model.theta, theta, rtol=1e-5)
+        np.testing.assert_array_equal(model.labels, classes)
+
+    def test_predict_separable(self, mesh8):
+        # class 0 heavy on feature 0, class 1 heavy on feature 1
+        X = np.array([[10, 0], [9, 1], [0, 10], [1, 9]], dtype=np.float32)
+        y = np.array([0, 0, 1, 1], dtype=np.float64)
+        model = multinomial_nb_train(X, y, lam=1.0, mesh=mesh8)
+        assert model.predict(np.array([5.0, 0.0])) == 0.0
+        assert model.predict(np.array([0.0, 5.0])) == 1.0
+
+    def test_nondivisible_batch_padding(self, mesh8):
+        # 13 rows is not a multiple of 8 devices; padding must not leak
+        X = np.ones((13, 3), dtype=np.float32)
+        y = np.array([0, 1] * 6 + [0], dtype=np.float64)
+        model = multinomial_nb_train(X, y, lam=1.0, mesh=mesh8)
+        # priors reflect 7 vs 6 counts
+        assert model.pi[0] > model.pi[1]
+        np.testing.assert_allclose(
+            np.exp(model.pi).sum(), (13 + 2) / (13 + 2), rtol=1e-6)
+
+
+FIXTURE = [
+    LabeledPoint("spam", ("cheap", "buy")),
+    LabeledPoint("spam", ("cheap", "now")),
+    LabeledPoint("spam", ("free", "buy")),
+    LabeledPoint("ham", ("meeting", "now")),
+    LabeledPoint("ham", ("cheap", "agenda")),
+]
+
+
+class TestCategoricalNB:
+    def test_priors_and_likelihoods(self, mesh8):
+        model = categorical_nb_train(FIXTURE, mesh8)
+        assert model.priors["spam"] == pytest.approx(math.log(3 / 5))
+        assert model.priors["ham"] == pytest.approx(math.log(2 / 5))
+        # P(cheap | spam) = 2/3 at position 0
+        assert model.likelihoods["spam"][0]["cheap"] == \
+            pytest.approx(math.log(2 / 3))
+        assert model.likelihoods["ham"][1]["agenda"] == \
+            pytest.approx(math.log(1 / 2))
+        # unseen (spam, pos0, meeting) absent entirely
+        assert "meeting" not in model.likelihoods["spam"][0]
+
+    def test_log_score_and_none_for_unseen(self, mesh8):
+        model = categorical_nb_train(FIXTURE, mesh8)
+        s = model.log_score(LabeledPoint("spam", ("cheap", "buy")))
+        assert s == pytest.approx(
+            math.log(3 / 5) + math.log(2 / 3) + math.log(2 / 3))
+        assert model.log_score(LabeledPoint("spam", ("meeting", "buy"))) \
+            is None
+        assert model.log_score(LabeledPoint("nolabel", ("cheap", "buy"))) \
+            is None
+
+    def test_default_likelihood_fallback(self, mesh8):
+        model = categorical_nb_train(FIXTURE, mesh8)
+        # reference pattern: default = min likelihood - log(count)
+        s = model.log_score(
+            LabeledPoint("spam", ("meeting", "buy")),
+            default=lambda m: min(m.values()) - 1.0)
+        assert s is not None
+
+    def test_predict(self, mesh8):
+        model = categorical_nb_train(FIXTURE, mesh8)
+        assert model.predict(("cheap", "buy")) == "spam"
+        assert model.predict(("meeting", "now")) == "ham"

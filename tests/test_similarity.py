@@ -1,0 +1,61 @@
+"""Cosine top-k + candidate-filter tests (similarproduct predict semantics)."""
+
+import numpy as np
+
+from predictionio_tpu.ops.similarity import (build_filter_mask, cosine_top_k,
+                                             normalize_rows)
+
+
+def factors():
+    # 6 items in 2-D: items 0,1 point +x; 2,3 point +y; 4 diagonal; 5 -x
+    return np.array([
+        [1.0, 0.0], [2.0, 0.1], [0.0, 1.0], [0.1, 2.0],
+        [1.0, 1.0], [-1.0, 0.0]], dtype=np.float32)
+
+
+class TestCosineTopK:
+    def test_ranks_by_summed_cosine(self):
+        V = normalize_rows(factors())
+        q = np.array([[1.0, 0.0]], dtype=np.float32)
+        scores, idx = cosine_top_k(V, q, 6)
+        assert idx[0] in (0, 1)  # colinear items first
+        assert 5 not in idx      # negative cosine filtered (score <= 0)
+        assert np.all(np.diff(scores) <= 1e-6)
+
+    def test_multi_query_sum(self):
+        V = normalize_rows(factors())
+        q = factors()[[0, 2]]  # +x and +y queries; diagonal item 4 wins
+        scores, idx = cosine_top_k(V, q, 6)
+        assert idx[0] == 4
+
+    def test_normalize_rows_handles_zero(self):
+        V = normalize_rows(np.zeros((2, 3), dtype=np.float32))
+        assert np.all(np.isfinite(V))
+
+
+class TestFilterMask:
+    def test_blacklist_and_query_exclusion(self):
+        mask = build_filter_mask(6, exclude=[0, 3])
+        assert not mask[0] and not mask[3] and mask[1]
+
+    def test_whitelist_wins(self):
+        mask = build_filter_mask(6, exclude=[1], white_list=[1, 2])
+        assert not mask[1]  # excluded even though whitelisted
+        assert mask[2] and not mask[0]
+
+    def test_categories(self):
+        cats = [{"a"}, {"b"}, {"a", "b"}, None, set(), {"c"}]
+        mask = build_filter_mask(6, item_categories=cats, categories={"a"})
+        assert mask.tolist() == [True, False, True, False, False, False]
+
+    def test_out_of_range_ids_ignored(self):
+        mask = build_filter_mask(3, exclude=[-1, 99], white_list=[0, 99])
+        assert mask.tolist() == [True, False, False]
+
+    def test_end_to_end_filtered_topk(self):
+        V = normalize_rows(factors())
+        q = np.array([[1.0, 0.2]], dtype=np.float32)
+        mask = build_filter_mask(6, exclude=[0, 1])
+        scores, idx = cosine_top_k(V, q, 3, mask)
+        assert 0 not in idx and 1 not in idx
+        assert len(idx) <= 3
