@@ -1,0 +1,73 @@
+"""Markov chain over a sparse transition-count matrix.
+
+Rebuilds the reference's ``MarkovChain`` engine
+(reference: e2/src/main/scala/io/prediction/e2/engine/MarkovChain.scala):
+row-normalize counts, keep the top-N entries per row, predict next-state
+probabilities as state-vector x matrix. Device-side: the pruned matrix is a
+dense [S, N] (index, prob) pair of arrays so predict is one gather+scatter
+einsum, avoiding host sparse structures.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class MarkovChainModel:
+    """Top-N row-normalized transitions. indices[s, j] = target state (or -1
+    padding), probs[s, j] = P(target | s)."""
+    indices: np.ndarray  # [S, N] int32
+    probs: np.ndarray    # [S, N] float32
+    n_states: int
+    top_n: int
+
+    def predict(self, current_state: np.ndarray) -> np.ndarray:
+        """next[j] = sum_s current[s] * P(j | s) (MarkovChain.scala predict)."""
+        return np.asarray(_mc_predict(
+            self.indices, self.probs,
+            np.asarray(current_state, dtype=np.float32), self.n_states))
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("n_states",))
+def _mc_predict(indices, probs, current, n_states: int):
+    import jax.numpy as jnp
+    contrib = probs * current[:, None]          # [S, N]
+    flat_idx = jnp.where(indices >= 0, indices, n_states)
+    out = jnp.zeros(n_states + 1, dtype=jnp.float32)
+    out = out.at[flat_idx.reshape(-1)].add(contrib.reshape(-1))
+    return out[:n_states]
+
+
+def markov_chain_train(row_idx: np.ndarray, col_idx: np.ndarray,
+                       counts: np.ndarray, n_states: int,
+                       top_n: int) -> MarkovChainModel:
+    """Build the pruned transition model from COO counts (host numpy: the
+    data is tiny next to factorization workloads)."""
+    row_idx = np.asarray(row_idx, dtype=np.int64)
+    col_idx = np.asarray(col_idx, dtype=np.int64)
+    counts = np.asarray(counts, dtype=np.float64)
+    indices = np.full((n_states, top_n), -1, dtype=np.int32)
+    probs = np.zeros((n_states, top_n), dtype=np.float32)
+    order = np.argsort(row_idx, kind="stable")
+    r, c, v = row_idx[order], col_idx[order], counts[order]
+    bounds = np.searchsorted(r, np.arange(n_states + 1))
+    for s in range(n_states):
+        lo, hi = bounds[s], bounds[s + 1]
+        if lo == hi:
+            continue
+        total = v[lo:hi].sum()
+        k = min(top_n, hi - lo)
+        top = np.argsort(-v[lo:hi], kind="stable")[:k]
+        sel_c = c[lo:hi][top]
+        sel_p = v[lo:hi][top] / total
+        # reference sorts kept entries by column index
+        colsort = np.argsort(sel_c)
+        indices[s, :k] = sel_c[colsort]
+        probs[s, :k] = sel_p[colsort]
+    return MarkovChainModel(indices=indices, probs=probs,
+                            n_states=n_states, top_n=top_n)

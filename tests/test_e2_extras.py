@@ -1,0 +1,75 @@
+"""MarkovChain, BinaryVectorizer, CrossValidation tests (e2 parity)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.cross_validation import split_data
+from predictionio_tpu.ops.markov import markov_chain_train
+from predictionio_tpu.ops.vectorizer import BinaryVectorizer
+
+
+class TestMarkovChain:
+    def test_row_normalized_topn(self):
+        # state 0: ->1 (3x), ->2 (1x); state 1: ->0 (2x)
+        m = markov_chain_train([0, 0, 1], [1, 2, 0], [3, 1, 2], 3, top_n=2)
+        np.testing.assert_allclose(
+            m.probs[0], [0.75, 0.25], rtol=1e-6)
+        assert m.indices[0].tolist() == [1, 2]
+        assert m.indices[2].tolist() == [-1, -1]
+
+    def test_topn_prunes_smallest(self):
+        m = markov_chain_train([0, 0, 0], [0, 1, 2], [5, 1, 4], 3, top_n=2)
+        assert set(m.indices[0].tolist()) == {0, 2}
+        np.testing.assert_allclose(sorted(m.probs[0]), [0.4, 0.5])
+
+    def test_predict_propagates(self):
+        m = markov_chain_train([0, 1], [1, 2], [1, 1], 3, top_n=1)
+        out = m.predict(np.array([1.0, 0.0, 0.0]))
+        np.testing.assert_allclose(out, [0, 1.0, 0])
+        out2 = m.predict(out)
+        np.testing.assert_allclose(out2, [0, 0, 1.0])
+
+    def test_predict_mixes_rows(self):
+        m = markov_chain_train([0, 0, 1], [1, 2, 2], [1, 1, 1], 3, top_n=2)
+        out = m.predict(np.array([0.5, 0.5, 0.0]))
+        np.testing.assert_allclose(out, [0, 0.25, 0.75])
+
+
+class TestBinaryVectorizer:
+    def test_fit_transform(self):
+        maps = [{"color": "red", "size": "L"}, {"color": "blue"}]
+        v = BinaryVectorizer.fit(maps, ["color", "size"])
+        assert v.n_features == 3
+        x = v.transform({"color": "red", "size": "L"})
+        assert x.sum() == 2.0
+        y = v.transform({"color": "green"})  # unseen -> all zeros
+        assert y.sum() == 0.0
+
+    def test_only_requested_properties(self):
+        v = BinaryVectorizer.fit([{"a": "1", "b": "2"}], ["a"])
+        assert v.n_features == 1
+
+    def test_batch(self):
+        v = BinaryVectorizer.fit([{"a": "1"}, {"a": "2"}], ["a"])
+        X = v.transform_batch([{"a": "1"}, {"a": "2"}, {"a": "3"}])
+        assert X.shape == (3, 2)
+        assert X.sum() == 2.0
+
+
+class TestSplitData:
+    def test_folds_partition(self):
+        data = list(range(10))
+        folds = split_data(3, data, "info",
+                           training_data_creator=list,
+                           query_creator=lambda d: ("q", d),
+                           actual_creator=lambda d: ("a", d))
+        assert len(folds) == 3
+        all_test = []
+        for fold_ix, (td, ei, qa) in enumerate(folds):
+            assert ei == "info"
+            test_pts = [q[1] for q, a in qa]
+            all_test += test_pts
+            assert set(td) | set(test_pts) == set(data)
+            assert not set(td) & set(test_pts)
+            assert all(i % 3 == fold_ix for i in test_pts)
+        assert sorted(all_test) == data
