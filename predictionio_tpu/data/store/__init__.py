@@ -1,0 +1,6 @@
+"""Engine-facing event access (app-name-keyed), L2 of the layer map."""
+
+from predictionio_tpu.data.store.event_store import (EventStore, LEventStore,
+                                                     PEventStore)
+
+__all__ = ["EventStore", "PEventStore", "LEventStore"]

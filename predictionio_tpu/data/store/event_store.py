@@ -1,0 +1,137 @@
+"""App-name-keyed event access for engine components.
+
+Rebuilds the reference's ``PEventStore`` / ``LEventStore``
+(reference: data/src/main/scala/io/prediction/data/store/PEventStore.scala:30-116,
+LEventStore.scala:30-142, Common.scala appNameToId): engines refer to apps by
+*name* (+ optional channel name); the store resolves ids through the metadata
+DAOs and forwards to the configured Events backend.
+
+The P/L split collapses here: one synchronous API serves both the bulk
+training reads (PEvents role — feed ``parallel.dataset`` ingest) and the
+serve-time point lookups with a deadline (LEvents role; the ecommerce
+template's 200 ms business-rule reads)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from typing import Dict, Iterator, Optional, Sequence
+
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.registry import Storage
+
+
+class EventStore:
+    def __init__(self, apps=None, channels=None, events=None):
+        self._apps = apps
+        self._channels = channels
+        self._events = events
+
+    @property
+    def apps(self):
+        return self._apps or Storage.get_meta_data_apps()
+
+    @property
+    def channels(self):
+        return self._channels or Storage.get_meta_data_channels()
+
+    @property
+    def events(self):
+        return self._events or Storage.get_events()
+
+    def resolve(self, app_name: str,
+                channel_name: Optional[str] = None) -> tuple:
+        """app/channel name -> ids (store/Common.scala appNameToId)."""
+        app = self.apps.get_by_name(app_name)
+        if app is None:
+            raise ValueError(
+                f"Invalid app name {app_name!r}: app does not exist.")
+        channel_id = None
+        if channel_name is not None:
+            match = [c for c in self.channels.get_by_app_id(app.id)
+                     if c.name == channel_name]
+            if not match:
+                raise ValueError(
+                    f"Invalid channel name {channel_name!r} for app "
+                    f"{app_name!r}.")
+            channel_id = match[0].id
+        return app.id, channel_id
+
+    # -- bulk reads (PEventStore.find, PEventStore.scala:54) ---------------
+    def find(self, app_name: str, channel_name: Optional[str] = None,
+             start_time: Optional[_dt.datetime] = None,
+             until_time: Optional[_dt.datetime] = None,
+             entity_type: Optional[str] = None,
+             entity_id: Optional[str] = None,
+             event_names: Optional[Sequence[str]] = None,
+             target_entity_type=None, target_entity_id=None,
+             limit: Optional[int] = None,
+             reversed_order: bool = False) -> Iterator[Event]:
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        return self.events.find(
+            app_id=app_id, channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            entity_id=entity_id, event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id, limit=limit,
+            reversed_order=reversed_order)
+
+    # -- property aggregation (PEventStore.aggregateProperties) ------------
+    def aggregate_properties(self, app_name: str, entity_type: str,
+                             channel_name: Optional[str] = None,
+                             start_time: Optional[_dt.datetime] = None,
+                             until_time: Optional[_dt.datetime] = None,
+                             required: Optional[Sequence[str]] = None
+                             ) -> Dict[str, PropertyMap]:
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        return self.events.aggregate_properties(
+            app_id=app_id, channel_id=channel_id, entity_type=entity_type,
+            start_time=start_time, until_time=until_time, required=required)
+
+    # -- serve-time point reads (LEventStore.findByEntity) -----------------
+    def find_by_entity(self, app_name: str, entity_type: str, entity_id: str,
+                       channel_name: Optional[str] = None,
+                       event_names: Optional[Sequence[str]] = None,
+                       target_entity_type=None, target_entity_id=None,
+                       start_time=None, until_time=None,
+                       limit: Optional[int] = None, latest: bool = True,
+                       timeout_ms: Optional[int] = None) -> list:
+        """Point lookup with an optional deadline (LEventStore.scala:30 — the
+        reference's Duration timeout; the ecommerce template calls this with
+        200 ms). Runs in a worker thread when a timeout is given so a slow
+        backend cannot stall the serving path."""
+        def _query():
+            return list(self.find(
+                app_name=app_name, channel_name=channel_name,
+                entity_type=entity_type, entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id, start_time=start_time,
+                until_time=until_time, limit=limit, reversed_order=latest))
+
+        if timeout_ms is None:
+            return _query()
+        result: list = []
+        error: list = []
+
+        def _run():
+            try:
+                result.append(_query())
+            except Exception as e:  # surfaced below
+                error.append(e)
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        t.join(timeout_ms / 1000.0)
+        if t.is_alive():
+            raise TimeoutError(
+                f"event lookup exceeded {timeout_ms} ms deadline")
+        if error:
+            raise error[0]
+        return result[0]
+
+
+# Module-level default instances, mirroring the reference's singletons.
+PEventStore = EventStore()
+LEventStore = PEventStore

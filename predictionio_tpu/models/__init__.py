@@ -1,0 +1,37 @@
+"""Engine templates (L7): the four production template families from
+BASELINE.json, rebuilt TPU-native (reference: examples/scala-parallel-*).
+
+``ENGINE_FACTORIES`` is the registry engine.json's ``engineFactory`` field
+resolves against (the reflection analog of WorkflowUtils.getEngine).
+"""
+
+from typing import Dict, Type
+
+
+def _registry() -> Dict[str, type]:
+    from predictionio_tpu.models import (classification, ecommerce,
+                                         recommendation, similarproduct)
+    return {
+        "recommendation": recommendation.RecommendationEngineFactory,
+        "classification": classification.ClassificationEngineFactory,
+        "similarproduct": similarproduct.SimilarProductEngineFactory,
+        "ecommercerecommendation": ecommerce.ECommerceEngineFactory,
+    }
+
+
+def get_engine_factory(name: str):
+    """Resolve an engineFactory name: a registry key or a dotted path
+    ``package.module.ClassName``."""
+    reg = _registry()
+    if name in reg:
+        return reg[name]
+    if "." in name:
+        import importlib
+        module_name, _, attr = name.rpartition(".")
+        return getattr(importlib.import_module(module_name), attr)
+    raise KeyError(
+        f"Unknown engineFactory {name!r}; registered: {sorted(reg)}")
+
+
+def list_engine_factories():
+    return sorted(_registry())

@@ -1,0 +1,49 @@
+"""Shared query/result shapes and helpers for the engine templates.
+
+The JSON wire shapes (ItemScore / PredictedResult) match the reference
+templates byte-for-byte (reference: examples/scala-parallel-*/src/main/scala/
+Engine.scala Query/PredictedResult/ItemScore case classes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Set
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import EntityIdIxMap
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+    def to_dict(self):
+        return {"item": self.item, "score": float(self.score)}
+
+
+@dataclass(frozen=True)
+class ItemScoreResult:
+    item_scores: Sequence[ItemScore]
+
+    def to_dict(self):
+        return {"itemScores": [s.to_dict() for s in self.item_scores]}
+
+
+def resolve_ids(ix_map: EntityIdIxMap, ids: Optional[Sequence[str]]
+                ) -> np.ndarray:
+    """String ids -> known dense indices (unknowns dropped, matching the
+    reference's `.map(map.get).flatten`)."""
+    if not ids:
+        return np.array([], dtype=np.int32)
+    ixs = ix_map.to_indices(list(ids))
+    return ixs[ixs >= 0]
+
+
+def top_scores_to_result(ix_map: EntityIdIxMap, scores: np.ndarray,
+                         idx: np.ndarray) -> ItemScoreResult:
+    items = ix_map.ids_of(idx) if len(idx) else []
+    return ItemScoreResult(tuple(
+        ItemScore(item, float(s)) for item, s in zip(items, scores)))

@@ -1,0 +1,185 @@
+"""Recommendation engine template: explicit ALS on rate/buy events.
+
+Rebuilds `scala-parallel-recommendation` (reference:
+examples/scala-parallel-recommendation/custom-prepartor/src/main/scala/
+ALSAlgorithm.scala:27-86 — MLlib `ALS.train` on rate/buy events, predict =
+`model.recommendProducts`; DataSource.scala:20-46 reads rate/buy from the
+event store, buy counts as rating 4.0; duplicate ratings keep the latest
+event). The MLlib call becomes ops.als explicit training on the mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
+                                   EngineParams, FirstServing, P2LAlgorithm,
+                                   Params, Preparator, SanityCheck)
+from predictionio_tpu.data.bimap import EntityIdIxMap
+from predictionio_tpu.data.event import to_millis
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.common import (ItemScoreResult,
+                                            top_scores_to_result)
+from predictionio_tpu.ops.als import ALSConfig, ALSModel, als_train, \
+    recommend_products
+from predictionio_tpu.ops.ratings import RatingsCOO, dedup_ratings
+
+logger = logging.getLogger(__name__)
+
+
+# -- data shapes ------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Rating:
+    user: str
+    item: str
+    rating: float
+    t: int = 0  # event-time millis (dedup tie-break)
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    ratings: List[Rating]
+
+    def sanity_check(self):
+        if not self.ratings:
+            raise ValueError("ratings is empty; check the data source")
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int
+
+    @staticmethod
+    def from_dict(d: dict) -> "Query":
+        return Query(user=str(d["user"]), num=int(d["num"]))
+
+
+@dataclass
+class PreparedData:
+    ratings_coo: RatingsCOO
+    user_ix: EntityIdIxMap
+    item_ix: EntityIdIxMap
+
+
+# -- DASE components --------------------------------------------------------
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    event_names: Tuple[str, ...] = ("rate", "buy")
+    buy_rating: float = 4.0  # implicit rating assigned to buy events
+
+
+class RecommendationDataSource(DataSource):
+    PARAMS_CLASS = DataSourceParams
+
+    def __init__(self, params=None):
+        super().__init__(params or DataSourceParams())
+
+    def read_training(self) -> TrainingData:
+        p = self.params
+        ratings = []
+        for e in PEventStore.find(app_name=p.app_name, entity_type="user",
+                                  target_entity_type="item",
+                                  event_names=list(p.event_names)):
+            if e.event == "rate":
+                rating = e.properties.get("rating", float)
+            else:  # buy
+                rating = p.buy_rating
+            ratings.append(Rating(e.entity_id, e.target_entity_id, rating,
+                                  to_millis(e.event_time)))
+        return TrainingData(ratings)
+
+
+@dataclass(frozen=True)
+class PreparatorParams(Params):
+    dedup: str = "latest"
+
+
+class RecommendationPreparator(Preparator):
+    """Builds the dense vocabulary + dedup'd COO (the BiMap.stringInt step
+    of the reference's preparator/algorithm, done once host-side)."""
+    PARAMS_CLASS = PreparatorParams
+
+    def __init__(self, params=None):
+        super().__init__(params or PreparatorParams())
+
+    def prepare(self, td: TrainingData) -> PreparedData:
+        user_ix = EntityIdIxMap.build((r.user for r in td.ratings))
+        item_ix = EntityIdIxMap.build((r.item for r in td.ratings))
+        ui = user_ix.to_indices([r.user for r in td.ratings])
+        ii = item_ix.to_indices([r.item for r in td.ratings])
+        vals = np.array([r.rating for r in td.ratings], dtype=np.float32)
+        ts = np.array([r.t for r in td.ratings], dtype=np.int64)
+        ui, ii, vals = dedup_ratings(ui, ii, vals, ts, self.params.dedup)
+        coo = RatingsCOO(ui, ii, vals, len(user_ix), len(item_ix))
+        return PreparedData(coo, user_ix, item_ix)
+
+
+@dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lam: float = 0.01
+    seed: Optional[int] = None
+
+
+@dataclass
+class RecommendationModel:
+    als: ALSModel
+    user_ix: EntityIdIxMap
+    item_ix: EntityIdIxMap
+
+
+class ALSAlgorithm(P2LAlgorithm):
+    """Explicit ALS (ALSAlgorithm.scala:27-86)."""
+    PARAMS_CLASS = ALSAlgorithmParams
+    QUERY_CLASS = Query
+
+    def __init__(self, params=None):
+        super().__init__(params or ALSAlgorithmParams())
+
+    def train(self, pd: PreparedData) -> RecommendationModel:
+        p = self.params
+        if pd.ratings_coo.nnz == 0:
+            raise ValueError("No ratings to train on")
+        cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
+                        seed=p.seed if p.seed is not None else 0)
+        model = als_train(pd.ratings_coo, cfg)
+        return RecommendationModel(model, pd.user_ix, pd.item_ix)
+
+    def predict(self, model: RecommendationModel, query: Query
+                ) -> ItemScoreResult:
+        uix = model.user_ix.get(query.user, -1)
+        if uix < 0:
+            logger.info("No prediction for unknown user %s.", query.user)
+            return ItemScoreResult(())
+        scores, idx = recommend_products(model.als, int(uix), query.num)
+        return top_scores_to_result(model.item_ix, scores, idx)
+
+    def batch_predict(self, model, queries):
+        return [(ix, self.predict(model, q)) for ix, q in queries]
+
+
+class RecommendationEngineFactory(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            {"": RecommendationDataSource},
+            {"": RecommendationPreparator},
+            {"als": ALSAlgorithm},
+            {"": FirstServing})
+
+    @classmethod
+    def engine_params(cls) -> EngineParams:
+        return EngineParams(
+            data_source_params=("", DataSourceParams()),
+            preparator_params=("", PreparatorParams()),
+            algorithm_params_list=[("als", ALSAlgorithmParams())],
+            serving_params=("", None))

@@ -1,0 +1,219 @@
+"""Similar-product engine template: implicit ALS + cosine similarity.
+
+Rebuilds `scala-parallel-similarproduct` (reference:
+examples/scala-parallel-similarproduct/multi/src/main/scala/
+ALSAlgorithm.scala — `ALS.trainImplicit` over view-count "ratings" built by
+`((u,i),1).reduceByKey(_+_)` :96-133; predict scores every item by summed
+cosine similarity against the query items' factors with category/white/black
+filters :146-190). The driver-side cosine scan becomes one jitted masked
+matmul + top-k (ops.similarity).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
+                                   EngineParams, FirstServing, P2LAlgorithm,
+                                   Params, Preparator, SanityCheck)
+from predictionio_tpu.data.bimap import EntityIdIxMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.common import (ItemScoreResult, resolve_ids,
+                                            top_scores_to_result)
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.ops.ratings import RatingsCOO, dedup_ratings
+from predictionio_tpu.ops.similarity import (build_filter_mask, cosine_top_k,
+                                             normalize_rows)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Item:
+    categories: Optional[Tuple[str, ...]] = None
+
+
+@dataclass(frozen=True)
+class ViewEvent:
+    user: str
+    item: str
+    t: int = 0
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    users: Dict[str, dict]
+    items: Dict[str, Item]
+    view_events: List[ViewEvent]
+
+    def sanity_check(self):
+        if not self.view_events:
+            raise ValueError("view_events is empty; check the data source")
+        if not self.items:
+            raise ValueError("items is empty; check the data source")
+
+
+@dataclass(frozen=True)
+class Query:
+    items: Tuple[str, ...]
+    num: int
+    categories: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+    @staticmethod
+    def from_dict(d: dict) -> "Query":
+        def opt(key):
+            v = d.get(key)
+            return tuple(v) if v is not None else None
+        return Query(items=tuple(d["items"]), num=int(d["num"]),
+                     categories=opt("categories"),
+                     white_list=opt("whiteList"),
+                     black_list=opt("blackList"))
+
+
+@dataclass
+class PreparedData:
+    td: TrainingData
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+
+
+class SimilarProductDataSource(DataSource):
+    """(multi/DataSource.scala readTraining: $set user, $set item with
+    categories, view events)"""
+    PARAMS_CLASS = DataSourceParams
+
+    def __init__(self, params=None):
+        super().__init__(params or DataSourceParams())
+
+    def read_training(self) -> TrainingData:
+        app = self.params.app_name
+        users = {eid: dict(pm.fields) for eid, pm in
+                 PEventStore.aggregate_properties(
+                     app_name=app, entity_type="user").items()}
+        items = {}
+        for eid, pm in PEventStore.aggregate_properties(
+                app_name=app, entity_type="item").items():
+            cats = pm.get_opt("categories", list)
+            items[eid] = Item(tuple(cats) if cats is not None else None)
+        views = []
+        from predictionio_tpu.data.event import to_millis
+        for e in PEventStore.find(app_name=app, entity_type="user",
+                                  event_names=["view"],
+                                  target_entity_type="item"):
+            views.append(ViewEvent(e.entity_id, e.target_entity_id,
+                                   to_millis(e.event_time)))
+        return TrainingData(users=users, items=items, view_events=views)
+
+
+class SimilarProductPreparator(Preparator):
+    def prepare(self, td: TrainingData) -> PreparedData:
+        return PreparedData(td)
+
+
+@dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    lam: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+
+
+@dataclass
+class SimilarProductModel:
+    """productFeatures + id maps + item metadata (ALSAlgorithm.scala
+    ALSModel)."""
+    item_factors_normalized: np.ndarray   # [I, R] L2-normalized rows
+    item_ix: EntityIdIxMap
+    items: Dict[str, Item]
+    item_categories: List[Optional[set]]  # by dense index
+
+
+class ALSAlgorithm(P2LAlgorithm):
+    PARAMS_CLASS = ALSAlgorithmParams
+    QUERY_CLASS = Query
+
+    def __init__(self, params=None):
+        super().__init__(params or ALSAlgorithmParams())
+
+    def train(self, pd: PreparedData) -> SimilarProductModel:
+        td = pd.td
+        p = self.params
+        if not td.view_events:
+            raise ValueError("No view events to train on")
+        # item vocabulary covers all $set items (so unseen-in-views items
+        # still resolve), users only those with views
+        user_ix = EntityIdIxMap.build(v.user for v in td.view_events)
+        item_ix = EntityIdIxMap.build(list(td.items.keys()) +
+                                      [v.item for v in td.view_events])
+        ui = user_ix.to_indices([v.user for v in td.view_events])
+        ii = item_ix.to_indices([v.item for v in td.view_events])
+        ones = np.ones(len(td.view_events), dtype=np.float32)
+        # ((u,i),1).reduceByKey(_+_)  — view counts
+        ui, ii, counts = dedup_ratings(ui, ii, ones, policy="sum")
+        coo = RatingsCOO(ui, ii, counts, len(user_ix), len(item_ix))
+        cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
+                        implicit_prefs=True, alpha=p.alpha,
+                        seed=p.seed if p.seed is not None else 0)
+        model = als_train(coo, cfg)
+        item_categories = []
+        for ix in range(len(item_ix)):
+            item = td.items.get(item_ix.id_of(ix))
+            item_categories.append(
+                set(item.categories) if item and item.categories else None)
+        return SimilarProductModel(
+            item_factors_normalized=normalize_rows(model.item_factors),
+            item_ix=item_ix,
+            items=dict(td.items),
+            item_categories=item_categories)
+
+    def predict(self, model: SimilarProductModel, query: Query
+                ) -> ItemScoreResult:
+        q_ix = resolve_ids(model.item_ix, query.items)
+        if len(q_ix) == 0:
+            logger.info("No productFeatures vector for query items %s.",
+                        query.items)
+            return ItemScoreResult(())
+        query_vecs = model.item_factors_normalized[q_ix]
+        white = (resolve_ids(model.item_ix, query.white_list)
+                 if query.white_list is not None else None)
+        black = resolve_ids(model.item_ix, query.black_list or ())
+        mask = build_filter_mask(
+            len(model.item_ix),
+            exclude=np.concatenate([q_ix, black]),  # query items excluded
+            white_list=white,
+            item_categories=model.item_categories,
+            categories=set(query.categories) if query.categories else None)
+        scores, idx = cosine_top_k(model.item_factors_normalized, query_vecs,
+                                   query.num, mask)
+        return top_scores_to_result(model.item_ix, scores, idx)
+
+    def batch_predict(self, model, queries):
+        return [(ix, self.predict(model, q)) for ix, q in queries]
+
+
+class SimilarProductEngineFactory(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            {"": SimilarProductDataSource},
+            {"": SimilarProductPreparator},
+            {"als": ALSAlgorithm},
+            {"": FirstServing})
+
+    @classmethod
+    def engine_params(cls) -> EngineParams:
+        return EngineParams(
+            data_source_params=("", DataSourceParams()),
+            preparator_params=("", None),
+            algorithm_params_list=[("als", ALSAlgorithmParams())],
+            serving_params=("", None))

@@ -1,0 +1,305 @@
+"""End-to-end template tests: seeded event store -> train -> predict.
+
+Mirrors the role of the reference's quickstart walkthroughs for the four
+template families (SURVEY.md section 2.7)."""
+
+import dataclasses
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineParams
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+
+UTC = dt.timezone.utc
+
+
+def t(sec):
+    return dt.datetime(2026, 1, 1, 0, 0, 0, tzinfo=UTC) + dt.timedelta(
+        seconds=int(sec))
+
+
+@pytest.fixture
+def app(tmp_env):
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "testapp"))
+    Storage.get_events().init(app_id)
+    return app_id
+
+
+def insert(app_id, event, etype, eid, ttype=None, tid=None, props=None,
+           sec=0):
+    Storage.get_events().insert(
+        Event(event=event, entity_type=etype, entity_id=eid,
+              target_entity_type=ttype, target_entity_id=tid,
+              properties=DataMap(props or {}), event_time=t(sec)),
+        app_id)
+
+
+class TestRecommendationTemplate:
+    def seed(self, app_id):
+        rng = np.random.default_rng(0)
+        # two taste groups: users uA* love items iA*, uB* love iB*
+        for g, (users, items) in enumerate(
+                [(["uA0", "uA1", "uA2"], ["iA0", "iA1", "iA2"]),
+                 (["uB0", "uB1", "uB2"], ["iB0", "iB1", "iB2"])]):
+            for u in users:
+                for i in items:
+                    if rng.random() < 0.9:
+                        insert(app_id, "rate", "user", u, "item", i,
+                               {"rating": 5.0}, sec=rng.integers(100))
+        # cross-group low ratings
+        insert(app_id, "rate", "user", "uA0", "item", "iB0",
+               {"rating": 1.0}, sec=200)
+        insert(app_id, "rate", "user", "uB0", "item", "iA0",
+               {"rating": 1.0}, sec=200)
+        # a buy event (becomes rating 4.0)
+        insert(app_id, "buy", "user", "uA1", "item", "iA0", sec=201)
+
+    def test_train_predict(self, app, mesh8):
+        from predictionio_tpu.models import recommendation as R
+        self.seed(app)
+        engine = R.RecommendationEngineFactory.apply()
+        ep = EngineParams(
+            data_source_params=("", R.DataSourceParams(app_name="testapp")),
+            preparator_params=("", R.PreparatorParams()),
+            algorithm_params_list=[("als", R.ALSAlgorithmParams(
+                rank=4, num_iterations=8, lam=0.05, seed=3))],
+            serving_params=("", None))
+        tr = engine.train(ep)
+        algo = tr.algorithms[0]
+        res = algo.predict(tr.models[0], R.Query(user="uA2", num=3))
+        assert len(res.item_scores) == 3
+        top_items = [s.item for s in res.item_scores]
+        # group-A user should prefer group-A items
+        assert sum(1 for i in top_items if i.startswith("iA")) >= 2
+        # unknown user -> empty result, not an error
+        res = algo.predict(tr.models[0], R.Query(user="nobody", num=3))
+        assert res.item_scores == ()
+
+    def test_dedup_latest_rating_wins(self, app, mesh8):
+        from predictionio_tpu.models import recommendation as R
+        insert(app, "rate", "user", "u1", "item", "i1", {"rating": 1.0},
+               sec=1)
+        insert(app, "rate", "user", "u1", "item", "i1", {"rating": 5.0},
+               sec=2)
+        ds = R.RecommendationDataSource(R.DataSourceParams("testapp"))
+        td = ds.read_training()
+        pd = R.RecommendationPreparator(R.PreparatorParams()).prepare(td)
+        assert pd.ratings_coo.nnz == 1
+        assert pd.ratings_coo.rating[0] == 5.0
+
+
+class TestClassificationTemplate:
+    def seed(self, app_id):
+        rng = np.random.default_rng(1)
+        for j in range(40):
+            label = float(j % 2)
+            base = np.array([8.0, 1.0, 1.0]) if label == 0 else \
+                np.array([1.0, 1.0, 8.0])
+            attrs = base + rng.integers(0, 2, 3)
+            insert(app_id, "$set", "user", f"u{j}", props={
+                "plan": label, "attr0": float(attrs[0]),
+                "attr1": float(attrs[1]), "attr2": float(attrs[2])},
+                sec=j)
+
+    def test_train_predict_eval(self, app, mesh8):
+        from predictionio_tpu.models import classification as C
+        self.seed(app)
+        engine = C.ClassificationEngineFactory.apply()
+        ep = EngineParams(
+            data_source_params=("", C.DataSourceParams(
+                app_name="testapp", eval_k=3)),
+            preparator_params=("", None),
+            algorithm_params_list=[("naive",
+                                    C.NaiveBayesAlgorithmParams(lam=1.0))],
+            serving_params=("", None))
+        tr = engine.train(ep)
+        algo = tr.algorithms[0]
+        assert algo.predict(tr.models[0],
+                            C.Query(9.0, 1.0, 1.0)).label == 0.0
+        assert algo.predict(tr.models[0],
+                            C.Query(1.0, 1.0, 9.0)).label == 1.0
+        # evaluation path: k-fold accuracy should be high on separable data
+        from predictionio_tpu.core import MetricEvaluator
+        result = MetricEvaluator(C.Accuracy()).evaluate_base(engine, [ep])
+        assert result.best_score.score > 0.9
+
+    def test_missing_property_users_skipped(self, app, mesh8):
+        from predictionio_tpu.models import classification as C
+        insert(app, "$set", "user", "full", props={
+            "plan": 0.0, "attr0": 1.0, "attr1": 1.0, "attr2": 1.0})
+        insert(app, "$set", "user", "partial", props={"plan": 1.0})
+        ds = C.ClassificationDataSource(C.DataSourceParams("testapp"))
+        td = ds.read_training()
+        assert len(td.labeled_points) == 1
+
+
+class TestSimilarProductTemplate:
+    def seed(self, app_id):
+        rng = np.random.default_rng(2)
+        for g in range(2):
+            for i in range(4):
+                cats = ["catA"] if g == 0 else ["catB"]
+                insert(app_id, "$set", "item", f"i{g}{i}",
+                       props={"categories": cats})
+        for u in range(8):
+            insert(app_id, "$set", "user", f"u{u}")
+            g = u % 2
+            for i in range(4):
+                if rng.random() < 0.85:
+                    for _ in range(int(rng.integers(1, 4))):
+                        insert(app_id, "view", "user", f"u{u}", "item",
+                               f"i{g}{i}", sec=int(rng.integers(100)))
+
+    def params(self):
+        from predictionio_tpu.models import similarproduct as S
+        return EngineParams(
+            data_source_params=("", S.DataSourceParams(app_name="testapp")),
+            preparator_params=("", None),
+            algorithm_params_list=[("als", S.ALSAlgorithmParams(
+                rank=4, num_iterations=10, lam=0.01, alpha=5.0, seed=1))],
+            serving_params=("", None))
+
+    def test_similar_items_same_group(self, app, mesh8):
+        from predictionio_tpu.models import similarproduct as S
+        self.seed(app)
+        engine = S.SimilarProductEngineFactory.apply()
+        tr = engine.train(self.params())
+        algo = tr.algorithms[0]
+        res = algo.predict(tr.models[0], S.Query(items=("i00",), num=3))
+        items = [s.item for s in res.item_scores]
+        assert "i00" not in items  # query item excluded
+        assert len(items) >= 1
+        assert sum(1 for i in items if i.startswith("i0")) >= \
+            sum(1 for i in items if i.startswith("i1"))
+
+    def test_filters(self, app, mesh8):
+        from predictionio_tpu.models import similarproduct as S
+        self.seed(app)
+        engine = S.SimilarProductEngineFactory.apply()
+        tr = engine.train(self.params())
+        algo = tr.algorithms[0]
+        model = tr.models[0]
+        res = algo.predict(model, S.Query(
+            items=("i00",), num=8, categories=("catB",)))
+        assert all(s.item.startswith("i1") for s in res.item_scores)
+        res = algo.predict(model, S.Query(
+            items=("i00",), num=8, black_list=("i01",)))
+        assert "i01" not in [s.item for s in res.item_scores]
+        res = algo.predict(model, S.Query(
+            items=("i00",), num=8, white_list=("i02",)))
+        assert [s.item for s in res.item_scores] in ([], ["i02"])
+        # unknown query item -> empty
+        res = algo.predict(model, S.Query(items=("nope",), num=3))
+        assert res.item_scores == ()
+
+
+class TestECommerceTemplate:
+    def seed(self, app_id):
+        rng = np.random.default_rng(3)
+        for g in range(2):
+            for i in range(4):
+                insert(app_id, "$set", "item", f"i{g}{i}",
+                       props={"categories": ["catA" if g == 0 else "catB"]})
+        for u in range(8):
+            g = u % 2
+            for i in range(4):
+                if rng.random() < 0.85:
+                    insert(app_id, "rate", "user", f"u{u}", "item",
+                           f"i{g}{i}", {"rating": float(rng.integers(3, 6))},
+                           sec=int(rng.integers(100)))
+
+    def params(self, **kw):
+        from predictionio_tpu.models import ecommerce as E
+        algo = E.ECommAlgorithmParams(
+            app_name="testapp", rank=4, num_iterations=10, lam=0.01,
+            alpha=5.0, seed=2, **kw)
+        return EngineParams(
+            data_source_params=("", E.DataSourceParams(app_name="testapp")),
+            preparator_params=("", None),
+            algorithm_params_list=[("ecomm", algo)],
+            serving_params=("", None))
+
+    def test_known_user_excludes_seen(self, app, mesh8):
+        from predictionio_tpu.models import ecommerce as E
+        self.seed(app)
+        # u0 has "view"-seen i00
+        insert(app, "view", "user", "u0", "item", "i00", sec=500)
+        engine = E.ECommerceEngineFactory.apply()
+        tr = engine.train(self.params(unseen_only=True,
+                                      seen_events=("view",)))
+        algo = tr.algorithms[0]
+        res = algo.predict(tr.models[0], E.Query(user="u0", num=8))
+        assert "i00" not in [s.item for s in res.item_scores]
+        assert len(res.item_scores) >= 1
+
+    def test_unavailable_items_blacklisted(self, app, mesh8):
+        from predictionio_tpu.models import ecommerce as E
+        self.seed(app)
+        insert(app, "$set", "constraint", "unavailableItems",
+               props={"items": ["i01", "i11"]}, sec=600)
+        engine = E.ECommerceEngineFactory.apply()
+        tr = engine.train(self.params(unseen_only=False))
+        algo = tr.algorithms[0]
+        for user in ("u0", "u1"):
+            res = algo.predict(tr.models[0], E.Query(user=user, num=8))
+            items = [s.item for s in res.item_scores]
+            assert "i01" not in items and "i11" not in items
+
+    def test_new_user_falls_back_to_recent_views(self, app, mesh8):
+        from predictionio_tpu.models import ecommerce as E
+        self.seed(app)
+        insert(app, "view", "user", "fresh", "item", "i00", sec=700)
+        engine = E.ECommerceEngineFactory.apply()
+        tr = engine.train(self.params(unseen_only=False))
+        algo = tr.algorithms[0]
+        res = algo.predict(tr.models[0], E.Query(user="fresh", num=4))
+        assert len(res.item_scores) >= 1
+        # new user with no views at all -> empty
+        res = algo.predict(tr.models[0], E.Query(user="ghost", num=4))
+        assert res.item_scores == ()
+
+    def test_model_survives_serialization(self, app, mesh8):
+        from predictionio_tpu.models import ecommerce as E
+        self.seed(app)
+        engine = E.ECommerceEngineFactory.apply()
+        ep = self.params(unseen_only=False)
+        tr = engine.train(ep)
+        blob = engine.serialize_models(
+            engine.make_serializable_models(tr, "inst", ep))
+        deploy = engine.prepare_deploy(ep, engine.deserialize_models(blob),
+                                       "inst")
+        res = deploy.algorithms[0].predict(deploy.models[0],
+                                           E.Query(user="u0", num=3))
+        assert len(res.item_scores) >= 1
+
+
+class TestQueryJson:
+    def test_query_from_dict(self):
+        from predictionio_tpu.models import (classification, ecommerce,
+                                             recommendation, similarproduct)
+        q = recommendation.Query.from_dict({"user": "u1", "num": 4})
+        assert q == recommendation.Query("u1", 4)
+        q = classification.Query.from_dict(
+            {"attr0": 1, "attr1": 2, "attr2": 3})
+        assert q.features.tolist() == [1.0, 2.0, 3.0]
+        q = similarproduct.Query.from_dict(
+            {"items": ["i1"], "num": 2, "categories": ["c"],
+             "whiteList": ["a"], "blackList": []})
+        assert q.categories == ("c",) and q.black_list == ()
+        q = ecommerce.Query.from_dict({"user": "u", "num": 1})
+        assert q.white_list is None
+
+    def test_registry(self):
+        from predictionio_tpu.models import (get_engine_factory,
+                                             list_engine_factories)
+        assert len(list_engine_factories()) == 4
+        f = get_engine_factory("recommendation")
+        assert f.apply() is not None
+        f2 = get_engine_factory(
+            "predictionio_tpu.models.recommendation."
+            "RecommendationEngineFactory")
+        assert f2 is f
