@@ -1,0 +1,5 @@
+"""Event-collection REST API (L1)."""
+
+from predictionio_tpu.data.api.event_server import EventServer, EventServerConfig
+
+__all__ = ["EventServer", "EventServerConfig"]

@@ -1,0 +1,280 @@
+"""The Event Server: REST event collection into the event store.
+
+Rebuilds the reference's Spray event server
+(reference: data/src/main/scala/io/prediction/data/api/EventServer.scala:112-460):
+  GET  /                       -> {"status": "alive"}
+  POST /events.json            -> 201 {"eventId": id}
+  GET  /events/<id>.json       -> event JSON | 404
+  DELETE /events/<id>.json     -> {"message": "Found"} | 404
+  GET  /events.json            -> query events (filters as query params)
+  POST /batch/events.json      -> per-event status array (max 50)
+  GET  /stats.json             -> bookkeeping counters (opt-in --stats)
+  POST /webhooks/<name>.json   -> JSON webhook connectors
+  POST /webhooks/<name>        -> form webhook connectors
+
+Auth (EventServer.scala:81-107): accessKey query param or HTTP Basic
+username; an AccessKey row with a non-empty `events` list whitelists which
+event names it may write. `channel` param scopes to a named channel.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from predictionio_tpu.data.api.stats import Stats
+from predictionio_tpu.data.event import (Event, EventValidation,
+                                         parse_event_time)
+from predictionio_tpu.data.storage.base import ABSENT
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.utils.http import HttpServer, Request, Response, Router
+
+logger = logging.getLogger(__name__)
+
+MAX_BATCH_SIZE = 50  # EventServer.scala batch limit
+
+
+@dataclass
+class EventServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 7070
+    stats: bool = False
+
+
+class EventServer:
+    def __init__(self, config: EventServerConfig = EventServerConfig(),
+                 access_keys=None, channels=None, events=None,
+                 webhook_connectors=None):
+        self.config = config
+        self._access_keys = access_keys
+        self._channels = channels
+        self._events = events
+        self.stats = Stats()
+        if webhook_connectors is None:
+            from predictionio_tpu.data.webhooks import default_connectors
+            webhook_connectors = default_connectors()
+        self.webhook_connectors = webhook_connectors
+        self.router = self._build_router()
+        self.server: Optional[HttpServer] = None
+
+    # DAOs resolved lazily so env/registry changes are respected
+    @property
+    def access_keys(self):
+        return self._access_keys or Storage.get_meta_data_access_keys()
+
+    @property
+    def channels(self):
+        return self._channels or Storage.get_meta_data_channels()
+
+    @property
+    def events(self):
+        return self._events or Storage.get_events()
+
+    # -- auth (EventServer.scala:81-107) -----------------------------------
+    def _authenticate(self, req: Request):
+        key = req.params.get("accessKey")
+        if not key:
+            auth = req.headers.get("Authorization", "")
+            if auth.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(auth[6:]).decode("utf-8")
+                    key = decoded.split(":", 1)[0]
+                except Exception:
+                    key = None
+        if not key:
+            raise AuthError(401, "Missing accessKey.")
+        access_key = self.access_keys.get(key)
+        if access_key is None:
+            raise AuthError(401, "Invalid accessKey.")
+        channel_id = None
+        channel_name = req.params.get("channel")
+        if channel_name:
+            match = [c for c in self.channels.get_by_app_id(access_key.appid)
+                     if c.name == channel_name]
+            if not match:
+                raise AuthError(400, "Invalid channel.")
+            channel_id = match[0].id
+        return access_key, channel_id
+
+    # -- handlers -----------------------------------------------------------
+    def _status(self, req: Request) -> Response:
+        return Response(200, {"status": "alive"})
+
+    def _check_event_allowed(self, access_key, event_name: str):
+        if access_key.events and event_name not in access_key.events:
+            raise AuthError(
+                403, f"{event_name} events are not allowed")
+
+    def _create_event(self, req: Request) -> Response:
+        access_key, channel_id = self._authenticate(req)
+        d = req.json()
+        if not isinstance(d, dict):
+            raise ValueError("request body must be a JSON object")
+        event = Event.from_dict(d)
+        self._check_event_allowed(access_key, event.event)
+        EventValidation.validate(event)
+        event_id = self.events.insert(event, access_key.appid, channel_id)
+        if self.config.stats:
+            self.stats.update(access_key.appid, event.event,
+                              event.entity_type, 201)
+        return Response(201, {"eventId": event_id})
+
+    def _batch_create(self, req: Request) -> Response:
+        access_key, channel_id = self._authenticate(req)
+        items = req.json()
+        if not isinstance(items, list):
+            raise ValueError("request body must be a JSON array")
+        if len(items) > MAX_BATCH_SIZE:
+            return Response(400, {
+                "message": f"Batch request must have less than or equal to "
+                           f"{MAX_BATCH_SIZE} events"})
+        results = []
+        for d in items:
+            try:
+                event = Event.from_dict(d)
+                self._check_event_allowed(access_key, event.event)
+                EventValidation.validate(event)
+                event_id = self.events.insert(event, access_key.appid,
+                                              channel_id)
+                results.append({"status": 201, "eventId": event_id})
+                if self.config.stats:
+                    self.stats.update(access_key.appid, event.event,
+                                      event.entity_type, 201)
+            except AuthError as e:
+                results.append({"status": e.status, "message": e.message})
+            except Exception as e:
+                results.append({"status": 400, "message": str(e)})
+        return Response(200, results)
+
+    def _get_event(self, req: Request) -> Response:
+        access_key, channel_id = self._authenticate(req)
+        event_id = req.path_args[0]
+        event = self.events.get(event_id, access_key.appid, channel_id)
+        if event is None:
+            return Response(404, {"message": "Not Found"})
+        return Response(200, event.to_dict())
+
+    def _delete_event(self, req: Request) -> Response:
+        access_key, channel_id = self._authenticate(req)
+        event_id = req.path_args[0]
+        ok = self.events.delete(event_id, access_key.appid, channel_id)
+        if ok:
+            return Response(200, {"message": "Found"})
+        return Response(404, {"message": "Not Found"})
+
+    def _find_events(self, req: Request) -> Response:
+        access_key, channel_id = self._authenticate(req)
+        p = req.params
+
+        def time_of(key):
+            return parse_event_time(p[key]) if key in p else None
+
+        def tgt(key):
+            if key not in p:
+                return None
+            return ABSENT if p[key] == "" else p[key]
+
+        limit = int(p.get("limit", 20))
+        reversed_order = p.get("reversed") == "true"
+        if reversed_order and not (p.get("entityType") and
+                                   p.get("entityId")):
+            return Response(400, {
+                "message": "the parameter reversed can only be used with "
+                           "both entityType and entityId specified."})
+        events = list(self.events.find(
+            app_id=access_key.appid, channel_id=channel_id,
+            start_time=time_of("startTime"), until_time=time_of("untilTime"),
+            entity_type=p.get("entityType"), entity_id=p.get("entityId"),
+            event_names=(p["event"].split(",") if "event" in p else None),
+            target_entity_type=tgt("targetEntityType"),
+            target_entity_id=tgt("targetEntityId"),
+            limit=limit, reversed_order=reversed_order))
+        if not events:
+            return Response(404, {"message": "Not Found"})
+        return Response(200, [e.to_dict() for e in events])
+
+    def _get_stats(self, req: Request) -> Response:
+        access_key, _ = self._authenticate(req)
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To see stats, launch Event Server with "
+                           "--stats argument."})
+        return Response(200, self.stats.to_dict(access_key.appid))
+
+    def _webhook_json(self, req: Request) -> Response:
+        access_key, channel_id = self._authenticate(req)
+        name = req.path_args[0]
+        connector = self.webhook_connectors.get_json(name)
+        if connector is None:
+            return Response(404, {"message": f"webhook {name} not supported"})
+        event = connector.to_event(req.json() or {})
+        EventValidation.validate(event)
+        event_id = self.events.insert(event, access_key.appid, channel_id)
+        return Response(201, {"eventId": event_id})
+
+    def _webhook_form(self, req: Request) -> Response:
+        access_key, channel_id = self._authenticate(req)
+        name = req.path_args[0]
+        connector = self.webhook_connectors.get_form(name)
+        if connector is None:
+            return Response(404, {"message": f"webhook {name} not supported"})
+        event = connector.to_event(req.form())
+        EventValidation.validate(event)
+        event_id = self.events.insert(event, access_key.appid, channel_id)
+        return Response(201, {"eventId": event_id})
+
+    def _webhook_get(self, req: Request) -> Response:
+        self._authenticate(req)
+        name = req.path_args[0]
+        if (self.webhook_connectors.get_json(name) or
+                self.webhook_connectors.get_form(name)):
+            return Response(200, {"message": "Ok"})
+        return Response(404, {"message": f"webhook {name} not supported"})
+
+    def _build_router(self) -> Router:
+        r = Router()
+
+        def guarded(handler):
+            def wrapped(req: Request) -> Response:
+                try:
+                    return handler(req)
+                except AuthError as e:
+                    return Response(e.status, {"message": e.message})
+            return wrapped
+
+        r.add("GET", "/", self._status)
+        r.add("POST", "/events.json", guarded(self._create_event))
+        r.add("GET", "/events.json", guarded(self._find_events))
+        r.add("POST", "/batch/events.json", guarded(self._batch_create))
+        r.add("GET", "/events/<id>.json", guarded(self._get_event))
+        r.add("DELETE", "/events/<id>.json", guarded(self._delete_event))
+        r.add("GET", "/stats.json", guarded(self._get_stats))
+        r.add("POST", "/webhooks/<name>.json", guarded(self._webhook_json))
+        r.add("GET", "/webhooks/<name>.json", guarded(self._webhook_get))
+        r.add("POST", "/webhooks/<name>", guarded(self._webhook_form))
+        return r
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, background: bool = True) -> "EventServer":
+        self.server = HttpServer(self.router, self.config.ip,
+                                 self.config.port)
+        self.server.start(background=background)
+        self.config.port = self.server.port
+        logger.info("Event Server started on %s:%d",
+                    self.config.ip, self.config.port)
+        return self
+
+    def stop(self):
+        if self.server:
+            self.server.stop()
+            self.server = None
+
+
+class AuthError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message

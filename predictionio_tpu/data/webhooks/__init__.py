@@ -1,0 +1,25 @@
+"""Webhook connectors: third-party payloads -> events.
+
+Rebuilds the reference's webhook framework (reference:
+data/src/main/scala/io/prediction/data/webhooks/{JsonConnector,FormConnector,
+ConnectorUtil}.scala and the registry api/WebhooksConnectors.scala:34 —
+segment.io as the JSON connector, MailChimp as the form connector).
+"""
+
+from predictionio_tpu.data.webhooks.base import (ConnectorException,
+                                                 ConnectorRegistry,
+                                                 FormConnector,
+                                                 JsonConnector)
+
+
+def default_connectors() -> ConnectorRegistry:
+    from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
+    from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
+    reg = ConnectorRegistry()
+    reg.register_json("segmentio", SegmentIOConnector())
+    reg.register_form("mailchimp", MailChimpConnector())
+    return reg
+
+
+__all__ = ["ConnectorException", "ConnectorRegistry", "FormConnector",
+           "JsonConnector", "default_connectors"]

@@ -1,0 +1,152 @@
+"""Tiny threaded HTTP server + router on the stdlib.
+
+Plays the role of the reference's Spray/Akka HTTP layer (reference:
+data/src/main/scala/io/prediction/data/api/EventServer.scala,
+core/src/main/scala/io/prediction/workflow/CreateServer.scala) without
+external dependencies: a ThreadingHTTPServer dispatching to route handlers.
+Request-level concurrency comes from the thread pool; device work stays
+serialized behind the algorithm's own jit calls (XLA queues per-device).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    params: Dict[str, str]
+    headers: Dict[str, str]
+    body: bytes
+    path_args: Tuple[str, ...] = ()
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+    def form(self) -> Dict[str, str]:
+        parsed = urllib.parse.parse_qs(self.body.decode("utf-8"),
+                                       keep_blank_values=True)
+        return {k: v[0] for k, v in parsed.items()}
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: Any = None           # dict/list -> JSON; str -> as-is
+    content_type: str = "application/json; charset=UTF-8"
+
+    def payload(self) -> bytes:
+        if self.body is None:
+            return b""
+        if isinstance(self.body, (bytes, bytearray)):
+            return bytes(self.body)
+        if isinstance(self.body, str):
+            return self.body.encode("utf-8")
+        return json.dumps(self.body).encode("utf-8")
+
+
+Handler = Callable[[Request], Response]
+
+
+class Router:
+    """Method+path-regex routing. Patterns use <name> wildcards that match
+    one path segment and arrive as positional path_args."""
+
+    def __init__(self):
+        self.routes: List[Tuple[str, re.Pattern, Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler):
+        regex = re.compile(
+            "^" + re.sub(r"<[^>]+>", r"([^/]+)", pattern) + "$")
+        self.routes.append((method.upper(), regex, handler))
+
+    def dispatch(self, req: Request) -> Response:
+        matched_path = False
+        for method, regex, handler in self.routes:
+            m = regex.match(req.path)
+            if m:
+                matched_path = True
+                if method == req.method:
+                    req.path_args = m.groups()
+                    return handler(req)
+        if matched_path:
+            return Response(405, {"message": "method not allowed"})
+        return Response(404, {"message": "not found"})
+
+
+class HttpServer:
+    def __init__(self, router: Router, host: str = "0.0.0.0",
+                 port: int = 8000):
+        self.router = router
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _make_handler(self):
+        router = self.router
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _handle(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                params = {k: v[0] for k, v in
+                          urllib.parse.parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req = Request(method=self.command, path=parsed.path,
+                              params=params,
+                              headers={k: v for k, v in self.headers.items()},
+                              body=body)
+                try:
+                    resp = router.dispatch(req)
+                except ValueError as e:
+                    resp = Response(400, {"message": str(e)})
+                except Exception as e:
+                    logger.exception("handler error")
+                    resp = Response(500, {"message": str(e)})
+                payload = resp.payload()
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_DELETE = do_PUT = _handle
+
+            def log_message(self, fmt, *args):
+                logger.debug("%s %s", self.address_string(), fmt % args)
+
+        return _Handler
+
+    def start(self, background: bool = True):
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        if background:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.serve_forever()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,227 @@
+"""Event Server REST tests (mirrors reference EventServiceSpec + webhook
+connector specs), run against an in-process server over real HTTP."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api.event_server import (EventServer,
+                                                    EventServerConfig)
+from predictionio_tpu.data.storage import AccessKey, App, Channel, Storage
+
+
+def call(port, method, path, body=None, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=(json.dumps(body).encode() if isinstance(body, (dict, list))
+              else body),
+        headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture
+def server(tmp_env):
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "esapp"))
+    Storage.get_events().init(app_id)
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("testkey", app_id, []))
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("limitedkey", app_id, ["rate"]))
+    chan_id = Storage.get_meta_data_channels().insert(
+        Channel(0, "chan1", app_id))
+    Storage.get_events().init(app_id, chan_id)
+    s = EventServer(EventServerConfig(ip="127.0.0.1", port=0, stats=True))
+    s.start()
+    yield s
+    s.stop()
+
+
+EVENT = {"event": "rate", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": 4.5},
+         "eventTime": "2026-01-02T03:04:05.678Z"}
+
+
+class TestEventCRUD:
+    def test_status(self, server):
+        status, body = call(server.config.port, "GET", "/")
+        assert status == 200 and body == {"status": "alive"}
+
+    def test_create_get_delete(self, server):
+        p = server.config.port
+        status, body = call(p, "POST", "/events.json?accessKey=testkey",
+                            EVENT)
+        assert status == 201
+        eid = body["eventId"]
+        status, body = call(p, "GET", f"/events/{eid}.json?accessKey=testkey")
+        assert status == 200
+        assert body["event"] == "rate"
+        assert body["properties"]["rating"] == 4.5
+        assert body["eventTime"] == "2026-01-02T03:04:05.678Z"
+        status, body = call(p, "DELETE",
+                            f"/events/{eid}.json?accessKey=testkey")
+        assert status == 200 and body == {"message": "Found"}
+        status, _ = call(p, "GET", f"/events/{eid}.json?accessKey=testkey")
+        assert status == 404
+
+    def test_auth_required_and_basic_auth(self, server):
+        p = server.config.port
+        status, _ = call(p, "POST", "/events.json", EVENT)
+        assert status == 401
+        status, _ = call(p, "POST", "/events.json?accessKey=wrong", EVENT)
+        assert status == 401
+        import base64
+        auth = base64.b64encode(b"testkey:").decode()
+        status, _ = call(p, "POST", "/events.json", EVENT,
+                         {"Authorization": f"Basic {auth}"})
+        assert status == 201
+
+    def test_event_whitelist(self, server):
+        p = server.config.port
+        status, _ = call(p, "POST", "/events.json?accessKey=limitedkey",
+                         EVENT)
+        assert status == 201
+        bad = dict(EVENT, event="buy")
+        status, body = call(p, "POST", "/events.json?accessKey=limitedkey",
+                            bad)
+        assert status == 403
+
+    def test_invalid_event_rejected(self, server):
+        p = server.config.port
+        bad = dict(EVENT, event="$invalid")
+        status, body = call(p, "POST", "/events.json?accessKey=testkey", bad)
+        assert status == 400
+
+    def test_channel_scoping(self, server):
+        p = server.config.port
+        status, body = call(
+            p, "POST", "/events.json?accessKey=testkey&channel=chan1", EVENT)
+        assert status == 201
+        # default channel does not see it
+        status, _ = call(p, "GET", "/events.json?accessKey=testkey")
+        assert status == 404
+        status, body = call(
+            p, "GET", "/events.json?accessKey=testkey&channel=chan1")
+        assert status == 200 and len(body) == 1
+        status, _ = call(
+            p, "POST", "/events.json?accessKey=testkey&channel=nope", EVENT)
+        assert status == 400
+
+
+class TestFindEvents:
+    def seed(self, p):
+        for i, (ev, eid, sec) in enumerate([
+                ("rate", "u1", 5), ("buy", "u1", 6), ("rate", "u2", 7)]):
+            e = dict(EVENT, event=ev, entityId=eid,
+                     eventTime=f"2026-01-02T03:04:0{sec}.000Z")
+            status, _ = call(p, "POST", "/events.json?accessKey=testkey", e)
+            assert status == 201
+
+    def test_filters(self, server):
+        p = server.config.port
+        self.seed(p)
+        status, body = call(p, "GET", "/events.json?accessKey=testkey")
+        assert status == 200 and len(body) == 3
+        status, body = call(
+            p, "GET", "/events.json?accessKey=testkey&event=rate")
+        assert len(body) == 2
+        status, body = call(
+            p, "GET", "/events.json?accessKey=testkey&entityType=user"
+            "&entityId=u1&reversed=true")
+        assert [e["event"] for e in body] == ["buy", "rate"]
+        status, body = call(
+            p, "GET", "/events.json?accessKey=testkey&limit=1")
+        assert len(body) == 1
+        status, body = call(
+            p, "GET", "/events.json?accessKey=testkey"
+            "&startTime=2026-01-02T03:04:06.000Z")
+        assert len(body) == 2
+        # reversed without entity -> 400
+        status, _ = call(
+            p, "GET", "/events.json?accessKey=testkey&reversed=true")
+        assert status == 400
+
+    def test_batch(self, server):
+        p = server.config.port
+        batch = [EVENT, dict(EVENT, event="$invalid"),
+                 dict(EVENT, entityId="u9")]
+        status, body = call(p, "POST", "/batch/events.json?accessKey=testkey",
+                            batch)
+        assert status == 200
+        assert [r["status"] for r in body] == [201, 400, 201]
+        status, body = call(p, "POST",
+                            "/batch/events.json?accessKey=testkey",
+                            [EVENT] * 51)
+        assert status == 400
+
+    def test_stats(self, server):
+        p = server.config.port
+        self.seed(p)
+        status, body = call(p, "GET", "/stats.json?accessKey=testkey")
+        assert status == 200
+        assert body["currentWindow"]["count"] == 3
+        assert body["currentWindow"]["byEvent"]["rate"] == 2
+
+
+class TestWebhooks:
+    def test_segmentio_track(self, server):
+        p = server.config.port
+        payload = {
+            "type": "track", "userId": "user123", "event": "Signed Up",
+            "properties": {"plan": "Pro"},
+            "timestamp": "2026-01-02T03:04:05.000Z"}
+        status, body = call(
+            p, "POST", "/webhooks/segmentio.json?accessKey=testkey", payload)
+        assert status == 201
+        status, events = call(
+            p, "GET", "/events.json?accessKey=testkey&event=track")
+        assert events[0]["entityId"] == "user123"
+        assert events[0]["properties"]["event"] == "Signed Up"
+        assert events[0]["properties"]["properties"]["plan"] == "Pro"
+
+    def test_segmentio_requires_user(self, server):
+        p = server.config.port
+        status, _ = call(
+            p, "POST", "/webhooks/segmentio.json?accessKey=testkey",
+            {"type": "track", "event": "x"})
+        assert status == 400
+
+    def test_unknown_webhook(self, server):
+        p = server.config.port
+        status, _ = call(p, "POST", "/webhooks/nope.json?accessKey=testkey",
+                         {})
+        assert status == 404
+        status, _ = call(p, "GET",
+                         "/webhooks/segmentio.json?accessKey=testkey")
+        assert status == 200
+
+    def test_mailchimp_subscribe_form(self, server):
+        import urllib.parse
+        p = server.config.port
+        form = {
+            "type": "subscribe", "fired_at": "2026-03-26 21:35:57",
+            "data[id]": "8a25ff1d98", "data[list_id]": "a6b5da1054",
+            "data[email]": "api@mailchimp.com",
+            "data[email_type]": "html",
+            "data[merges][EMAIL]": "api@mailchimp.com",
+            "data[merges][FNAME]": "MailChimp",
+            "data[merges][LNAME]": "API",
+            "data[ip_opt]": "10.20.10.30",
+            "data[ip_signup]": "10.20.10.30"}
+        body = urllib.parse.urlencode(form).encode()
+        status, resp = call(
+            p, "POST", "/webhooks/mailchimp?accessKey=testkey", body,
+            {"Content-Type": "application/x-www-form-urlencoded"})
+        assert status == 201
+        status, events = call(
+            p, "GET", "/events.json?accessKey=testkey&event=subscribe")
+        assert events[0]["entityId"] == "8a25ff1d98"
+        assert events[0]["targetEntityId"] == "a6b5da1054"
+        assert events[0]["eventTime"].startswith("2026-03-26T21:35:57")
