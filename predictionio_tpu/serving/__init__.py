@@ -1,0 +1,5 @@
+"""Engine query serving (L4 deploy side)."""
+
+from predictionio_tpu.serving.server import EngineServer, ServerConfig
+
+__all__ = ["EngineServer", "ServerConfig"]

@@ -1,0 +1,254 @@
+"""The engine server: deployed query HTTP service.
+
+Rebuilds the reference's ``CreateServer``
+(reference: core/src/main/scala/io/prediction/workflow/CreateServer.scala:
+ServerConfig :80-98, model restore + prepareDeploy :206-265, ServerActor
+routes `/`, `/queries.json`, `/reload`, `/stop`, `/plugins.json` :461-708,
+query path :490-641, feedback loop :526-596, serving counters :418-420).
+
+TPU notes: models restored from the model store are re-uploaded to device
+HBM lazily by each algorithm's first predict; the query path is host ->
+jitted device scoring -> host JSON, with business-rule event reads kept off
+the device path (the templates handle that). requestCount / avgServingSec /
+lastServingSec counters match the reference status page.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.core.engine import Engine, EngineParams
+from predictionio_tpu.data.event import format_event_time, utcnow
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.models import get_engine_factory
+from predictionio_tpu.serving.plugins import EngineServerPluginContext
+from predictionio_tpu.utils.http import (HttpServer, Request, Response,
+                                         Router)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ServerConfig:
+    """(CreateServer.scala:80-98)"""
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    engine_instance_id: Optional[str] = None
+    engine_id: Optional[str] = None
+    engine_version: Optional[str] = None
+    engine_variant: str = "engine.json"
+    batch: str = ""
+    accesskey: str = ""
+    event_server_ip: str = "0.0.0.0"
+    event_server_port: int = 7070
+    feedback: bool = False
+
+
+class EngineServer:
+    def __init__(self, config: ServerConfig,
+                 engine: Optional[Engine] = None,
+                 engine_params: Optional[EngineParams] = None,
+                 plugin_context: Optional[EngineServerPluginContext] = None):
+        self.config = config
+        self._lock = threading.RLock()
+        self.engine = engine
+        self.engine_params = engine_params
+        self.engine_instance = None
+        self.algorithms = []
+        self.models = []
+        self.serving = None
+        self.plugin_context = (plugin_context or
+                               EngineServerPluginContext.load_from_env())
+        # serving counters (CreateServer.scala:418-420)
+        self.request_count = 0
+        self.serving_seconds = 0.0
+        self.last_serving_sec = 0.0
+        self.start_time = utcnow()
+        self.server: Optional[HttpServer] = None
+        self.router = self._build_router()
+
+    # -- model loading (createServerActorWithEngine, :206-265) -------------
+    def load_engine_instance(self):
+        instances = Storage.get_meta_data_engine_instances()
+        cfg = self.config
+        if cfg.engine_instance_id:
+            instance = instances.get(cfg.engine_instance_id)
+            if instance is None:
+                raise ValueError(
+                    f"Invalid engine instance id {cfg.engine_instance_id}")
+        else:
+            instance = instances.get_latest_completed(
+                cfg.engine_id or "default", cfg.engine_version or "0",
+                cfg.engine_variant)
+            if instance is None:
+                raise ValueError(
+                    f"No valid engine instance found for engine "
+                    f"{cfg.engine_id} {cfg.engine_version} "
+                    f"{cfg.engine_variant}. Try running `pio train` first.")
+        return instance
+
+    def load(self):
+        """Restore models and build the serving pipeline (the deploy path)."""
+        with self._lock:
+            instance = self.load_engine_instance()
+            if self.engine is None:
+                factory = get_engine_factory(instance.engine_factory)
+                self.engine = factory.apply()
+            if self.engine_params is None:
+                variant = {
+                    "datasource": json.loads(
+                        instance.data_source_params or "{}"),
+                    "preparator": json.loads(
+                        instance.preparator_params or "{}"),
+                    "algorithms": json.loads(
+                        instance.algorithms_params or "[]"),
+                    "serving": json.loads(instance.serving_params or "{}"),
+                }
+                self.engine_params = self.engine.json_to_engine_params(
+                    variant)
+            model = Storage.get_model_data_models().get(instance.id)
+            if model is None:
+                raise ValueError(
+                    f"No model found for engine instance {instance.id}")
+            persisted = self.engine.deserialize_models(model.models)
+            result = self.engine.prepare_deploy(
+                self.engine_params, persisted, instance.id)
+            self.engine_instance = instance
+            self.algorithms = result.algorithms
+            self.models = result.models
+            self.serving = self.engine.make_serving(self.engine_params)
+            logger.info("Engine instance %s loaded (%d algorithm(s))",
+                        instance.id, len(self.algorithms))
+        return self
+
+    # -- query path (ServerActor.myRoute /queries.json, :490-641) ----------
+    def handle_query(self, query_dict: dict) -> dict:
+        t0 = time.perf_counter()
+        with self._lock:
+            algorithms = self.algorithms
+            models = self.models
+            serving = self.serving
+        if not algorithms:
+            raise RuntimeError("no engine loaded")
+        # decode via the first algorithm's query class (JsonExtractor :499)
+        qc = algorithms[0].query_class
+        query = qc.from_dict(query_dict) if qc is not None else query_dict
+        supplemented = serving.supplement(query)
+        predictions = [algo.predict(model, supplemented)
+                       for algo, model in zip(algorithms, models)]
+        prediction = serving.serve(query, predictions)
+        pred_dict = (prediction.to_dict()
+                     if hasattr(prediction, "to_dict") else prediction)
+        if not isinstance(pred_dict, dict):
+            pred_dict = {"result": pred_dict}
+        if self.config.feedback:
+            pr_id = query_dict.get("prId") or self.engine_instance.id
+            pred_dict = dict(pred_dict, prId=pr_id)
+            self._send_feedback(query_dict, pred_dict, pr_id)
+        pred_dict = self.plugin_context.apply_output(
+            self.engine_instance, query_dict, pred_dict)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.request_count += 1
+            self.serving_seconds += dt
+            self.last_serving_sec = dt
+        return pred_dict
+
+    # -- feedback loop (:526-596) ------------------------------------------
+    def _send_feedback(self, query: dict, prediction: dict, pr_id: str):
+        event = {
+            "event": "predict", "entityType": "pio_pr", "entityId": pr_id,
+            "properties": {"query": query, "prediction": prediction},
+            "eventTime": format_event_time(utcnow()),
+        }
+        url = (f"http://{self.config.event_server_ip}:"
+               f"{self.config.event_server_port}/events.json"
+               f"?accessKey={self.config.accesskey}")
+
+        def _post():
+            try:
+                req = urllib.request.Request(
+                    url, data=json.dumps(event).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception as e:
+                logger.error("feedback event POST failed: %s", e)
+
+        threading.Thread(target=_post, daemon=True).start()
+
+    # -- routes -------------------------------------------------------------
+    def _status_page(self, req: Request) -> Response:
+        with self._lock:
+            avg = (self.serving_seconds / self.request_count
+                   if self.request_count else 0.0)
+            inst = self.engine_instance
+        html = f"""<html><head><title>Engine Server at
+{self.config.ip}:{self.config.port}</title></head><body>
+<h1>Engine Server</h1>
+<table border=1>
+<tr><td>Started</td><td>{self.start_time.isoformat()}</td></tr>
+<tr><td>Engine instance</td><td>{inst.id if inst else '-'}</td></tr>
+<tr><td>Engine factory</td><td>{inst.engine_factory if inst else '-'}</td></tr>
+<tr><td>Request count</td><td>{self.request_count}</td></tr>
+<tr><td>Average serving time</td><td>{avg:.6f} s</td></tr>
+<tr><td>Last serving time</td><td>{self.last_serving_sec:.6f} s</td></tr>
+</table></body></html>"""
+        return Response(200, html, content_type="text/html; charset=UTF-8")
+
+    def _queries(self, req: Request) -> Response:
+        d = req.json()
+        if not isinstance(d, dict):
+            raise ValueError("query must be a JSON object")
+        return Response(200, self.handle_query(d))
+
+    def _reload(self, req: Request) -> Response:
+        """Hot-swap to the latest COMPLETED instance (:337-358)."""
+        cfg = self.config
+        if cfg.engine_instance_id is None and self.engine_instance:
+            cfg.engine_id = self.engine_instance.engine_id
+            cfg.engine_version = self.engine_instance.engine_version
+            cfg.engine_variant = self.engine_instance.engine_variant
+        self.engine_params = None  # re-derive from the new instance
+        self.load()
+        return Response(200, {"message": "Reloaded"})
+
+    def _stop(self, req: Request) -> Response:
+        threading.Thread(target=self.stop, daemon=True).start()
+        return Response(200, {"message": "Shutting down."})
+
+    def _plugins(self, req: Request) -> Response:
+        return Response(200, self.plugin_context.to_dict())
+
+    def _build_router(self) -> Router:
+        r = Router()
+        r.add("GET", "/", self._status_page)
+        r.add("POST", "/queries.json", self._queries)
+        r.add("GET", "/reload", self._reload)
+        r.add("POST", "/reload", self._reload)
+        r.add("POST", "/stop", self._stop)
+        r.add("GET", "/stop", self._stop)
+        r.add("GET", "/plugins.json", self._plugins)
+        return r
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, background: bool = True) -> "EngineServer":
+        self.server = HttpServer(self.router, self.config.ip,
+                                 self.config.port)
+        self.server.start(background=background)
+        self.config.port = self.server.port
+        logger.info("Engine server started on %s:%d", self.config.ip,
+                    self.config.port)
+        return self
+
+    def stop(self):
+        if self.server:
+            self.server.stop()
+            self.server = None

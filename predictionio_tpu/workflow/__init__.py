@@ -1,0 +1,9 @@
+"""Training/eval workflow runtime (L4)."""
+
+from predictionio_tpu.workflow.core_workflow import (run_evaluation,
+                                                     run_train)
+from predictionio_tpu.workflow.create_workflow import (WorkflowConfig,
+                                                       create_workflow_main)
+
+__all__ = ["run_train", "run_evaluation", "WorkflowConfig",
+           "create_workflow_main"]

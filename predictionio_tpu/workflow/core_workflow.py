@@ -1,0 +1,108 @@
+"""Core train/eval drivers with instance lifecycle records.
+
+Rebuilds the reference's ``CoreWorkflow``
+(reference: core/src/main/scala/io/prediction/workflow/CoreWorkflow.scala:
+runTrain :42-99 — EngineInstance INIT -> train -> Kryo models ->
+Models.insert -> status COMPLETED; runEvaluation :101-160 —
+EvaluationInstance lifecycle with rendered results). Pickle of host-side
+pytrees replaces Kryo; the SparkContext is replaced by the ambient device
+mesh (parallel.mesh.current_mesh), created lazily by kernels.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import traceback
+from typing import Optional, Sequence
+
+from predictionio_tpu.core.engine import (Engine, EngineParams,
+                                          WorkflowParams)
+from predictionio_tpu.core.evaluation import (EngineParamsGenerator,
+                                              Evaluation, MetricEvaluator)
+from predictionio_tpu.core.params import params_to_json
+from predictionio_tpu.data.storage.base import (EngineInstance,
+                                                EvaluationInstance, Model)
+from predictionio_tpu.data.storage.registry import Storage
+
+logger = logging.getLogger(__name__)
+
+
+def _now():
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def run_train(engine: Engine, engine_params: EngineParams,
+              engine_id: str = "default", engine_version: str = "0",
+              engine_variant: str = "default",
+              engine_factory: str = "",
+              env: Optional[dict] = None,
+              workflow_params: WorkflowParams = WorkflowParams()) -> str:
+    """Train and persist; returns the EngineInstance id
+    (CoreWorkflow.runTrain)."""
+    instances = Storage.get_meta_data_engine_instances()
+    ep_json = engine.engine_params_to_json(engine_params)
+    instance = EngineInstance(
+        id="", status="INIT", start_time=_now(), end_time=_now(),
+        engine_id=engine_id, engine_version=engine_version,
+        engine_variant=engine_variant, engine_factory=engine_factory,
+        batch=workflow_params.batch, env=env or {},
+        data_source_params=json.dumps(ep_json.get("datasource", {})),
+        preparator_params=json.dumps(ep_json.get("preparator", {})),
+        algorithms_params=json.dumps(ep_json.get("algorithms", [])),
+        serving_params=json.dumps(ep_json.get("serving", {})))
+    instance_id = instances.insert(instance)
+    instance = instances.get(instance_id)
+    try:
+        result = engine.train(engine_params, workflow_params)
+        if workflow_params.save_model:
+            serializable = engine.make_serializable_models(
+                result, instance_id, engine_params)
+            blob = engine.serialize_models(serializable)
+            Storage.get_model_data_models().insert(Model(instance_id, blob))
+        instances.update(instance.with_(status="COMPLETED",
+                                        end_time=_now()))
+        logger.info("Training completed: engine instance %s", instance_id)
+        return instance_id
+    except Exception:
+        logger.error("Training failed:\n%s", traceback.format_exc())
+        instances.update(instance.with_(status="ABORTED", end_time=_now()))
+        raise
+
+
+def run_evaluation(engine: Engine, evaluation: Evaluation,
+                   engine_params_list: Sequence[EngineParams],
+                   evaluation_class: str = "",
+                   engine_params_generator_class: str = "",
+                   env: Optional[dict] = None,
+                   output_path: Optional[str] = None,
+                   workflow_params: WorkflowParams = WorkflowParams()) -> str:
+    """Evaluate a params sweep and record results; returns the
+    EvaluationInstance id (CoreWorkflow.runEvaluation)."""
+    dao = Storage.get_meta_data_evaluation_instances()
+    instance = EvaluationInstance(
+        status="INIT", start_time=_now(), end_time=_now(),
+        evaluation_class=evaluation_class,
+        engine_params_generator_class=engine_params_generator_class,
+        batch=workflow_params.batch, env=env or {})
+    instance_id = dao.insert(instance)
+    instance = dao.get(instance_id)
+    try:
+        assert evaluation.metric is not None, "Evaluation.metric must be set"
+        evaluator = MetricEvaluator(evaluation.metric,
+                                    list(evaluation.metrics),
+                                    output_path=output_path)
+        result = evaluator.evaluate_base(engine, engine_params_list,
+                                         workflow_params)
+        dao.update(instance.with_(
+            status="EVALCOMPLETED", end_time=_now(),
+            evaluator_results=result.one_liner(),
+            evaluator_results_html=result.to_html(),
+            evaluator_results_json=result.to_json(engine)))
+        logger.info("Evaluation completed: %s", result.one_liner())
+        return instance_id
+    except Exception:
+        logger.error("Evaluation failed:\n%s", traceback.format_exc())
+        dao.update(instance.with_(status="ABORTED", end_time=_now()))
+        raise

@@ -1,0 +1,122 @@
+"""Workflow entry point: engine.json variant -> train/eval run.
+
+Rebuilds the reference's ``CreateWorkflow`` main
+(reference: core/src/main/scala/io/prediction/workflow/CreateWorkflow.scala:
+WorkflowConfig :40-58, main :132-266): parse the engine variant JSON,
+resolve the engine factory (registry lookup replacing JVM reflection —
+WorkflowUtils.scala:62), extract EngineParams, and dispatch to the train or
+evaluation driver. No spark-submit: the trainer runs in-process on the
+ambient mesh (SURVEY.md section 2.9 driver/executor row).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from predictionio_tpu.core.engine import WorkflowParams
+from predictionio_tpu.models import get_engine_factory
+from predictionio_tpu.workflow.core_workflow import (run_evaluation,
+                                                     run_train)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkflowConfig:
+    """(CreateWorkflow.scala:40-58)"""
+    batch: str = ""
+    engine_id: str = "default"
+    engine_version: str = "0"
+    engine_variant: str = "engine.json"
+    engine_factory: Optional[str] = None   # overrides variant's field
+    evaluation_class: Optional[str] = None
+    engine_params_generator_class: Optional[str] = None
+    engine_params_key: Optional[str] = None
+    verbosity: int = 0
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+    verbose: bool = False
+    json_extractor: str = "Both"  # accepted for CLI parity; JSON is native
+
+
+def load_variant(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def resolve_class(dotted: str):
+    module_name, _, attr = dotted.rpartition(".")
+    if not module_name:
+        raise ValueError(f"not a dotted class path: {dotted!r}")
+    return getattr(importlib.import_module(module_name), attr)
+
+
+def workflow_params_from_config(config: WorkflowConfig) -> WorkflowParams:
+    return WorkflowParams(
+        batch=config.batch, verbose=config.verbosity,
+        skip_sanity_check=config.skip_sanity_check,
+        stop_after_read=config.stop_after_read,
+        stop_after_prepare=config.stop_after_prepare)
+
+
+def create_workflow_main(config: WorkflowConfig) -> str:
+    """Returns the created instance id (engine or evaluation)."""
+    if config.evaluation_class:
+        return _run_evaluation(config)
+    return _run_train(config)
+
+
+def _engine_and_params(config: WorkflowConfig):
+    variant = load_variant(config.engine_variant)
+    factory_name = config.engine_factory or variant.get("engineFactory")
+    if not factory_name:
+        raise ValueError(
+            "engineFactory must be given in the engine variant or via "
+            "--engine-factory")
+    factory = get_engine_factory(factory_name)
+    engine = factory.apply()
+    engine_params = engine.json_to_engine_params(variant)
+    return variant, factory_name, engine, engine_params
+
+
+def _run_train(config: WorkflowConfig) -> str:
+    variant, factory_name, engine, engine_params = _engine_and_params(config)
+    return run_train(
+        engine, engine_params,
+        engine_id=variant.get("id", config.engine_id),
+        engine_version=config.engine_version,
+        engine_variant=config.engine_variant,
+        engine_factory=factory_name,
+        env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
+        workflow_params=workflow_params_from_config(config))
+
+
+def _run_evaluation(config: WorkflowConfig) -> str:
+    evaluation_cls = resolve_class(config.evaluation_class)
+    evaluation = (evaluation_cls() if isinstance(evaluation_cls, type)
+                  else evaluation_cls)
+    engine = evaluation.engine
+    if engine is None:
+        raise ValueError(
+            f"{config.evaluation_class} does not define .engine")
+    if config.engine_params_generator_class:
+        gen_cls = resolve_class(config.engine_params_generator_class)
+        generator = gen_cls() if isinstance(gen_cls, type) else gen_cls
+    else:
+        generator = evaluation  # Evaluation may carry its own list
+    params_list = list(getattr(generator, "engine_params_list", ()))
+    if not params_list:
+        raise ValueError("engine_params_list is empty")
+    return run_evaluation(
+        engine, evaluation, params_list,
+        evaluation_class=config.evaluation_class or "",
+        engine_params_generator_class=(
+            config.engine_params_generator_class or ""),
+        env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
+        workflow_params=workflow_params_from_config(config))
