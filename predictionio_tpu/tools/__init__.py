@@ -1,0 +1,2 @@
+"""Tools & ops (L6): the `pio` CLI, app/accesskey management, export/import,
+dashboard, admin API."""

@@ -1,0 +1,435 @@
+"""The `pio` command-line interface.
+
+Rebuilds the reference's Console
+(reference: tools/src/main/scala/io/prediction/tools/console/Console.scala:186-651):
+same verbs, argparse instead of scopt, no spark-submit — train/eval/deploy
+run in-process on the device mesh (Runner.scala's role collapses into a
+plain function call; multi-host launch is env-driven via
+parallel.mesh.init_distributed).
+
+Verbs: version, status, build, train, eval, deploy, undeploy, eventserver,
+dashboard, adminserver, app {new,list,show,delete,data-delete,channel-new,
+channel-delete}, accesskey {new,list,delete}, template {list,get}, export,
+import, run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import urllib.request
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _print(s=""):
+    print(s)
+
+
+# ---------------------------------------------------------------------------
+# command implementations
+# ---------------------------------------------------------------------------
+
+def cmd_version(args) -> int:
+    import predictionio_tpu
+    _print(predictionio_tpu.__version__)
+    return 0
+
+
+def cmd_status(args) -> int:
+    """(Console.scala:1033 status — verify storage + mesh)"""
+    from predictionio_tpu.data.storage.registry import Storage
+    _print("Inspecting storage backend connections...")
+    results = Storage.verify_all_data_objects()
+    for repo, ok in results.items():
+        _print(f"  {repo}: {'OK' if ok else 'FAILED'} "
+               f"({Storage.config_summary().get(repo, '?')})")
+    _print("Inspecting device mesh...")
+    try:
+        import jax
+        devices = jax.devices()
+        _print(f"  {len(devices)} device(s): "
+               f"{[d.platform + ':' + str(d.id) for d in devices]}")
+    except Exception as e:
+        _print(f"  device init failed: {e}")
+        return 1
+    if all(results.values()):
+        _print("Your system is all ready to go.")
+        return 0
+    return 1
+
+
+def cmd_build(args) -> int:
+    """Validate engine.json and the engine factory import (the sbt-compile
+    analog — Python engines need no build, Console.scala:924)."""
+    from predictionio_tpu.models import get_engine_factory
+    with open(args.engine_json) as f:
+        variant = json.load(f)
+    factory_name = variant.get("engineFactory")
+    if not factory_name:
+        _print("engineFactory missing in engine.json")
+        return 1
+    factory = get_engine_factory(factory_name)
+    engine = factory.apply()
+    engine.json_to_engine_params(variant)
+    _print(f"Engine {factory_name} is valid. Build finished successfully.")
+    return 0
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.workflow import (WorkflowConfig,
+                                           create_workflow_main)
+    config = WorkflowConfig(
+        batch=args.batch or "",
+        engine_variant=args.engine_json,
+        engine_id=args.engine_id or "default",
+        engine_version=args.engine_version or "0",
+        engine_factory=args.engine_factory,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+        verbose=args.verbose)
+    instance_id = create_workflow_main(config)
+    _print(f"Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.workflow import (WorkflowConfig,
+                                           create_workflow_main)
+    config = WorkflowConfig(
+        batch=args.batch or "",
+        engine_variant=args.engine_json,
+        evaluation_class=args.evaluation_class,
+        engine_params_generator_class=args.engine_params_generator_class)
+    instance_id = create_workflow_main(config)
+    _print(f"Evaluation completed. Evaluation instance ID: {instance_id}")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    config = ServerConfig(
+        ip=args.ip, port=args.port,
+        engine_instance_id=args.engine_instance_id,
+        engine_id=args.engine_id or "default",
+        engine_version=args.engine_version or "0",
+        engine_variant=args.engine_json,
+        feedback=args.feedback,
+        event_server_ip=args.event_server_ip,
+        event_server_port=args.event_server_port,
+        accesskey=args.accesskey or "")
+    server = EngineServer(config)
+    server.load()
+    _print(f"Engine is deployed and running. Engine API is live at "
+           f"http://{config.ip}:{config.port}.")
+    server.start(background=False)
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    """(Console undeploy — POST /stop to the deployed server)"""
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        req = urllib.request.Request(url, method="POST", data=b"")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+        _print(f"Undeployed engine server at {args.ip}:{args.port}.")
+        return 0
+    except Exception as e:
+        _print(f"Undeploy failed: {e}")
+        return 1
+
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.data.api.event_server import (EventServer,
+                                                        EventServerConfig)
+    server = EventServer(EventServerConfig(ip=args.ip, port=args.port,
+                                           stats=args.stats))
+    _print(f"Event Server is listening on http://{args.ip}:{args.port}")
+    server.start(background=False)
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.tools.dashboard import Dashboard, DashboardConfig
+    server = Dashboard(DashboardConfig(ip=args.ip, port=args.port))
+    _print(f"Dashboard is listening on http://{args.ip}:{args.port}")
+    server.start(background=False)
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.tools.admin import AdminServer, AdminServerConfig
+    server = AdminServer(AdminServerConfig(ip=args.ip, port=args.port))
+    _print(f"Admin server is listening on http://{args.ip}:{args.port}")
+    server.start(background=False)
+    return 0
+
+
+def cmd_app(args) -> int:
+    from predictionio_tpu.tools import app_commands as ac
+
+    def show(desc):
+        _print(f"    App Name: {desc.app.name}")
+        _print(f"      App ID: {desc.app.id}")
+        _print(f" Description: {desc.app.description or ''}")
+        for k in desc.access_keys:
+            events = ",".join(k.events) if k.events else "(all)"
+            _print(f"  Access Key: {k.key} | {events}")
+        for c in desc.channels:
+            _print(f"     Channel: {c.name} (id {c.id})")
+
+    try:
+        if args.app_command == "new":
+            desc = ac.app_new(args.name, app_id=args.id or 0,
+                              description=args.description,
+                              access_key=args.access_key or "")
+            _print("Created a new app:")
+            show(desc)
+        elif args.app_command == "list":
+            for desc in ac.app_list():
+                keys = ", ".join(k.key for k in desc.access_keys)
+                _print(f"{desc.app.id:4d} | {desc.app.name} | {keys}")
+        elif args.app_command == "show":
+            show(ac.app_show(args.name))
+        elif args.app_command == "delete":
+            if not args.force and not _confirm(
+                    f"Delete app {args.name} and all its data?"):
+                return 1
+            ac.app_delete(args.name)
+            _print(f"Deleted app {args.name}.")
+        elif args.app_command == "data-delete":
+            if not args.force and not _confirm(
+                    f"Delete data of app {args.name}?"):
+                return 1
+            ac.app_data_delete(args.name, channel=args.channel,
+                               delete_all=args.all)
+            _print(f"Deleted data of app {args.name}.")
+        elif args.app_command == "channel-new":
+            c = ac.channel_new(args.name, args.channel)
+            _print(f"Created channel {c.name} (id {c.id}) for app "
+                   f"{args.name}.")
+        elif args.app_command == "channel-delete":
+            if not args.force and not _confirm(
+                    f"Delete channel {args.channel} of app {args.name}?"):
+                return 1
+            ac.channel_delete(args.name, args.channel)
+            _print(f"Deleted channel {args.channel}.")
+        return 0
+    except ac.AppCommandError as e:
+        _print(str(e))
+        return 1
+
+
+def cmd_accesskey(args) -> int:
+    from predictionio_tpu.tools import app_commands as ac
+    try:
+        if args.accesskey_command == "new":
+            events = args.event or []
+            k = ac.accesskey_new(args.app_name, key=args.key or "",
+                                 events=events)
+            _print(f"Created new access key: {k.key}")
+        elif args.accesskey_command == "list":
+            for k in ac.accesskey_list(args.app_name):
+                events = ",".join(k.events) if k.events else "(all)"
+                _print(f"{k.key} | app {k.appid} | {events}")
+        elif args.accesskey_command == "delete":
+            ac.accesskey_delete(args.key)
+            _print(f"Deleted access key {args.key}.")
+        return 0
+    except ac.AppCommandError as e:
+        _print(str(e))
+        return 1
+
+
+def cmd_template(args) -> int:
+    """Offline template gallery: scaffolds the built-in engine templates
+    (the GitHub-backed gallery of Console.scala Template.scala:130-416 is
+    network-bound; the built-ins ship in-tree instead)."""
+    from predictionio_tpu.tools.templates import (get_template,
+                                                  list_templates)
+    if args.template_command == "list":
+        for name, desc in list_templates():
+            _print(f"  {name:28s} {desc}")
+        return 0
+    return get_template(args.name, args.directory)
+
+
+def cmd_export(args) -> int:
+    from predictionio_tpu.tools.export_import import export_events
+    n = export_events(args.appid, args.output, channel_id=args.channelid)
+    _print(f"Exported {n} events to {args.output}.")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from predictionio_tpu.tools.export_import import import_events
+    n = import_events(args.appid, args.input, channel_id=args.channelid)
+    _print(f"Imported {n} events.")
+    return 0
+
+
+def cmd_run(args) -> int:
+    """(Console run — execute a main class/module in the pio environment)"""
+    import runpy
+    sys.argv = [args.main_py] + (args.args or [])
+    runpy.run_path(args.main_py, run_name="__main__")
+    return 0
+
+
+def _confirm(question: str) -> bool:
+    answer = input(f"{question} (Y/n) ")
+    return answer in ("", "y", "Y")
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio",
+        description="pio-tpu: TPU-native machine-learning server")
+    p.add_argument("--verbose", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(func=cmd_version)
+    sub.add_parser("status").set_defaults(func=cmd_status)
+
+    b = sub.add_parser("build")
+    b.add_argument("--engine-json", default="engine.json")
+    b.set_defaults(func=cmd_build)
+
+    t = sub.add_parser("train")
+    t.add_argument("--engine-json", default="engine.json")
+    t.add_argument("--engine-id")
+    t.add_argument("--engine-version")
+    t.add_argument("--engine-factory")
+    t.add_argument("--batch")
+    t.add_argument("--skip-sanity-check", action="store_true")
+    t.add_argument("--stop-after-read", action="store_true")
+    t.add_argument("--stop-after-prepare", action="store_true")
+    t.set_defaults(func=cmd_train)
+
+    e = sub.add_parser("eval")
+    e.add_argument("evaluation_class")
+    e.add_argument("engine_params_generator_class", nargs="?")
+    e.add_argument("--engine-json", default="engine.json")
+    e.add_argument("--batch")
+    e.set_defaults(func=cmd_eval)
+
+    d = sub.add_parser("deploy")
+    d.add_argument("--ip", default="0.0.0.0")
+    d.add_argument("--port", type=int, default=8000)
+    d.add_argument("--engine-json", default="engine.json")
+    d.add_argument("--engine-id")
+    d.add_argument("--engine-version")
+    d.add_argument("--engine-instance-id")
+    d.add_argument("--feedback", action="store_true")
+    d.add_argument("--event-server-ip", default="0.0.0.0")
+    d.add_argument("--event-server-port", type=int, default=7070)
+    d.add_argument("--accesskey")
+    d.set_defaults(func=cmd_deploy)
+
+    u = sub.add_parser("undeploy")
+    u.add_argument("--ip", default="127.0.0.1")
+    u.add_argument("--port", type=int, default=8000)
+    u.set_defaults(func=cmd_undeploy)
+
+    ev = sub.add_parser("eventserver")
+    ev.add_argument("--ip", default="0.0.0.0")
+    ev.add_argument("--port", type=int, default=7070)
+    ev.add_argument("--stats", action="store_true")
+    ev.set_defaults(func=cmd_eventserver)
+
+    db = sub.add_parser("dashboard")
+    db.add_argument("--ip", default="127.0.0.1")
+    db.add_argument("--port", type=int, default=9000)
+    db.set_defaults(func=cmd_dashboard)
+
+    adm = sub.add_parser("adminserver")
+    adm.add_argument("--ip", default="127.0.0.1")
+    adm.add_argument("--port", type=int, default=7071)
+    adm.set_defaults(func=cmd_adminserver)
+
+    a = sub.add_parser("app")
+    asub = a.add_subparsers(dest="app_command", required=True)
+    an = asub.add_parser("new")
+    an.add_argument("name")
+    an.add_argument("--id", type=int)
+    an.add_argument("--description")
+    an.add_argument("--access-key")
+    asub.add_parser("list")
+    ash = asub.add_parser("show")
+    ash.add_argument("name")
+    ad = asub.add_parser("delete")
+    ad.add_argument("name")
+    ad.add_argument("-f", "--force", action="store_true")
+    add_ = asub.add_parser("data-delete")
+    add_.add_argument("name")
+    add_.add_argument("--channel")
+    add_.add_argument("--all", action="store_true")
+    add_.add_argument("-f", "--force", action="store_true")
+    acn = asub.add_parser("channel-new")
+    acn.add_argument("name")
+    acn.add_argument("channel")
+    acd = asub.add_parser("channel-delete")
+    acd.add_argument("name")
+    acd.add_argument("channel")
+    acd.add_argument("-f", "--force", action="store_true")
+    a.set_defaults(func=cmd_app)
+
+    k = sub.add_parser("accesskey")
+    ksub = k.add_subparsers(dest="accesskey_command", required=True)
+    kn = ksub.add_parser("new")
+    kn.add_argument("app_name")
+    kn.add_argument("--key")
+    kn.add_argument("--event", action="append")
+    kl = ksub.add_parser("list")
+    kl.add_argument("app_name", nargs="?")
+    kd = ksub.add_parser("delete")
+    kd.add_argument("key")
+    k.set_defaults(func=cmd_accesskey)
+
+    tp = sub.add_parser("template")
+    tsub = tp.add_subparsers(dest="template_command", required=True)
+    tsub.add_parser("list")
+    tg = tsub.add_parser("get")
+    tg.add_argument("name")
+    tg.add_argument("directory")
+    tp.set_defaults(func=cmd_template)
+
+    ex = sub.add_parser("export")
+    ex.add_argument("--appid", type=int, required=True)
+    ex.add_argument("--output", required=True)
+    ex.add_argument("--channelid", type=int)
+    ex.set_defaults(func=cmd_export)
+
+    im = sub.add_parser("import")
+    im.add_argument("--appid", type=int, required=True)
+    im.add_argument("--input", required=True)
+    im.add_argument("--channelid", type=int)
+    im.set_defaults(func=cmd_import)
+
+    r = sub.add_parser("run")
+    r.add_argument("main_py")
+    r.add_argument("args", nargs="*")
+    r.set_defaults(func=cmd_run)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[%(levelname)s] [%(name)s] %(message)s")
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Dashboard server: lists evaluation instances + results.
+
+Rebuilds the reference's Dashboard
+(reference: tools/src/main/scala/io/prediction/tools/dashboard/Dashboard.scala:76-138
+and the twirl index page): an HTML index of completed evaluation instances
+with per-instance result pages in txt/html/json.
+"""
+
+from __future__ import annotations
+
+import html as _html
+from dataclasses import dataclass
+
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.utils.http import (HttpServer, Request, Response,
+                                         Router)
+
+
+@dataclass
+class DashboardConfig:
+    ip: str = "127.0.0.1"
+    port: int = 9000
+
+
+class Dashboard:
+    def __init__(self, config: DashboardConfig = DashboardConfig()):
+        self.config = config
+        self.router = self._build_router()
+        self.server = None
+
+    def _index(self, req: Request) -> Response:
+        instances = Storage.get_meta_data_evaluation_instances() \
+            .get_completed()
+        rows = []
+        for i in instances:
+            rows.append(
+                f"<tr><td>{i.id}</td>"
+                f"<td>{_html.escape(i.evaluation_class)}</td>"
+                f"<td>{_html.escape(i.engine_params_generator_class)}</td>"
+                f"<td>{i.start_time}</td><td>{i.end_time}</td>"
+                f"<td><a href='/engine_instances/{i.id}/evaluator_results."
+                f"txt'>txt</a> "
+                f"<a href='/engine_instances/{i.id}/evaluator_results."
+                f"html'>HTML</a> "
+                f"<a href='/engine_instances/{i.id}/evaluator_results."
+                f"json'>JSON</a></td></tr>")
+        page = ("<html><head><title>PredictionIO Dashboard</title></head>"
+                "<body><h1>Completed Evaluations</h1><table border=1>"
+                "<tr><th>ID</th><th>Evaluation</th><th>Generator</th>"
+                "<th>Start</th><th>End</th><th>Results</th></tr>"
+                + "".join(rows) + "</table></body></html>")
+        return Response(200, page, content_type="text/html; charset=UTF-8")
+
+    def _result(self, req: Request) -> Response:
+        instance_id, fmt = req.path_args
+        i = Storage.get_meta_data_evaluation_instances().get(instance_id)
+        if i is None or i.status != "EVALCOMPLETED":
+            return Response(404, {"message": "Not Found"})
+        if fmt == "txt":
+            return Response(200, i.evaluator_results,
+                            content_type="text/plain; charset=UTF-8")
+        if fmt == "html":
+            return Response(200, i.evaluator_results_html,
+                            content_type="text/html; charset=UTF-8")
+        return Response(200, i.evaluator_results_json)
+
+    def _build_router(self) -> Router:
+        r = Router()
+        r.add("GET", "/", self._index)
+        r.add("GET", "/engine_instances/<id>/evaluator_results.<fmt>",
+              self._result)
+        return r
+
+    def start(self, background: bool = True) -> "Dashboard":
+        self.server = HttpServer(self.router, self.config.ip,
+                                 self.config.port)
+        self.server.start(background=background)
+        self.config.port = self.server.port
+        return self
+
+    def stop(self):
+        if self.server:
+            self.server.stop()
+            self.server = None

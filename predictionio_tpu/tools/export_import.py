@@ -1,0 +1,52 @@
+"""Event export/import: event store <-> JSON-lines files.
+
+Rebuilds the reference's export/import tools
+(reference: tools/src/main/scala/io/prediction/tools/export/EventsToFile.scala:95
+and imprt/FileToEvents.scala:39): one JSON event per line, the same wire
+format as /events.json.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from predictionio_tpu.data.event import Event, EventValidation
+from predictionio_tpu.data.storage.registry import Storage
+
+
+def export_events(app_id: int, output: str,
+                  channel_id: Optional[int] = None) -> int:
+    events = Storage.get_events()
+    n = 0
+    with open(output, "w") as f:
+        for e in events.find(app_id=app_id, channel_id=channel_id):
+            f.write(e.to_json())
+            f.write("\n")
+            n += 1
+    return n
+
+
+def import_events(app_id: int, input_path: str,
+                  channel_id: Optional[int] = None,
+                  batch_size: int = 10000, validate: bool = True) -> int:
+    events = Storage.get_events()
+    batch = []
+    n = 0
+    with open(input_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            e = Event.from_json(line)
+            if validate:
+                EventValidation.validate(e)
+            batch.append(e)
+            if len(batch) >= batch_size:
+                events.insert_batch(batch, app_id, channel_id)
+                n += len(batch)
+                batch = []
+    if batch:
+        events.insert_batch(batch, app_id, channel_id)
+        n += len(batch)
+    return n

@@ -1,0 +1,105 @@
+"""Offline engine-template gallery.
+
+Plays the role of the reference's GitHub-backed template tool
+(reference: tools/src/main/scala/io/prediction/tools/console/Template.scala:130-416
+`pio template list/get`) with the built-in template families shipped
+in-tree: `get` scaffolds a working engine directory (engine.json + README +
+seed script) wired to the corresponding predictionio_tpu.models factory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+TEMPLATES = {
+    "recommendation": {
+        "description": "Explicit-ALS personalized recommendation "
+                       "(rate/buy events)",
+        "engine_json": {
+            "id": "default",
+            "description": "Default settings",
+            "engineFactory": "recommendation",
+            "datasource": {"params": {"app_name": "MyApp"}},
+            "preparator": {"params": {"dedup": "latest"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 10, "num_iterations": 20, "lam": 0.01, "seed": 3}}],
+        },
+        "query_example": {"user": "1", "num": 4},
+    },
+    "classification": {
+        "description": "Naive-bayes classification over $set user "
+                       "properties",
+        "engine_json": {
+            "id": "default",
+            "description": "Default settings",
+            "engineFactory": "classification",
+            "datasource": {"params": {"app_name": "MyApp", "eval_k": 5}},
+            "algorithms": [{"name": "naive", "params": {"lam": 1.0}}],
+        },
+        "query_example": {"attr0": 2, "attr1": 0, "attr2": 0},
+    },
+    "similarproduct": {
+        "description": "Implicit-ALS similar-item recommendation "
+                       "(view events)",
+        "engine_json": {
+            "id": "default",
+            "description": "Default settings",
+            "engineFactory": "similarproduct",
+            "datasource": {"params": {"app_name": "MyApp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 10, "num_iterations": 20, "lam": 0.01,
+                "alpha": 1.0, "seed": 3}}],
+        },
+        "query_example": {"items": ["i1"], "num": 4},
+    },
+    "ecommercerecommendation": {
+        "description": "ALS + live business rules (seen-item/"
+                       "unavailable-item blacklists)",
+        "engine_json": {
+            "id": "default",
+            "description": "Default settings",
+            "engineFactory": "ecommercerecommendation",
+            "datasource": {"params": {"app_name": "MyApp"}},
+            "algorithms": [{"name": "ecomm", "params": {
+                "app_name": "MyApp", "unseen_only": True,
+                "seen_events": ["buy", "view"], "rank": 10,
+                "num_iterations": 20, "lam": 0.01, "alpha": 1.0,
+                "seed": 3}}],
+        },
+        "query_example": {"user": "u1", "num": 4},
+    },
+}
+
+
+def list_templates():
+    return [(name, t["description"]) for name, t in sorted(TEMPLATES.items())]
+
+
+def get_template(name: str, directory: str) -> int:
+    if name not in TEMPLATES:
+        print(f"Unknown template {name!r}. Try `pio template list`.")
+        return 1
+    t = TEMPLATES[name]
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "engine.json"), "w") as f:
+        json.dump(t["engine_json"], f, indent=2)
+        f.write("\n")
+    with open(os.path.join(directory, "README.md"), "w") as f:
+        f.write(f"""# {name} engine
+
+{t['description']}
+
+## Usage
+
+    pio app new MyApp                # note the access key
+    # ... send events to the event server (pio eventserver) ...
+    pio train --engine-json engine.json
+    pio deploy --engine-json engine.json --port 8000
+
+    curl -H 'Content-Type: application/json' \\
+      -d '{json.dumps(t['query_example'])}' \\
+      http://localhost:8000/queries.json
+""")
+    print(f"Engine template {name} created in {directory}.")
+    return 0

@@ -1,0 +1,194 @@
+"""CLI / app-commands / export-import / dashboard / admin tests
+(mirrors reference console behavior + AdminAPISpec)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.tools import app_commands as ac
+from predictionio_tpu.tools.cli import main as cli_main
+
+
+def call(port, method, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            ct = resp.headers.get("Content-Type", "")
+            data = resp.read()
+            return resp.status, (json.loads(data) if "json" in ct
+                                 else data.decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class TestAppCommands:
+    def test_app_lifecycle(self, tmp_env):
+        desc = ac.app_new("app1", description="my app")
+        assert desc.app.name == "app1"
+        assert len(desc.access_keys) == 1 and desc.access_keys[0].key
+        with pytest.raises(ac.AppCommandError):
+            ac.app_new("app1")
+        assert [d.app.name for d in ac.app_list()] == ["app1"]
+        shown = ac.app_show("app1")
+        assert shown.app.description == "my app"
+        ac.app_delete("app1")
+        assert ac.app_list() == []
+        with pytest.raises(ac.AppCommandError):
+            ac.app_show("app1")
+
+    def test_channels(self, tmp_env):
+        ac.app_new("app2")
+        c = ac.channel_new("app2", "chan-x")
+        assert c.id > 0
+        with pytest.raises(ac.AppCommandError):
+            ac.channel_new("app2", "chan-x")
+        with pytest.raises(ac.AppCommandError):
+            ac.channel_new("app2", "bad name!")
+        assert [ch.name for ch in ac.app_show("app2").channels] == ["chan-x"]
+        ac.channel_delete("app2", "chan-x")
+        assert ac.app_show("app2").channels == []
+
+    def test_data_delete(self, tmp_env):
+        desc = ac.app_new("app3")
+        ev = Storage.get_events()
+        ev.insert(Event(event="rate", entity_type="u", entity_id="1"),
+                  desc.app.id)
+        assert len(list(ev.find(desc.app.id))) == 1
+        ac.app_data_delete("app3")
+        assert list(ev.find(desc.app.id)) == []
+
+    def test_accesskeys(self, tmp_env):
+        ac.app_new("app4")
+        k = ac.accesskey_new("app4", events=["rate"])
+        assert k.events == ("rate",)
+        keys = ac.accesskey_list("app4")
+        assert len(keys) == 2  # default + new
+        ac.accesskey_delete(k.key)
+        assert len(ac.accesskey_list("app4")) == 1
+
+
+class TestExportImport:
+    def test_round_trip(self, tmp_env, tmp_path):
+        desc = ac.app_new("exapp")
+        ev = Storage.get_events()
+        for i in range(25):
+            ev.insert(Event(event="rate", entity_type="user",
+                            entity_id=f"u{i}", target_entity_type="item",
+                            target_entity_id=f"i{i}",
+                            properties=DataMap({"rating": float(i)})),
+                      desc.app.id)
+        out = tmp_path / "events.jsonl"
+        from predictionio_tpu.tools.export_import import (export_events,
+                                                          import_events)
+        assert export_events(desc.app.id, str(out)) == 25
+        assert len(out.read_text().splitlines()) == 25
+
+        desc2 = ac.app_new("imapp")
+        assert import_events(desc2.app.id, str(out)) == 25
+        got = sorted(e.entity_id for e in ev.find(desc2.app.id))
+        assert len(got) == 25
+        e0 = next(iter(ev.find(desc2.app.id, entity_id="u3",
+                               entity_type="user")))
+        assert e0.properties.get("rating", float) == 3.0
+
+
+class TestCLI:
+    def test_version_status_build(self, tmp_env, tmp_path, capsys):
+        assert cli_main(["version"]) == 0
+        assert cli_main(["status"]) == 0
+        out = capsys.readouterr().out
+        assert "METADATA: OK" in out
+        variant = {"engineFactory": "recommendation",
+                   "datasource": {"params": {"app_name": "x"}},
+                   "algorithms": [{"name": "als", "params": {"rank": 5}}]}
+        vf = tmp_path / "engine.json"
+        vf.write_text(json.dumps(variant))
+        assert cli_main(["build", "--engine-json", str(vf)]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"engineFactory": "nope"}))
+        with pytest.raises(KeyError):
+            cli_main(["build", "--engine-json", str(bad)])
+
+    def test_app_cli(self, tmp_env, capsys):
+        assert cli_main(["app", "new", "cliapp", "--access-key", "k1"]) == 0
+        out = capsys.readouterr().out
+        assert "cliapp" in out and "k1" in out
+        assert cli_main(["app", "list"]) == 0
+        assert cli_main(["app", "channel-new", "cliapp", "ch1"]) == 0
+        assert cli_main(["accesskey", "new", "cliapp"]) == 0
+        assert cli_main(["accesskey", "list", "cliapp"]) == 0
+        assert cli_main(["app", "delete", "cliapp", "-f"]) == 0
+        assert cli_main(["app", "show", "cliapp"]) == 1
+
+    def test_template_cli(self, tmp_env, tmp_path, capsys):
+        assert cli_main(["template", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "recommendation" in out
+        tdir = tmp_path / "eng"
+        assert cli_main(["template", "get", "recommendation",
+                         str(tdir)]) == 0
+        variant = json.loads((tdir / "engine.json").read_text())
+        assert variant["engineFactory"] == "recommendation"
+        assert (tdir / "README.md").exists()
+        assert cli_main(["template", "get", "nope", str(tdir)]) == 1
+
+
+class TestDashboard:
+    def test_lists_evaluations(self, tmp_env):
+        from predictionio_tpu.tools.dashboard import (Dashboard,
+                                                      DashboardConfig)
+        import datetime as dt
+        from predictionio_tpu.data.storage.base import EvaluationInstance
+        dao = Storage.get_meta_data_evaluation_instances()
+        iid = dao.insert(EvaluationInstance(
+            status="EVALCOMPLETED", evaluation_class="MyEval",
+            evaluator_results="score: 0.9",
+            evaluator_results_html="<html>ok</html>",
+            evaluator_results_json='{"score": 0.9}'))
+        d = Dashboard(DashboardConfig(ip="127.0.0.1", port=0)).start()
+        try:
+            p = d.config.port
+            status, page = call(p, "GET", "/")
+            assert status == 200 and "MyEval" in page
+            status, txt = call(
+                p, "GET", f"/engine_instances/{iid}/evaluator_results.txt")
+            assert txt == "score: 0.9"
+            status, j = call(
+                p, "GET", f"/engine_instances/{iid}/evaluator_results.json")
+            assert j == {"score": 0.9}
+            status, _ = call(
+                p, "GET", "/engine_instances/nope/evaluator_results.txt")
+            assert status == 404
+        finally:
+            d.stop()
+
+
+class TestAdminServer:
+    def test_app_rest(self, tmp_env):
+        from predictionio_tpu.tools.admin import (AdminServer,
+                                                  AdminServerConfig)
+        s = AdminServer(AdminServerConfig(ip="127.0.0.1", port=0)).start()
+        try:
+            p = s.config.port
+            status, body = call(p, "GET", "/")
+            assert body == {"status": "alive"}
+            status, body = call(p, "POST", "/cmd/app", {"name": "adminapp"})
+            assert status == 200 and body["key"]
+            status, body = call(p, "POST", "/cmd/app", {"name": "adminapp"})
+            assert status == 409
+            status, body = call(p, "GET", "/cmd/app")
+            assert [a["name"] for a in body["apps"]] == ["adminapp"]
+            status, body = call(p, "DELETE", "/cmd/app/adminapp/data")
+            assert status == 200
+            status, body = call(p, "DELETE", "/cmd/app/adminapp")
+            assert status == 200
+            status, body = call(p, "GET", "/cmd/app")
+            assert body["apps"] == []
+        finally:
+            s.stop()
