@@ -1,0 +1,183 @@
+"""Benchmark harness: ALS training throughput + REST predict latency.
+
+The reference publishes no numbers (BASELINE.md), so this harness defines
+the measurement: synthetic MovieLens-20M-shaped ratings (138,493 users x
+26,744 items x 20M ratings, power-law popularity), explicit ALS rank=200 —
+the BASELINE.json north-star workload — timed per full iteration (user
+sweep + item sweep, MLlib's iteration unit). Secondary: p50 latency of
+POST /queries.json against the trained model behind the real engine server.
+
+vs_baseline compares against SPARK_CPU_BASELINE_RATINGS_PER_SEC, an assumed
+single-node Spark-1.3 MLlib ALS figure for this workload (the reference's
+substrate; it cannot be measured in this environment). The north-star
+">=10x Spark-on-CPU" therefore corresponds to vs_baseline >= 10.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SPARK_CPU_BASELINE_RATINGS_PER_SEC = 2.0e5
+
+
+def synthetic_ml20m(n_users, n_items, nnz, seed=0):
+    """Power-law popularity + lognormal user activity, ML-20M shaped."""
+    rng = np.random.default_rng(seed)
+    # user activity: lognormal, scaled to sum ~ nnz
+    raw = rng.lognormal(mean=0.0, sigma=1.1, size=n_users)
+    counts = np.maximum(1, (raw / raw.sum() * nnz)).astype(np.int64)
+    diff = nnz - counts.sum()
+    counts[0] += max(diff, 1 - counts[0])
+    user_idx = np.repeat(np.arange(n_users, dtype=np.int32),
+                         counts).astype(np.int32)
+    total = user_idx.shape[0]
+    # item popularity: zipf-ish
+    pop = 1.0 / np.arange(1, n_items + 1) ** 1.1
+    pop /= pop.sum()
+    item_idx = rng.choice(n_items, size=total, p=pop).astype(np.int32)
+    rating = rng.integers(1, 6, size=total).astype(np.float32)
+    return user_idx, item_idx, rating
+
+
+def bench_als(full_scale: bool):
+    from predictionio_tpu.ops.als import ALSConfig, als_train, als_rmse
+    from predictionio_tpu.ops.ratings import RatingsCOO
+    from predictionio_tpu.parallel.mesh import current_mesh
+
+    if full_scale:
+        n_users, n_items, nnz, rank = 138_493, 26_744, 20_000_000, 200
+        iters_timed = 2
+    else:  # CPU smoke mode
+        n_users, n_items, nnz, rank = 2_000, 500, 60_000, 32
+        iters_timed = 2
+
+    t0 = time.perf_counter()
+    ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
+    ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
+    gen_s = time.perf_counter() - t0
+
+    mesh = current_mesh()
+    base = dict(rank=rank, lam=0.05, seed=1,
+                compute_dtype=("bfloat16" if full_scale else "float32"),
+                work_budget=(1 << 20))
+
+    # warmup: compiles every bucket kernel (first compile is the slow part)
+    t0 = time.perf_counter()
+    als_train(ratings, ALSConfig(iterations=1, **base), mesh)
+    warm_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    model = als_train(ratings, ALSConfig(iterations=iters_timed, **base),
+                      mesh)
+    train_s = time.perf_counter() - t0
+    ratings_per_sec = ratings.nnz * iters_timed / train_s
+
+    # sanity: the factorization actually fits the data
+    sample = np.random.default_rng(0).choice(ratings.nnz,
+                                             min(200_000, ratings.nnz),
+                                             replace=False)
+    sub = RatingsCOO(ui[sample], ii[sample], vv[sample], n_users, n_items)
+    rmse = als_rmse(model, sub)
+
+    return {
+        "ratings_per_sec_per_chip": ratings_per_sec,
+        "train_s_per_iteration": train_s / iters_timed,
+        "warmup_s": warm_s,
+        "datagen_s": gen_s,
+        "nnz": ratings.nnz,
+        "rank": rank,
+        "train_rmse_sample": rmse,
+    }, model
+
+
+def bench_rest_latency(model, n_queries=200):
+    """p50 of POST /queries.json against the trained model via the real
+    engine server (loopback HTTP)."""
+    import urllib.request
+
+    from predictionio_tpu.core import EngineParams, FirstServing
+    from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap
+    from predictionio_tpu.data.storage.base import EngineInstance
+    from predictionio_tpu.models import recommendation as R
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    import datetime as dt
+
+    n_users = model.user_factors.shape[0]
+    n_items = model.item_factors.shape[0]
+    user_ix = EntityIdIxMap(
+        BiMap({str(i): i for i in range(n_users)}))
+    item_ix = EntityIdIxMap(
+        BiMap({str(i): i for i in range(n_items)}))
+    rec_model = R.RecommendationModel(model, user_ix, item_ix)
+    algo = R.ALSAlgorithm(R.ALSAlgorithmParams(rank=model.rank))
+
+    engine = R.RecommendationEngineFactory.apply()
+    server = EngineServer(ServerConfig(ip="127.0.0.1", port=0),
+                          engine=engine)
+    now = dt.datetime.now(dt.timezone.utc)
+    server.engine_instance = EngineInstance(
+        id="bench", status="COMPLETED", start_time=now, end_time=now,
+        engine_id="bench", engine_version="0", engine_variant="bench",
+        engine_factory="recommendation")
+    server.algorithms = [algo]
+    server.models = [rec_model]
+    server.serving = FirstServing()
+    server.start()
+    try:
+        port = server.config.port
+        rng = np.random.default_rng(0)
+        users = rng.integers(0, n_users, n_queries)
+        # warmup (jit of the top-k scorer)
+        for u in users[:10]:
+            _post(port, {"user": str(int(u)), "num": 10})
+        lat = []
+        for u in users:
+            t0 = time.perf_counter()
+            _post(port, {"user": str(int(u)), "num": 10})
+            lat.append(time.perf_counter() - t0)
+        lat = np.array(lat)
+        return {"p50_ms": float(np.percentile(lat, 50) * 1000),
+                "p95_ms": float(np.percentile(lat, 95) * 1000),
+                "qps_serial": float(1.0 / lat.mean())}
+    finally:
+        server.stop()
+
+
+def _post(port, body):
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.read()
+
+
+def main():
+    import jax
+    backend = jax.default_backend()
+    full_scale = backend not in ("cpu",)
+    als_stats, model = bench_als(full_scale)
+    rest_stats = bench_rest_latency(model)
+    value = als_stats["ratings_per_sec_per_chip"]
+    out = {
+        "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "ratings/s/chip",
+        "vs_baseline": round(value / SPARK_CPU_BASELINE_RATINGS_PER_SEC, 3),
+        "backend": backend,
+        "full_scale": full_scale,
+        **{k: (round(v, 4) if isinstance(v, float) else v)
+           for k, v in als_stats.items() if k != "ratings_per_sec_per_chip"},
+        **{k: round(v, 3) for k, v in rest_stats.items()},
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,11 @@ class ALSConfig:
     seed: int = 0
     work_budget: int = 1 << 20         # B*K per solve batch
     compute_dtype: str = "float32"     # einsum dtype ('bfloat16' on TPU ok)
+    factor_sharding: str = "replicated"  # 'replicated' | 'model'
+    # 'model' shards factor-table rows over the mesh model axis (tables too
+    # large for one device's HBM); GSPMD inserts the all-gathers the
+    # per-batch index gathers need — the analog of MLlib's factor-block
+    # shuffles, but compiler-scheduled over ICI.
 
 
 @dataclass
@@ -129,11 +134,16 @@ def _gram(factors):
 # Training driver
 # ---------------------------------------------------------------------------
 
-def _init_factors(n: int, rank: int, seed: int, salt: int) -> np.ndarray:
+def _init_factors(n: int, rank: int, seed: int, salt: int,
+                  row_multiple: int = 1) -> np.ndarray:
     # MLlib seeds factors with abs(normal)/sqrt(rank) per block; we use a
-    # deterministic numpy RNG — scale keeps initial predictions O(1)
+    # deterministic numpy RNG — scale keeps initial predictions O(1).
+    # At least one trailing dummy row is allocated (the scatter target for
+    # padding); total rows are rounded up so a model-axis sharding divides.
+    rows = n + 1
+    rows = ((rows + row_multiple - 1) // row_multiple) * row_multiple
     rng = np.random.default_rng(seed * 2654435761 % (2 ** 31) + salt)
-    f = rng.standard_normal((n + 1, rank), dtype=np.float32)
+    f = rng.standard_normal((rows, rank), dtype=np.float32)
     return np.abs(f) / np.sqrt(rank)
 
 
@@ -172,17 +182,23 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
         len(user_plan.batches), user_plan.kernel_shapes,
         len(item_plan.batches), item_plan.kernel_shapes)
 
-    U = mesh.put_replicated(_init_factors(ratings.n_users, cfg.rank,
-                                          cfg.seed, 1))
-    V = mesh.put_replicated(_init_factors(ratings.n_items, cfg.rank,
-                                          cfg.seed, 2))
+    if cfg.factor_sharding == "model":
+        put_factors = mesh.put_model_sharded
+        row_multiple = mesh.model_parallelism
+    else:
+        put_factors = mesh.put_replicated
+        row_multiple = 1
+    U = put_factors(_init_factors(ratings.n_users, cfg.rank, cfg.seed, 1,
+                                  row_multiple))
+    V = put_factors(_init_factors(ratings.n_items, cfg.rank, cfg.seed, 2,
+                                  row_multiple))
     for it in range(cfg.iterations):
-        gram_v = _gram(V[:-1]) if cfg.implicit_prefs else None
+        gram_v = _gram(V[:ratings.n_items]) if cfg.implicit_prefs else None
         U = _run_side(mesh, user_plan, U, V, cfg, gram_v)
-        gram_u = _gram(U[:-1]) if cfg.implicit_prefs else None
+        gram_u = _gram(U[:ratings.n_users]) if cfg.implicit_prefs else None
         V = _run_side(mesh, item_plan, V, U, cfg, gram_u)
-    U_host = np.asarray(U)[:-1]
-    V_host = np.asarray(V)[:-1]
+    U_host = np.asarray(U)[:ratings.n_users]
+    V_host = np.asarray(V)[:ratings.n_items]
     return ALSModel(user_factors=U_host, item_factors=V_host, rank=cfg.rank)
 
 

@@ -120,6 +120,11 @@ class MeshContext:
         jax = _jax()
         return jax.device_put(x, self.replicated())
 
+    def put_model_sharded(self, x):
+        """Rows sharded over the model axis (embedding tables)."""
+        jax = _jax()
+        return jax.device_put(x, self.model_sharded(np.ndim(x)))
+
     def pad_to_multiple(self, x: np.ndarray, axis: int = 0,
                         multiple: Optional[int] = None,
                         fill=0) -> Tuple[np.ndarray, int]:
