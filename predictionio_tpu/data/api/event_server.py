@@ -47,7 +47,7 @@ class EventServerConfig:
 class EventServer:
     def __init__(self, config: EventServerConfig = EventServerConfig(),
                  access_keys=None, channels=None, events=None,
-                 webhook_connectors=None):
+                 webhook_connectors=None, plugin_context=None):
         self.config = config
         self._access_keys = access_keys
         self._channels = channels
@@ -57,6 +57,11 @@ class EventServer:
             from predictionio_tpu.data.webhooks import default_connectors
             webhook_connectors = default_connectors()
         self.webhook_connectors = webhook_connectors
+        if plugin_context is None:
+            from predictionio_tpu.data.api.plugins import \
+                EventServerPluginContext
+            plugin_context = EventServerPluginContext.load_from_env()
+        self.plugin_context = plugin_context
         self.router = self._build_router()
         self.server: Optional[HttpServer] = None
 
@@ -116,6 +121,10 @@ class EventServer:
         event = Event.from_dict(d)
         self._check_event_allowed(access_key, event.event)
         EventValidation.validate(event)
+        # inputblocker plugins may veto (EventServer.scala:239)
+        self.plugin_context.check_input(
+            {"appId": access_key.appid, "channelId": channel_id,
+             "event": d})
         event_id = self.events.insert(event, access_key.appid, channel_id)
         if self.config.stats:
             self.stats.update(access_key.appid, event.event,
@@ -246,6 +255,8 @@ class EventServer:
             return wrapped
 
         r.add("GET", "/", self._status)
+        r.add("GET", "/plugins.json",
+              lambda req: Response(200, self.plugin_context.to_dict()))
         r.add("POST", "/events.json", guarded(self._create_event))
         r.add("GET", "/events.json", guarded(self._find_events))
         r.add("POST", "/batch/events.json", guarded(self._batch_create))

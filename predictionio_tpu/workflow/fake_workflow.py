@@ -1,0 +1,59 @@
+"""Dev/test harness: run an arbitrary function through the evaluation
+plumbing.
+
+Rebuilds the reference's ``FakeWorkflow``/``FakeRun``
+(reference: core/src/main/scala/io/prediction/workflow/FakeWorkflow.scala:93+):
+a developer can push any `fn(mesh) -> None` through the full evaluation
+lifecycle (EvaluationInstance records included) without writing DASE
+components — useful for smoke-testing storage + mesh wiring.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from predictionio_tpu.core import (DataSource, Engine, EngineParams,
+                                   Evaluation, FirstServing,
+                                   IdentityPreparator, LAlgorithm, Metric,
+                                   ZeroMetric)
+from predictionio_tpu.parallel.mesh import MeshContext, current_mesh
+
+
+class _FakeDataSource(DataSource):
+    def read_training(self):
+        return None
+
+    def read_eval(self):
+        return [(None, None, [(None, None)])]
+
+
+class _FakeAlgorithm(LAlgorithm):
+    fn: Callable[[MeshContext], None] = staticmethod(lambda mesh: None)
+
+    def train(self, pd):
+        return None
+
+    def predict(self, model, query):
+        type(self).fn(current_mesh())
+        return None
+
+
+class FakeRun(Evaluation):
+    """Evaluation that just runs `fn(mesh)` once (FakeWorkflow.scala FakeRun)."""
+
+    def __init__(self, fn: Callable[[MeshContext], None]):
+        algo_cls = type("_FakeAlgo", (_FakeAlgorithm,),
+                        {"fn": staticmethod(fn)})
+        self.engine = Engine({"": _FakeDataSource}, {"": IdentityPreparator},
+                             {"": algo_cls}, {"": FirstServing})
+        self.metric = ZeroMetric()
+        self.engine_params_list = [EngineParams()]
+
+
+def run_fake(fn: Callable[[MeshContext], None]) -> str:
+    """Run fn through the evaluation workflow; returns the
+    EvaluationInstance id."""
+    from predictionio_tpu.workflow.core_workflow import run_evaluation
+    fake = FakeRun(fn)
+    return run_evaluation(fake.engine, fake, fake.engine_params_list,
+                          evaluation_class="FakeRun")

@@ -1,0 +1,98 @@
+"""Event/engine-server plugin + FakeWorkflow tests."""
+
+import pytest
+
+from predictionio_tpu.data.api.plugins import (INPUT_BLOCKER, INPUT_SNIFFER,
+                                               EventServerPlugin,
+                                               EventServerPluginContext)
+from predictionio_tpu.serving.plugins import (OUTPUT_BLOCKER,
+                                              EngineServerPlugin,
+                                              EngineServerPluginContext)
+
+
+class RejectBuys(EventServerPlugin):
+    plugin_name = "rejectbuys"
+    input_type = INPUT_BLOCKER
+
+    def process(self, event_info, context):
+        if event_info["event"].get("event") == "buy":
+            raise ValueError("buys are blocked")
+
+
+class CountSniffer(EventServerPlugin):
+    plugin_name = "counter"
+    input_type = INPUT_SNIFFER
+    seen = 0
+
+    def process(self, event_info, context):
+        CountSniffer.seen += 1
+
+
+class TestEventServerPlugins:
+    def test_blocker_rejects_and_sniffer_observes(self, tmp_env):
+        import json
+        import urllib.request
+        import urllib.error
+
+        from predictionio_tpu.data.api.event_server import (
+            EventServer, EventServerConfig)
+        from predictionio_tpu.data.storage import AccessKey, App, Storage
+        app_id = Storage.get_meta_data_apps().insert(App(0, "plapp"))
+        Storage.get_events().init(app_id)
+        Storage.get_meta_data_access_keys().insert(
+            AccessKey("pk", app_id, []))
+        ctx = EventServerPluginContext()
+        ctx.register(RejectBuys())
+        ctx.register(CountSniffer())
+        CountSniffer.seen = 0
+        s = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                        plugin_context=ctx).start()
+        try:
+            def post(ev):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{s.config.port}/events.json"
+                    "?accessKey=pk",
+                    data=json.dumps(ev).encode(), method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    return e.code
+
+            ok = {"event": "rate", "entityType": "u", "entityId": "1"}
+            blocked = {"event": "buy", "entityType": "u", "entityId": "1"}
+            assert post(ok) == 201
+            assert post(blocked) == 400
+            assert CountSniffer.seen == 1  # only accepted events sniffed
+            assert len(list(Storage.get_events().find(app_id))) == 1
+        finally:
+            s.stop()
+
+
+class Redactor(EngineServerPlugin):
+    plugin_name = "redactor"
+    output_type = OUTPUT_BLOCKER
+
+    def process(self, engine_instance, query, prediction, context):
+        return {**prediction, "redacted": True}
+
+
+class TestEngineServerPlugins:
+    def test_output_blocker_transforms(self):
+        ctx = EngineServerPluginContext()
+        ctx.register(Redactor())
+        out = ctx.apply_output(None, {"q": 1}, {"itemScores": []})
+        assert out == {"itemScores": [], "redacted": True}
+        assert "redactor" in ctx.to_dict()["plugins"][OUTPUT_BLOCKER]
+
+
+class TestFakeWorkflow:
+    def test_run_fake_runs_fn_through_eval_plumbing(self, tmp_env, mesh8):
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.workflow.fake_workflow import run_fake
+        calls = []
+        iid = run_fake(lambda mesh: calls.append(mesh.n_devices))
+        assert calls == [8]
+        inst = Storage.get_meta_data_evaluation_instances().get(iid)
+        assert inst.status == "EVALCOMPLETED"
+        assert inst.evaluation_class == "FakeRun"
