@@ -1,0 +1,234 @@
+// Native append-only event log with hash index and coarse scan filters.
+//
+// Plays the role of the reference's HBase event-store backend
+// (reference: data/src/main/scala/io/prediction/data/storage/hbase/ —
+// rowkey = md5(entity) ++ millis ++ uuid, HBEventsUtil.scala:81-129, and
+// time-ranged scans, :286-410) as the high-throughput durable store behind
+// the Python Events interface: C++ owns file IO, the id index, and coarse
+// predicate filtering (time range, entity hash, event-name hash); Python
+// deserializes only the surviving records.
+//
+// File format: sequence of records
+//   u8  type        (1 = event, 2 = tombstone)
+//   u16 keylen
+//   u32 datalen
+//   i64 ts_millis   (event time)
+//   u64 entity_hash (FNV-1a of "entityType\x00entityId")
+//   u64 name_hash   (FNV-1a of event name)
+//   u64 target_hash (FNV-1a of "targetType\x00targetId", 0 when absent)
+//   key bytes, data bytes
+//
+// Concurrency: one mutex per handle; scan state is per-handle (the Python
+// wrapper serializes scans per handle).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct RecordHeader {
+  uint8_t type;
+  uint16_t keylen;
+  uint32_t datalen;
+  int64_t ts;
+  uint64_t entity_hash;
+  uint64_t name_hash;
+  uint64_t target_hash;
+} __attribute__((packed));
+
+struct IndexEntry {
+  uint64_t offset;   // offset of the record header
+  uint32_t datalen;
+  int64_t ts;
+  uint64_t entity_hash;
+  uint64_t name_hash;
+  uint64_t target_hash;
+  bool deleted;
+};
+
+struct Handle {
+  FILE* f = nullptr;
+  std::mutex mu;
+  std::unordered_map<std::string, IndexEntry> index;
+  std::vector<std::string> order;  // insertion order of live keys
+  // scan state
+  std::vector<const std::string*> scan_keys;
+  std::vector<uint8_t> fetch_buf;
+};
+
+uint64_t fnv1a(const uint8_t* data, size_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (size_t i = 0; i < len; i++) {
+    h ^= data[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+bool read_exact(FILE* f, void* buf, size_t n) {
+  return fread(buf, 1, n, f) == n;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t el_hash(const uint8_t* data, int32_t len) {
+  return fnv1a(data, (size_t)len);
+}
+
+void* el_open(const char* path) {
+  Handle* h = new Handle();
+  h->f = fopen(path, "a+b");
+  if (!h->f) {
+    delete h;
+    return nullptr;
+  }
+  // build index by scanning
+  fseeko(h->f, 0, SEEK_SET);
+  RecordHeader rh;
+  std::vector<char> key;
+  while (true) {
+    uint64_t off = (uint64_t)ftello(h->f);
+    if (!read_exact(h->f, &rh, sizeof(rh))) break;
+    key.resize(rh.keylen);
+    if (rh.keylen && !read_exact(h->f, key.data(), rh.keylen)) break;
+    if (fseeko(h->f, rh.datalen, SEEK_CUR) != 0) break;
+    std::string k(key.data(), rh.keylen);
+    if (rh.type == 2) {  // tombstone
+      auto it = h->index.find(k);
+      if (it != h->index.end()) it->second.deleted = true;
+    } else {
+      bool existed = h->index.count(k) != 0;
+      h->index[k] = IndexEntry{off, rh.datalen, rh.ts, rh.entity_hash,
+                               rh.name_hash, rh.target_hash, false};
+      if (!existed) h->order.push_back(k);
+    }
+  }
+  fseeko(h->f, 0, SEEK_END);
+  return h;
+}
+
+void el_close(void* vh) {
+  Handle* h = (Handle*)vh;
+  if (!h) return;
+  if (h->f) fclose(h->f);
+  delete h;
+}
+
+int el_append(void* vh, const uint8_t* key, int32_t keylen,
+              const uint8_t* data, int32_t datalen, int64_t ts,
+              uint64_t entity_hash, uint64_t name_hash,
+              uint64_t target_hash) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  RecordHeader rh{1, (uint16_t)keylen, (uint32_t)datalen, ts, entity_hash,
+                  name_hash, target_hash};
+  fseeko(h->f, 0, SEEK_END);
+  uint64_t off = (uint64_t)ftello(h->f);
+  if (fwrite(&rh, 1, sizeof(rh), h->f) != sizeof(rh)) return -1;
+  if (keylen && fwrite(key, 1, keylen, h->f) != (size_t)keylen) return -1;
+  if (datalen && fwrite(data, 1, datalen, h->f) != (size_t)datalen)
+    return -1;
+  std::string k((const char*)key, keylen);
+  bool existed = h->index.count(k) != 0;
+  h->index[k] = IndexEntry{off, (uint32_t)datalen, ts, entity_hash,
+                           name_hash, target_hash, false};
+  if (!existed) h->order.push_back(k);
+  return 0;
+}
+
+int el_flush(void* vh) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  return fflush(h->f);
+}
+
+// returns datalen and fills fetch_buf, or -1 when missing/deleted
+int64_t el_get(void* vh, const uint8_t* key, int32_t keylen) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  auto it = h->index.find(std::string((const char*)key, keylen));
+  if (it == h->index.end() || it->second.deleted) return -1;
+  const IndexEntry& e = it->second;
+  h->fetch_buf.resize(e.datalen);
+  fseeko(h->f, (off_t)(e.offset + sizeof(RecordHeader) + keylen), SEEK_SET);
+  if (!read_exact(h->f, h->fetch_buf.data(), e.datalen)) return -1;
+  fseeko(h->f, 0, SEEK_END);
+  return (int64_t)e.datalen;
+}
+
+const uint8_t* el_buf(void* vh) {
+  Handle* h = (Handle*)vh;
+  return h->fetch_buf.data();
+}
+
+int el_delete(void* vh, const uint8_t* key, int32_t keylen) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  auto it = h->index.find(std::string((const char*)key, keylen));
+  if (it == h->index.end() || it->second.deleted) return -1;
+  it->second.deleted = true;
+  RecordHeader rh{2, (uint16_t)keylen, 0, 0, 0, 0, 0};
+  fseeko(h->f, 0, SEEK_END);
+  fwrite(&rh, 1, sizeof(rh), h->f);
+  fwrite(key, 1, keylen, h->f);
+  return 0;
+}
+
+// Coarse scan: collect keys of live records passing the pushed-down
+// predicates. 0-valued hash filters mean "no filter"; name_hashes is an
+// optional array (OR semantics). Returns the match count; keys are fetched
+// with el_scan_key.
+int64_t el_scan(void* vh, int64_t start_ts, int64_t until_ts,
+                uint64_t entity_hash, const uint64_t* name_hashes,
+                int32_t n_names, uint64_t target_hash) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  h->scan_keys.clear();
+  for (const std::string& k : h->order) {
+    auto it = h->index.find(k);
+    if (it == h->index.end() || it->second.deleted) continue;
+    const IndexEntry& e = it->second;
+    if (start_ts != INT64_MIN && e.ts < start_ts) continue;
+    if (until_ts != INT64_MIN && e.ts >= until_ts) continue;
+    if (entity_hash != 0 && e.entity_hash != entity_hash) continue;
+    if (target_hash != 0 && e.target_hash != target_hash) continue;
+    if (n_names > 0) {
+      bool ok = false;
+      for (int32_t i = 0; i < n_names; i++) {
+        if (e.name_hash == name_hashes[i]) { ok = true; break; }
+      }
+      if (!ok) continue;
+    }
+    h->scan_keys.push_back(&it->first);
+  }
+  return (int64_t)h->scan_keys.size();
+}
+
+// Fetch the i-th scan result's key; returns key length (buffer valid until
+// the next call on this handle).
+int64_t el_scan_key(void* vh, int64_t i, const uint8_t** out) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  if (i < 0 || (size_t)i >= h->scan_keys.size()) return -1;
+  const std::string& k = *h->scan_keys[(size_t)i];
+  *out = (const uint8_t*)k.data();
+  return (int64_t)k.size();
+}
+
+int64_t el_count(void* vh) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  int64_t n = 0;
+  for (auto& kv : h->index)
+    if (!kv.second.deleted) n++;
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,266 @@
+"""Native (C++) append-log event store backend.
+
+The high-throughput durable backend, playing the reference's HBase role
+(reference: data/src/main/scala/io/prediction/data/storage/hbase/ —
+HBLEvents/HBPEvents over time-ranged scans). The C++ library
+(native/eventlog.cpp, built to native/build/libpio_eventlog.so via `make`)
+owns file IO, the id index, and coarse predicate filtering (time range +
+entity/name/target hashes); this wrapper serializes events as JSON blobs
+and applies the exact residual filters.
+
+Configure with PIO_STORAGE_SOURCES_<S>_TYPE=nativelog and _PATH=<dir>;
+one log file per (app, channel) namespace, like HBase's table-per-channel.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Tuple
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import (Event, from_millis, new_event_id,
+                                         to_millis)
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import ABSENT
+
+_LIB_LOCK = threading.Lock()
+_LIB = None
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libpio_eventlog.so")
+
+
+def _load_lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        if not os.path.exists(_SO_PATH):
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True)
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.el_open.restype = ctypes.c_void_p
+        lib.el_open.argtypes = [ctypes.c_char_p]
+        lib.el_close.argtypes = [ctypes.c_void_p]
+        lib.el_hash.restype = ctypes.c_uint64
+        lib.el_hash.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+        lib.el_append.restype = ctypes.c_int
+        lib.el_append.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+        lib.el_get.restype = ctypes.c_int64
+        lib.el_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int32]
+        lib.el_buf.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.el_buf.argtypes = [ctypes.c_void_p]
+        lib.el_delete.restype = ctypes.c_int
+        lib.el_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int32]
+        lib.el_flush.argtypes = [ctypes.c_void_p]
+        lib.el_scan.restype = ctypes.c_int64
+        lib.el_scan.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int32, ctypes.c_uint64]
+        lib.el_scan_key.restype = ctypes.c_int64
+        lib.el_scan_key.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.el_count.restype = ctypes.c_int64
+        lib.el_count.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+_INT64_MIN = -(2 ** 63)
+
+
+def _hash(lib, s: str) -> int:
+    b = s.encode("utf-8")
+    return lib.el_hash(b, len(b))
+
+
+class StorageClient:
+    def __init__(self, config):
+        self.config = config
+        self.path = (config.get("PATH") or config.get("HOSTS")
+                     or os.path.join(os.path.expanduser("~/.pio_store"),
+                                     "eventlog"))
+        os.makedirs(self.path, exist_ok=True)
+        self.lib = _load_lib()
+        self._objects = {}
+
+    def get_data_object(self, kind: str, namespace: str):
+        if kind != "events":
+            raise ValueError(
+                f"nativelog backend only stores events, not {kind}")
+        if namespace not in self._objects:
+            self._objects[namespace] = NativeLogEvents(
+                self.lib, os.path.join(self.path, namespace))
+        return self._objects[namespace]
+
+    def close(self):
+        for obj in self._objects.values():
+            obj.close()
+        self._objects.clear()
+
+
+class NativeLogEvents(base.Events):
+    def __init__(self, lib, root: str):
+        self.lib = lib
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._handles: Dict[Tuple[int, Optional[int]], int] = {}
+        self._lock = threading.RLock()
+
+    def _handle(self, app_id: int, channel_id: Optional[int],
+                create: bool = True) -> Optional[int]:
+        key = (app_id, channel_id)
+        with self._lock:
+            if key not in self._handles:
+                path = os.path.join(
+                    self.root,
+                    f"events_{app_id}_{channel_id or 0}.log")
+                if not create and not os.path.exists(path):
+                    return None
+                h = self.lib.el_open(path.encode())
+                if not h:
+                    raise IOError(f"cannot open event log {path}")
+                self._handles[key] = h
+            return self._handles[key]
+
+    def close(self):
+        with self._lock:
+            for h in self._handles.values():
+                self.lib.el_close(h)
+            self._handles.clear()
+
+    # -- Events interface ---------------------------------------------------
+    def init(self, app_id, channel_id=None) -> bool:
+        self._handle(app_id, channel_id)
+        return True
+
+    def remove(self, app_id, channel_id=None) -> bool:
+        key = (app_id, channel_id)
+        with self._lock:
+            if key in self._handles:
+                self.lib.el_close(self._handles.pop(key))
+            path = os.path.join(
+                self.root, f"events_{app_id}_{channel_id or 0}.log")
+            if os.path.exists(path):
+                os.remove(path)
+                return True
+            return False
+
+    @staticmethod
+    def _entity_key(e: Event) -> str:
+        return f"{e.entity_type}\x00{e.entity_id}"
+
+    @staticmethod
+    def _target_key(e: Event) -> str:
+        if e.target_entity_type is None:
+            return ""
+        return f"{e.target_entity_type}\x00{e.target_entity_id}"
+
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        h = self._handle(app_id, channel_id)
+        eid = event.event_id or new_event_id()
+        payload = json.dumps(
+            event.with_id(eid).to_dict(), separators=(",", ":")
+        ).encode("utf-8")
+        key = eid.encode("utf-8")
+        target = self._target_key(event)
+        rc = self.lib.el_append(
+            h, key, len(key), payload, len(payload),
+            to_millis(event.event_time),
+            _hash(self.lib, self._entity_key(event)),
+            _hash(self.lib, event.event),
+            _hash(self.lib, target) if target else 0)
+        if rc != 0:
+            raise IOError("append failed")
+        return eid
+
+    def insert_batch(self, events, app_id, channel_id=None):
+        with self._lock:
+            eids = [self.insert(e, app_id, channel_id) for e in events]
+            self.lib.el_flush(self._handle(app_id, channel_id))
+            return eids
+
+    def _decode(self, h, eid_bytes: bytes) -> Optional[Event]:
+        n = self.lib.el_get(h, eid_bytes, len(eid_bytes))
+        if n < 0:
+            return None
+        buf = ctypes.string_at(self.lib.el_buf(h), n)
+        return Event.from_dict(json.loads(buf.decode("utf-8")))
+
+    def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
+        h = self._handle(app_id, channel_id, create=False)
+        if h is None:
+            return None
+        with self._lock:
+            return self._decode(h, event_id.encode("utf-8"))
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        h = self._handle(app_id, channel_id, create=False)
+        if h is None:
+            return False
+        with self._lock:
+            return self.lib.el_delete(h, event_id.encode(),
+                                      len(event_id.encode())) == 0
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None, limit=None,
+             reversed_order=False):
+        h = self._handle(app_id, channel_id, create=False)
+        if h is None:
+            return iter(())
+        # pushed-down coarse filters
+        entity_hash = 0
+        if entity_type is not None and entity_id is not None:
+            entity_hash = _hash(self.lib, f"{entity_type}\x00{entity_id}")
+        target_hash = 0
+        if (target_entity_type not in (None, ABSENT)
+                and target_entity_id not in (None, ABSENT)):
+            target_hash = _hash(
+                self.lib, f"{target_entity_type}\x00{target_entity_id}")
+        if event_names:
+            arr = (ctypes.c_uint64 * len(event_names))(
+                *[_hash(self.lib, n) for n in event_names])
+            n_names = len(event_names)
+        else:
+            arr = None
+            n_names = 0
+        with self._lock:
+            count = self.lib.el_scan(
+                h,
+                to_millis(start_time) if start_time else _INT64_MIN,
+                to_millis(until_time) if until_time else _INT64_MIN,
+                entity_hash, arr, n_names, target_hash)
+            events = []
+            for i in range(count):
+                out = ctypes.POINTER(ctypes.c_uint8)()
+                klen = self.lib.el_scan_key(h, i, ctypes.byref(out))
+                if klen < 0:
+                    continue
+                eid = ctypes.string_at(out, klen)
+                e = self._decode(h, eid)
+                if e is None:
+                    continue
+                # exact residual filtering (hash false-positives + partial
+                # predicates the coarse pass cannot express)
+                if base.match_event(e, start_time, until_time, entity_type,
+                                    entity_id, event_names,
+                                    target_entity_type, target_entity_id):
+                    events.append(e)
+        events.sort(key=lambda e: e.event_time, reverse=reversed_order)
+        if limit is not None and limit >= 0:
+            events = events[:limit]
+        return iter(events)

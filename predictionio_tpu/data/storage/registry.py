@@ -97,6 +97,7 @@ def _backend_module(type_: str):
         "memory": "predictionio_tpu.data.storage.memory",
         "localfs": "predictionio_tpu.data.storage.localfs",
         "pgsql": "predictionio_tpu.data.storage.sqlite",  # same SQL DAO family
+        "nativelog": "predictionio_tpu.data.storage.nativelog",  # C++ log
     }
     if type_ not in modules:
         raise StorageError(f"Unknown storage source type: {type_}. "

@@ -26,10 +26,35 @@ def t(sec):
     return dt.datetime(2026, 1, 1, 0, 0, sec, tzinfo=UTC)
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+class _CompositeClient:
+    """events from one backend, metadata from another (the nativelog
+    backend stores only events, like the reference's HBase event store)."""
+
+    def __init__(self, events_client, meta_client):
+        self.events_client = events_client
+        self.meta_client = meta_client
+
+    def get_data_object(self, kind, namespace):
+        if kind == "events":
+            return self.events_client.get_data_object(kind, namespace)
+        return self.meta_client.get_data_object(kind, namespace)
+
+    def close(self):
+        self.events_client.close()
+        self.meta_client.close()
+
+
+@pytest.fixture(params=["memory", "sqlite", "nativelog"])
 def client(request, tmp_path):
     if request.param == "memory":
         c = MemClient(StorageClientConfig("TEST", "memory", {}))
+    elif request.param == "nativelog":
+        from predictionio_tpu.data.storage.nativelog import \
+            StorageClient as NativeClient
+        c = _CompositeClient(
+            NativeClient(StorageClientConfig(
+                "TEST", "nativelog", {"PATH": str(tmp_path / "log")})),
+            MemClient(StorageClientConfig("TEST", "memory", {})))
     else:
         c = SQLClient(StorageClientConfig(
             "TEST", "sqlite", {"URL": str(tmp_path / "t.db")}))
