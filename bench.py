@@ -24,6 +24,11 @@ import numpy as np
 
 SPARK_CPU_BASELINE_RATINGS_PER_SEC = 2.0e5
 
+# persistent XLA compilation cache: warmup compiles are paid once per
+# machine, not per run
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/pio_tpu_xla_cache")
+
 
 def synthetic_ml20m(n_users, n_items, nnz, seed=0):
     """Power-law popularity + lognormal user activity, ML-20M shaped."""
@@ -60,6 +65,14 @@ def bench_als(full_scale: bool):
     ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
     ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
     gen_s = time.perf_counter() - t0
+
+    try:
+        import jax
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.set_cache_dir(
+            os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:
+        pass
 
     mesh = current_mesh()
     base = dict(rank=rank, lam=0.05, seed=1,

@@ -1,0 +1,65 @@
+"""Batch views over the event stream.
+
+Rebuilds the reference's view helpers
+(reference: data/src/main/scala/io/prediction/data/view/{LBatchView,
+PBatchView,DataView}.scala): aggregate-properties-at-a-time-point views and
+a flattened tabular view of events for ad-hoc analysis. The DataFrame of
+DataView.create becomes a dict-of-numpy-columns, ready for host analysis or
+mesh ingest.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.aggregator import aggregate_properties
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event, to_millis
+from predictionio_tpu.data.store.event_store import EventStore
+
+
+class BatchView:
+    """Materialized snapshot of an app's events (LBatchView/PBatchView)."""
+
+    def __init__(self, app_name: str, store: Optional[EventStore] = None,
+                 channel_name: Optional[str] = None,
+                 start_time: Optional[_dt.datetime] = None,
+                 until_time: Optional[_dt.datetime] = None):
+        store = store or EventStore()
+        self.events = list(store.find(
+            app_name=app_name, channel_name=channel_name,
+            start_time=start_time, until_time=until_time))
+
+    def aggregate_properties(self, entity_type: str
+                             ) -> Dict[str, PropertyMap]:
+        return aggregate_properties(
+            e for e in self.events if e.entity_type == entity_type)
+
+    def filter(self, **kw) -> Sequence[Event]:
+        from predictionio_tpu.data.storage.base import match_event
+        return [e for e in self.events if match_event(e, **kw)]
+
+
+def data_view(app_name: str, store: Optional[EventStore] = None,
+              channel_name: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Flattened columnar view of events (DataView.create -> DataFrame,
+    view/DataView.scala:58): columns eventId/event/entityType/entityId/
+    targetEntityType/targetEntityId/eventTimeMillis/prId."""
+    store = store or EventStore()
+    events = list(store.find(app_name=app_name, channel_name=channel_name))
+    def col(f, dtype=object):
+        return np.array([f(e) for e in events], dtype=dtype)
+    return {
+        "eventId": col(lambda e: e.event_id or ""),
+        "event": col(lambda e: e.event),
+        "entityType": col(lambda e: e.entity_type),
+        "entityId": col(lambda e: e.entity_id),
+        "targetEntityType": col(lambda e: e.target_entity_type or ""),
+        "targetEntityId": col(lambda e: e.target_entity_id or ""),
+        "eventTimeMillis": col(lambda e: to_millis(e.event_time),
+                               dtype=np.int64),
+        "prId": col(lambda e: e.pr_id or ""),
+    }

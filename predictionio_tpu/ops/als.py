@@ -147,14 +147,20 @@ def _init_factors(n: int, rank: int, seed: int, salt: int,
     return np.abs(f) / np.sqrt(rank)
 
 
-def _run_side(mesh: MeshContext, plan: SolvePlan, factors, counter_factors,
-              cfg: ALSConfig, gram):
+def _upload_plan(mesh: MeshContext, plan: SolvePlan):
+    """Upload every batch once; the index/rating/mask tensors are constant
+    across iterations, so they stay resident in HBM for the whole train
+    (re-uploading per sweep would put ~NNZ*12B on the host<->device link
+    every iteration — the dominant cost on a tunneled chip)."""
+    return [tuple(mesh.put_batch(x)
+                  for x in (b.rows, b.idx, b.val, b.mask))
+            for b in plan.batches]
+
+
+def _run_side(device_batches, factors, counter_factors, cfg: ALSConfig,
+              gram):
     """One half-iteration: solve every batch of one side on the mesh."""
-    for batch in plan.batches:
-        rows = mesh.put_batch(batch.rows)
-        idx = mesh.put_batch(batch.idx)
-        val = mesh.put_batch(batch.val)
-        mask = mesh.put_batch(batch.mask)
+    for rows, idx, val, mask in device_batches:
         factors = _solve_scatter(
             factors, counter_factors, gram, rows, idx, val, mask,
             np.float32(cfg.lam), np.float32(cfg.alpha),
@@ -192,11 +198,13 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
                                   row_multiple))
     V = put_factors(_init_factors(ratings.n_items, cfg.rank, cfg.seed, 2,
                                   row_multiple))
+    user_batches = _upload_plan(mesh, user_plan)
+    item_batches = _upload_plan(mesh, item_plan)
     for it in range(cfg.iterations):
         gram_v = _gram(V[:ratings.n_items]) if cfg.implicit_prefs else None
-        U = _run_side(mesh, user_plan, U, V, cfg, gram_v)
+        U = _run_side(user_batches, U, V, cfg, gram_v)
         gram_u = _gram(U[:ratings.n_users]) if cfg.implicit_prefs else None
-        V = _run_side(mesh, item_plan, V, U, cfg, gram_u)
+        V = _run_side(item_batches, V, U, cfg, gram_u)
     U_host = np.asarray(U)[:ratings.n_users]
     V_host = np.asarray(V)[:ratings.n_items]
     return ALSModel(user_factors=U_host, item_factors=V_host, rank=cfg.rank)
@@ -221,13 +229,16 @@ def recommend_products(model: ALSModel, user_ix: int, k: int,
                        exclude: Optional[np.ndarray] = None
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Top-k items for one user (MatrixFactorizationModel.recommendProducts
-    analog). Returns (scores, item_indices)."""
+    analog). Returns (scores, item_indices). The item-factor table is
+    device-cached — only the query row and mask move per call."""
+    from predictionio_tpu.utils.device_cache import cached_put
     u = model.user_factors[user_ix][None, :]
     seen = np.zeros((1, model.n_items), dtype=bool)
     if exclude is not None and len(exclude):
         seen[0, np.asarray(exclude, dtype=np.int64)] = True
     k_eff = min(k, model.n_items)
-    scores, idx = _topk_scores(u, model.item_factors, seen, k_eff)
+    scores, idx = _topk_scores(u, cached_put(model.item_factors), seen,
+                               k_eff)
     return np.asarray(scores)[0], np.asarray(idx)[0]
 
 
@@ -241,11 +252,13 @@ def predict_ratings(model: ALSModel, user_ix: np.ndarray,
     def _dot(U, V, ui, ii):
         return jnp.sum(U[ui] * V[ii], axis=-1)
 
+    from predictionio_tpu.utils.device_cache import cached_put
+    U = cached_put(model.user_factors)
+    V = cached_put(model.item_factors)
     out = np.empty(len(user_ix), dtype=np.float32)
     for lo in range(0, len(user_ix), chunk):
         sl = slice(lo, lo + chunk)
-        out[sl] = np.asarray(_dot(model.user_factors, model.item_factors,
-                                  np.asarray(user_ix[sl]),
+        out[sl] = np.asarray(_dot(U, V, np.asarray(user_ix[sl]),
                                   np.asarray(item_ix[sl])))
     return out
 

@@ -41,13 +41,14 @@ def cosine_top_k(item_factors_normalized: np.ndarray,
                  allowed_mask: Optional[np.ndarray] = None
                  ) -> Tuple[np.ndarray, np.ndarray]:
     """Returns (scores, item_indices), length <= k, excluding -inf entries."""
+    from predictionio_tpu.utils.device_cache import cached_put
     n_items = item_factors_normalized.shape[0]
     if allowed_mask is None:
         allowed_mask = np.ones(n_items, dtype=bool)
     k_eff = min(k, n_items)
     scores, idx = _cosine_topk(
         np.asarray(query_vecs, dtype=np.float32),
-        item_factors_normalized, allowed_mask, k_eff)
+        cached_put(item_factors_normalized), allowed_mask, k_eff)
     scores = np.asarray(scores)
     idx = np.asarray(idx)
     keep = np.isfinite(scores)

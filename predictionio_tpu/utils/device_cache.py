@@ -1,0 +1,49 @@
+"""Host-array -> device-array cache.
+
+Serving-path fix for SURVEY hard part #4 (serve-time latency from HBM):
+model factor tables live in host numpy after deserialization; without a
+cache every jitted predict call would re-transfer them host->device (hundreds
+of ms for an ML-20M-sized table through a remote-chip tunnel). `cached_put`
+uploads once per (array identity, sharding) and evicts when the host array
+is garbage-collected.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, Tuple
+
+_lock = threading.Lock()
+_cache: Dict[Tuple[int, Any], Tuple[Any, Any]] = {}
+
+
+def cached_put(arr, sharding=None):
+    """device_put with identity-based memoization. `arr` must be a
+    weakref-able host array (numpy ndarray)."""
+    import jax
+
+    key = (id(arr), sharding)
+    with _lock:
+        entry = _cache.get(key)
+        if entry is not None and entry[0]() is arr:
+            return entry[1]
+    dev = jax.device_put(arr, sharding) if sharding is not None \
+        else jax.device_put(arr)
+    try:
+        ref = weakref.ref(arr, lambda r, k=key: _cache.pop(k, None))
+    except TypeError:
+        return dev  # not weakref-able; skip caching
+    with _lock:
+        _cache[key] = (ref, dev)
+    return dev
+
+
+def cache_size() -> int:
+    with _lock:
+        return len(_cache)
+
+
+def clear():
+    with _lock:
+        _cache.clear()

@@ -1,0 +1,43 @@
+"""BatchView / data_view tests (reference view layer parity)."""
+
+import numpy as np
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.data.view import BatchView, data_view
+
+
+def seed(app_name="viewapp"):
+    app_id = Storage.get_meta_data_apps().insert(App(0, app_name))
+    ev = Storage.get_events()
+    ev.init(app_id)
+    ev.insert_batch([
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"a": 1})),
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"rating": 3.0})),
+        Event(event="view", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i2"),
+    ], app_id)
+    return app_id
+
+
+class TestBatchView:
+    def test_snapshot_and_aggregate(self, tmp_env):
+        seed()
+        bv = BatchView("viewapp")
+        assert len(bv.events) == 3
+        agg = bv.aggregate_properties("user")
+        assert agg["u1"].fields == {"a": 1}
+        assert len(bv.filter(event_names=["rate", "view"])) == 2
+
+
+class TestDataView:
+    def test_columnar(self, tmp_env):
+        seed()
+        cols = data_view("viewapp")
+        assert cols["event"].shape == (3,)
+        assert set(cols["event"].tolist()) == {"$set", "rate", "view"}
+        assert cols["eventTimeMillis"].dtype == np.int64
+        assert "" in cols["targetEntityId"].tolist()  # $set has no target
