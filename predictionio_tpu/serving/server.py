@@ -227,6 +227,22 @@ class EngineServer:
     def _plugins(self, req: Request) -> Response:
         return Response(200, self.plugin_context.to_dict())
 
+    def _profile(self, req: Request) -> Response:
+        """jax.profiler trace control — beyond-parity observability
+        (SURVEY.md §5 tracing). POST /profile.json {"action": "start",
+        "dir": "/tmp/trace"} | {"action": "stop"}."""
+        import jax
+        d = req.json() or {}
+        action = d.get("action")
+        if action == "start":
+            trace_dir = d.get("dir", "/tmp/pio_trace")
+            jax.profiler.start_trace(trace_dir)
+            return Response(200, {"message": "tracing", "dir": trace_dir})
+        if action == "stop":
+            jax.profiler.stop_trace()
+            return Response(200, {"message": "trace stopped"})
+        return Response(400, {"message": "action must be start|stop"})
+
     def _build_router(self) -> Router:
         r = Router()
         r.add("GET", "/", self._status_page)
@@ -236,6 +252,7 @@ class EngineServer:
         r.add("POST", "/stop", self._stop)
         r.add("GET", "/stop", self._stop)
         r.add("GET", "/plugins.json", self._plugins)
+        r.add("POST", "/profile.json", self._profile)
         return r
 
     # -- lifecycle ----------------------------------------------------------

@@ -281,6 +281,16 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_upgrade(args) -> int:
+    """(Console upgrade / WorkflowUtils.checkUpgrade — the reference phones
+    home for new versions; this build is offline, so upgrade is a no-op
+    version report.)"""
+    import predictionio_tpu
+    _print(f"pio-tpu {predictionio_tpu.__version__}: offline build; "
+           "no upgrade channel configured.")
+    return 0
+
+
 def _confirm(question: str) -> bool:
     answer = input(f"{question} (Y/n) ")
     return answer in ("", "y", "Y")
@@ -419,6 +429,9 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("main_py")
     r.add_argument("args", nargs="*")
     r.set_defaults(func=cmd_run)
+
+    up = sub.add_parser("upgrade")
+    up.set_defaults(func=cmd_upgrade)
 
     return p
 
