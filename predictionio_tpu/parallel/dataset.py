@@ -1,0 +1,63 @@
+"""Host-parallel ingest: event streams -> mesh-sharded device arrays.
+
+The TPU-native replacement for the reference's HBase-scan-to-RDD edge
+(reference: data/src/main/scala/io/prediction/data/storage/hbase/
+HBPEvents.scala:42-80 `newAPIHadoopRDD`, and SURVEY.md §5 "Distributed
+communication backend"): each host process reads its slice of the event
+store, builds local numpy shards, and
+`jax.make_array_from_process_local_data` assembles the global sharded
+jax.Array over the mesh — no central driver ever holds the full data.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.parallel.mesh import MeshContext, current_mesh
+
+
+def sharded_from_host(x: np.ndarray, mesh: Optional[MeshContext] = None,
+                      axis: int = 0):
+    """Single-process path: pad dim `axis` to the data-parallel degree and
+    shard it over the mesh. Returns (array, original_len)."""
+    mesh = mesh or current_mesh()
+    padded, n = mesh.pad_to_multiple(np.asarray(x), axis=axis)
+    return mesh.put_batch(padded), n
+
+
+def sharded_from_process_local(local_shard: np.ndarray,
+                               global_rows: int,
+                               mesh: Optional[MeshContext] = None):
+    """Multi-host path: every process passes only its local rows; JAX
+    assembles the globally-sharded array (the make_array_from_process_local
+    _data edge). Falls back to sharded_from_host when single-process."""
+    import jax
+    mesh = mesh or current_mesh()
+    if jax.process_count() == 1:
+        return sharded_from_host(local_shard, mesh)[0]
+    sharding = mesh.batch_sharded(local_shard.ndim)
+    global_shape = (global_rows,) + tuple(local_shard.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, local_shard, global_shape)
+
+
+def events_to_ratings_arrays(events: Iterable,
+                             rating_of=None
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                        np.ndarray]:
+    """Stream (entityId, targetEntityId[, rating, t]) out of an event
+    iterator into flat object/float arrays ready for EntityIdIxMap +
+    RatingsCOO construction — the ingest half of every template DataSource,
+    factored out so multi-host readers can shard the event scan by
+    entity-hash range."""
+    users, items, vals, ts = [], [], [], []
+    from predictionio_tpu.data.event import to_millis
+    for e in events:
+        users.append(e.entity_id)
+        items.append(e.target_entity_id)
+        vals.append(rating_of(e) if rating_of else 1.0)
+        ts.append(to_millis(e.event_time))
+    return (np.array(users, dtype=object), np.array(items, dtype=object),
+            np.array(vals, dtype=np.float32), np.array(ts, dtype=np.int64))
