@@ -215,6 +215,29 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
 # ---------------------------------------------------------------------------
 
 @functools.partial(__import__("jax").jit, static_argnames=("k",))
+def _user_topk(user_factors, item_factors, user_ix, exclude_ix, k: int):
+    """Single-dispatch serve path: inputs are one scalar index + a small
+    padded exclude-index array (pad = -1), so only a few hundred bytes move
+    host->device per query — the factor tables are device-resident."""
+    import jax
+    import jax.numpy as jnp
+    u = user_factors[user_ix]                                  # [R]
+    scores = jnp.einsum("ir,r->i", item_factors, u,
+                        preferred_element_type=jnp.float32)
+    safe = jnp.where(exclude_ix < 0, scores.shape[0], exclude_ix)
+    scores = scores.at[safe].set(-jnp.inf, mode="drop")
+    return jax.lax.top_k(scores, k)
+
+
+def _pad_exclude(exclude, multiple: int = 64) -> np.ndarray:
+    ex = np.asarray(exclude, dtype=np.int32).ravel()
+    n = max(multiple, ((ex.size + multiple - 1) // multiple) * multiple)
+    out = np.full(n, -1, dtype=np.int32)
+    out[:ex.size] = ex
+    return out
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("k",))
 def _topk_scores(user_vecs, item_factors, seen_mask, k: int):
     """scores = u . V^T with seen items masked out; returns (scores, idx)."""
     import jax.numpy as jnp
@@ -232,14 +255,12 @@ def recommend_products(model: ALSModel, user_ix: int, k: int,
     analog). Returns (scores, item_indices). The item-factor table is
     device-cached — only the query row and mask move per call."""
     from predictionio_tpu.utils.device_cache import cached_put
-    u = model.user_factors[user_ix][None, :]
-    seen = np.zeros((1, model.n_items), dtype=bool)
-    if exclude is not None and len(exclude):
-        seen[0, np.asarray(exclude, dtype=np.int64)] = True
     k_eff = min(k, model.n_items)
-    scores, idx = _topk_scores(u, cached_put(model.item_factors), seen,
-                               k_eff)
-    return np.asarray(scores)[0], np.asarray(idx)[0]
+    scores, idx = _user_topk(
+        cached_put(model.user_factors), cached_put(model.item_factors),
+        np.int32(user_ix),
+        _pad_exclude(exclude if exclude is not None else ()), k_eff)
+    return np.asarray(scores), np.asarray(idx)
 
 
 def predict_ratings(model: ALSModel, user_ix: np.ndarray,

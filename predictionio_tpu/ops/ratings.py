@@ -111,6 +111,25 @@ def _next_pow2(x: int, floor: int) -> int:
     return max(floor, 1 << int(np.ceil(np.log2(max(x, 1)))))
 
 
+def bucket_lengths(max_count: int, min_k: int = 8,
+                   ratio: float = 1.2) -> np.ndarray:
+    """Padded segment lengths: powers of two up to 512 (few compiles for
+    the long tail of small entities), then a geometric ladder rounded to
+    multiples of 128 (lane-aligned) so heavy entities waste ~ratio-1
+    padding instead of up to 2x."""
+    sizes = []
+    k = min_k
+    while k <= min(512, _next_pow2(max_count, min_k)):
+        sizes.append(k)
+        k *= 2
+    while sizes[-1] < max_count:
+        k = int(np.ceil(sizes[-1] * ratio / 128.0) * 128)
+        if k <= sizes[-1]:
+            k = sizes[-1] + 128
+        sizes.append(k)
+    return np.array(sizes, dtype=np.int64)
+
+
 def build_solve_plan(group_idx: np.ndarray, counter_idx: np.ndarray,
                      values: np.ndarray, n_groups: int,
                      work_budget: int = 1 << 20, min_k: int = 8,
@@ -137,8 +156,8 @@ def build_solve_plan(group_idx: np.ndarray, counter_idx: np.ndarray,
     present = np.nonzero(counts)[0]
     if present.size == 0:
         return SolvePlan(batches=(), n_entities=n_groups, nnz=0)
-    ks = np.maximum(min_k, 2 ** np.ceil(
-        np.log2(np.maximum(counts[present], 1))).astype(np.int64))
+    sizes = bucket_lengths(int(counts[present].max()), min_k)
+    ks = sizes[np.searchsorted(sizes, counts[present], side="left")]
 
     batches: List[SolveBatch] = []
     for k in np.unique(ks):

@@ -101,6 +101,19 @@ class TestSolvePlan:
             assert k & (k - 1) == 0
             assert b * k <= max(1024, k)  # budget respected (min 1 row)
 
+    def test_bucket_lengths_ladder(self):
+        from predictionio_tpu.ops.ratings import bucket_lengths
+        sizes = bucket_lengths(10_000)
+        # pow2 up to 512, then lane-aligned geometric steps
+        assert {8, 16, 32, 64, 128, 256, 512}.issubset(set(sizes.tolist()))
+        big = sizes[sizes > 512]
+        assert np.all(big % 128 == 0)
+        assert sizes[-1] >= 10_000
+        # padding overhead above 512 bounded by the ratio
+        assert np.all(np.diff(big) / big[:-1] <= 0.35)
+        # monotonically increasing
+        assert np.all(np.diff(sizes) > 0)
+
     def test_empty(self):
         plan = build_solve_plan(np.array([], dtype=np.int64),
                                 np.array([], dtype=np.int32),

@@ -1,0 +1,86 @@
+"""Mesh / sharding / ingest tests."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.parallel.dataset import (sharded_from_host,
+                                               events_to_ratings_arrays)
+from predictionio_tpu.parallel.mesh import make_mesh, use_mesh, current_mesh
+
+
+class TestMesh:
+    def test_axes_and_sizes(self, mesh8):
+        assert mesh8.n_devices == 8
+        assert mesh8.data_parallelism == 8
+        assert mesh8.model_parallelism == 1
+
+    def test_2d_mesh(self):
+        import jax
+        m = make_mesh(jax.devices(), model_parallelism=2)
+        assert m.data_parallelism == 4
+        assert m.model_parallelism == 2
+
+    def test_model_parallelism_must_divide(self):
+        import jax
+        with pytest.raises(ValueError):
+            make_mesh(jax.devices(), model_parallelism=3)
+
+    def test_pad_to_multiple(self, mesh8):
+        x = np.arange(13)
+        padded, n = mesh8.pad_to_multiple(x)
+        assert padded.shape[0] == 16 and n == 13
+        y, n2 = mesh8.pad_to_multiple(np.arange(16))
+        assert y.shape[0] == 16 and n2 == 16
+
+    def test_put_batch_sharded(self, mesh8):
+        x = np.arange(16, dtype=np.float32).reshape(16, 1)
+        arr = mesh8.put_batch(x)
+        assert len(arr.sharding.device_set) == 8
+        np.testing.assert_array_equal(np.asarray(arr), x)
+
+    def test_use_mesh_context(self, mesh8):
+        import jax
+        single = make_mesh(jax.devices()[:1])
+        with use_mesh(single):
+            assert current_mesh() is single
+
+
+class TestIngest:
+    def test_sharded_from_host_pads(self, mesh8):
+        arr, n = sharded_from_host(np.arange(10, dtype=np.float32), mesh8)
+        assert arr.shape[0] == 16 and n == 10
+
+    def test_events_to_ratings_arrays(self):
+        import datetime as dt
+        from predictionio_tpu.data import DataMap, Event
+        evs = [Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                     target_entity_type="item", target_entity_id=f"i{i}",
+                     properties=DataMap({"rating": float(i)}),
+                     event_time=dt.datetime(2026, 1, 1, 0, 0, i,
+                                            tzinfo=dt.timezone.utc))
+               for i in range(3)]
+        u, it, v, t = events_to_ratings_arrays(
+            evs, rating_of=lambda e: e.properties.get("rating", float))
+        assert u.tolist() == ["u0", "u1", "u2"]
+        assert v.tolist() == [0.0, 1.0, 2.0]
+        assert t[1] - t[0] == 1000
+
+
+class TestDeviceCache:
+    def test_cached_put_identity(self, mesh8):
+        from predictionio_tpu.utils.device_cache import (cache_size,
+                                                         cached_put, clear)
+        clear()
+        x = np.arange(8, dtype=np.float32)
+        a1 = cached_put(x)
+        a2 = cached_put(x)
+        assert a1 is a2
+        assert cache_size() == 1
+        y = np.arange(8, dtype=np.float32)
+        a3 = cached_put(y)
+        assert a3 is not a1
+        del x, y
+        import gc
+        gc.collect()
+        assert cache_size() == 0
+        clear()
