@@ -63,8 +63,11 @@ def cmd_status(args) -> int:
 
 
 def cmd_build(args) -> int:
-    """Validate engine.json and the engine factory import (the sbt-compile
-    analog — Python engines need no build, Console.scala:924)."""
+    """Validate engine.json + factory import and register the engine
+    manifest (the sbt-compile + RegisterEngine analog — Python engines need
+    no compilation; Console.scala:924, RegisterEngine.scala)."""
+    from predictionio_tpu.data.storage.base import EngineManifest
+    from predictionio_tpu.data.storage.registry import Storage
     from predictionio_tpu.models import get_engine_factory
     with open(args.engine_json) as f:
         variant = json.load(f)
@@ -75,8 +78,31 @@ def cmd_build(args) -> int:
     factory = get_engine_factory(factory_name)
     engine = factory.apply()
     engine.json_to_engine_params(variant)
-    _print(f"Engine {factory_name} is valid. Build finished successfully.")
+    manifest = EngineManifest(
+        id=variant.get("id", "default"),
+        version=str(variant.get("version", "0")),
+        name=variant.get("id", factory_name),
+        description=variant.get("description"),
+        files=(args.engine_json,),
+        engine_factory=factory_name)
+    Storage.get_meta_data_engine_manifests().insert(manifest)
+    _print(f"Engine {factory_name} is valid. Registered manifest "
+           f"{manifest.id} {manifest.version}. Build finished successfully.")
     return 0
+
+
+def cmd_unregister(args) -> int:
+    """(Console unregister — remove the engine manifest)"""
+    from predictionio_tpu.data.storage.registry import Storage
+    with open(args.engine_json) as f:
+        variant = json.load(f)
+    mid = variant.get("id", "default")
+    version = str(variant.get("version", "0"))
+    if Storage.get_meta_data_engine_manifests().delete(mid, version):
+        _print(f"Unregistered engine {mid} {version}.")
+        return 0
+    _print(f"Engine {mid} {version} is not registered.")
+    return 1
 
 
 def cmd_train(args) -> int:
@@ -313,6 +339,10 @@ def build_parser() -> argparse.ArgumentParser:
     b = sub.add_parser("build")
     b.add_argument("--engine-json", default="engine.json")
     b.set_defaults(func=cmd_build)
+
+    un = sub.add_parser("unregister")
+    un.add_argument("--engine-json", default="engine.json")
+    un.set_defaults(func=cmd_unregister)
 
     t = sub.add_parser("train")
     t.add_argument("--engine-json", default="engine.json")

@@ -1,0 +1,26 @@
+"""Importable Evaluation + EngineParamsGenerator fixtures for the eval CLI
+path (the quickstart Evaluation.scala analog)."""
+
+from predictionio_tpu.core import EngineParams, Evaluation, \
+    EngineParamsGenerator
+from predictionio_tpu.models import classification as C
+
+
+def _params(lam):
+    return EngineParams(
+        data_source_params=("", C.DataSourceParams(app_name="evalapp",
+                                                   eval_k=3)),
+        preparator_params=("", None),
+        algorithm_params_list=[("naive", C.NaiveBayesAlgorithmParams(
+            lam=lam))],
+        serving_params=("", None))
+
+
+class AccuracyEvaluation(Evaluation):
+    def __init__(self):
+        self.engine = C.ClassificationEngineFactory.apply()
+        self.metric = C.Accuracy()
+
+
+class LambdaSweep(EngineParamsGenerator):
+    engine_params_list = [_params(0.1), _params(1.0), _params(10.0)]

@@ -1,0 +1,55 @@
+"""`pio eval` end-to-end: Evaluation class + params generator by dotted
+path, EvaluationInstance persisted with rendered results (mirrors the
+reference eval call stack, SURVEY.md §3.3)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.tools.cli import main as cli_main
+
+
+@pytest.fixture
+def eval_app(tmp_env, mesh8):
+    app_id = Storage.get_meta_data_apps().insert(App(0, "evalapp"))
+    ev = Storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    events = []
+    for j in range(36):
+        label = float(j % 2)
+        base = [9.0, 1.0, 1.0] if label == 0 else [1.0, 1.0, 9.0]
+        events.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{j}",
+            properties=DataMap({
+                "plan": label,
+                "attr0": base[0] + float(rng.integers(0, 2)),
+                "attr1": base[1], "attr2": base[2]})))
+    ev.insert_batch(events, app_id)
+    return app_id
+
+
+def test_eval_cli(eval_app, capsys):
+    rc = cli_main([
+        "eval", "tests.sample_eval.AccuracyEvaluation",
+        "tests.sample_eval.LambdaSweep"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Evaluation completed" in out
+    completed = Storage.get_meta_data_evaluation_instances().get_completed()
+    assert len(completed) == 1
+    inst = completed[0]
+    assert inst.evaluation_class == "tests.sample_eval.AccuracyEvaluation"
+    assert "Accuracy" in inst.evaluator_results
+    parsed = json.loads(inst.evaluator_results_json)
+    assert len(parsed["scores"]) == 3
+    assert parsed["bestScore"] > 0.9  # separable data
+
+
+def test_eval_without_generator_requires_own_list(eval_app):
+    # AccuracyEvaluation carries no engine_params_list of its own
+    with pytest.raises(ValueError, match="engine_params_list"):
+        cli_main(["eval", "tests.sample_eval.AccuracyEvaluation"])
