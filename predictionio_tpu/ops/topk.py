@@ -1,0 +1,65 @@
+"""Distributed top-k over model-sharded score tables.
+
+When an item-factor table is sharded over the mesh `model` axis (catalogs
+too large for one device's HBM — ALSConfig.factor_sharding='model'), serving
+must rank across shards. `sharded_top_k` runs the canonical two-phase
+reduction as one jitted shard_map: each device ranks its local shard
+(lax.top_k), the (k, score, index) candidates are all-gathered over ICI —
+k*devices values instead of the full score row — and the final top-k picks
+globally. This is the serve-time analog of the reference's distributed-model
+`RDD.lookup`/collect path (SURVEY.md §2.9 L/P2L/P row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.parallel.mesh import MeshContext, current_mesh
+
+
+def sharded_top_k(item_factors_sharded, query_vec, k: int,
+                  mesh: Optional[MeshContext] = None,
+                  allowed_mask_sharded=None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """item_factors_sharded: [I, R] jax.Array sharded over ('model', None).
+    query_vec: [R] host or device. Returns host (scores, global_indices).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or current_mesh()
+    n_items = item_factors_sharded.shape[0]
+    mp = mesh.model_parallelism
+    shard_rows = n_items // mp
+    k_eff = min(k, shard_rows)
+
+    @functools.partial(
+        shard_map, mesh=mesh.mesh,
+        in_specs=(P("model", None), P(), P("model")),
+        out_specs=(P(), P()),
+        check_vma=False)
+    def _local_then_global(v_shard, q, mask_shard):
+        scores = jnp.einsum("ir,r->i", v_shard, q,
+                            preferred_element_type=jnp.float32)
+        scores = jnp.where(mask_shard, scores, -jnp.inf)
+        local_s, local_i = jax.lax.top_k(scores, k_eff)
+        # globalize indices: shard offset from the model-axis position
+        ax = jax.lax.axis_index("model")
+        local_i = local_i + ax * v_shard.shape[0]
+        all_s = jax.lax.all_gather(local_s, "model").reshape(-1)
+        all_i = jax.lax.all_gather(local_i, "model").reshape(-1)
+        top_s, pos = jax.lax.top_k(all_s, k_eff)
+        return top_s, all_i[pos]
+
+    if allowed_mask_sharded is None:
+        allowed_mask_sharded = jax.device_put(
+            np.ones(n_items, dtype=bool), mesh.sharding("model"))
+    q = jnp.asarray(query_vec, dtype=item_factors_sharded.dtype)
+    scores, idx = _local_then_global(item_factors_sharded, q,
+                                     allowed_mask_sharded)
+    return np.asarray(scores)[:k], np.asarray(idx)[:k]
