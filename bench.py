@@ -145,9 +145,9 @@ def bench_rest_latency(model, n_queries=200):
         port = server.config.port
         rng = np.random.default_rng(0)
         users = rng.integers(0, n_users, n_queries)
-        # warmup (jit of the top-k scorer)
+        # warmup (first call compiles the serve kernel on-device)
         for u in users[:10]:
-            _post(port, {"user": str(int(u)), "num": 10})
+            _post(port, {"user": str(int(u)), "num": 10}, timeout=600)
         lat = []
         for u in users:
             t0 = time.perf_counter()
@@ -161,13 +161,13 @@ def bench_rest_latency(model, n_queries=200):
         server.stop()
 
 
-def _post(port, body):
+def _post(port, body, timeout=30):
     import urllib.request
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/queries.json",
         data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"}, method="POST")
-    with urllib.request.urlopen(req, timeout=30) as resp:
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         return resp.read()
 
 

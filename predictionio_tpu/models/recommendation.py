@@ -17,8 +17,9 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
-                                   EngineParams, FirstServing, P2LAlgorithm,
-                                   Params, Preparator, SanityCheck)
+                                   EngineParams, FirstServing, Metric,
+                                   P2LAlgorithm, Params, Preparator,
+                                   SanityCheck)
 from predictionio_tpu.data.bimap import EntityIdIxMap
 from predictionio_tpu.data.event import to_millis
 from predictionio_tpu.data.store import PEventStore
@@ -74,6 +75,15 @@ class DataSourceParams(Params):
     app_name: str = "default"
     event_names: Tuple[str, ...] = ("rate", "buy")
     buy_rating: float = 4.0  # implicit rating assigned to buy events
+    eval_k: Optional[int] = None    # enable k-fold read_eval when set
+    eval_query_num: int = 10        # query.num used for eval queries
+
+
+@dataclass(frozen=True)
+class ActualResult:
+    """Ratings the test fold holds for the queried user (the template
+    evaluation's ground truth)."""
+    ratings: Tuple[Rating, ...]
 
 
 class RecommendationDataSource(DataSource):
@@ -82,7 +92,7 @@ class RecommendationDataSource(DataSource):
     def __init__(self, params=None):
         super().__init__(params or DataSourceParams())
 
-    def read_training(self) -> TrainingData:
+    def _read_ratings(self) -> List[Rating]:
         p = self.params
         ratings = []
         for e in PEventStore.find(app_name=p.app_name, entity_type="user",
@@ -94,7 +104,31 @@ class RecommendationDataSource(DataSource):
                 rating = p.buy_rating
             ratings.append(Rating(e.entity_id, e.target_entity_id, rating,
                                   to_millis(e.event_time)))
-        return TrainingData(ratings)
+        return ratings
+
+    def read_training(self) -> TrainingData:
+        return TrainingData(self._read_ratings())
+
+    def read_eval(self):
+        """k-fold split of rating events; one query per test-fold user with
+        that user's held-out ratings as the actual (the recommendation
+        template's Evaluation DataSource shape)."""
+        p = self.params
+        if not p.eval_k:
+            return []
+        ratings = self._read_ratings()
+        folds = []
+        for fold in range(p.eval_k):
+            train = [r for i, r in enumerate(ratings) if i % p.eval_k != fold]
+            test = [r for i, r in enumerate(ratings) if i % p.eval_k == fold]
+            by_user = {}
+            for r in test:
+                by_user.setdefault(r.user, []).append(r)
+            qa = [(Query(user=user, num=p.eval_query_num),
+                   ActualResult(tuple(rs)))
+                  for user, rs in sorted(by_user.items())]
+            folds.append((TrainingData(train), None, qa))
+        return folds
 
 
 @dataclass(frozen=True)
@@ -164,7 +198,55 @@ class ALSAlgorithm(P2LAlgorithm):
         return top_scores_to_result(model.item_ix, scores, idx)
 
     def batch_predict(self, model, queries):
-        return [(ix, self.predict(model, q)) for ix, q in queries]
+        """Evaluation path: one batched device top-k for all known users
+        (vs the reference's per-query driver loop)."""
+        from predictionio_tpu.ops.als import _topk_scores
+        from predictionio_tpu.utils.device_cache import cached_put
+        out = {ix: ItemScoreResult(()) for ix, _ in queries}
+        known = [(ix, q, int(model.user_ix.get(q.user, -1)))
+                 for ix, q in queries]
+        known = [(ix, q, uix) for ix, q, uix in known if uix >= 0]
+        if known:
+            uvecs = model.als.user_factors[[uix for _, _, uix in known]]
+            k_max = min(max(q.num for _, q, _ in known), model.als.n_items)
+            seen = np.zeros((len(known), model.als.n_items), dtype=bool)
+            scores, idx = _topk_scores(
+                uvecs, cached_put(model.als.item_factors), seen, k_max)
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+            for row, (ix, q, _) in enumerate(known):
+                out[ix] = top_scores_to_result(
+                    model.item_ix, scores[row][:q.num], idx[row][:q.num])
+        return list(out.items())
+
+
+class PrecisionAtK(Metric):
+    """Precision@K with a positive-rating threshold (the recommendation
+    template's tuning metric). None (skipped) when a user has no positive
+    actuals, matching OptionAverageMetric semantics."""
+
+    def __init__(self, k: int = 10, rating_threshold: float = 2.0):
+        self.k = k
+        self.rating_threshold = rating_threshold
+
+    def header(self) -> str:
+        return f"PrecisionAtK(k={self.k}, threshold={self.rating_threshold})"
+
+    def calculate(self, eval_data) -> float:
+        vals = []
+        for _, qpa in eval_data:
+            for q, p, a in qpa:
+                positives = {r.item for r in a.ratings
+                             if r.rating >= self.rating_threshold}
+                if not positives:
+                    continue
+                top = [s.item for s in p.item_scores[:self.k]]
+                if not top:
+                    vals.append(0.0)
+                    continue
+                hits = sum(1 for item in top if item in positives)
+                vals.append(hits / min(self.k, len(top)))
+        return float("nan") if not vals else float(np.mean(vals))
 
 
 class RecommendationEngineFactory(EngineFactory):

@@ -79,6 +79,24 @@ class TestRecommendationTemplate:
         res = algo.predict(tr.models[0], R.Query(user="nobody", num=3))
         assert res.item_scores == ()
 
+    def test_eval_precision_at_k(self, app, mesh8):
+        from predictionio_tpu.core import MetricEvaluator
+        from predictionio_tpu.models import recommendation as R
+        self.seed(app)
+        engine = R.RecommendationEngineFactory.apply()
+        ep = EngineParams(
+            data_source_params=("", R.DataSourceParams(
+                app_name="testapp", eval_k=2, eval_query_num=4)),
+            preparator_params=("", R.PreparatorParams()),
+            algorithm_params_list=[("als", R.ALSAlgorithmParams(
+                rank=4, num_iterations=6, lam=0.05, seed=3))],
+            serving_params=("", None))
+        result = MetricEvaluator(R.PrecisionAtK(k=4, rating_threshold=3.0)) \
+            .evaluate_base(engine, [ep])
+        # grouped synthetic data: recommendations should hit held-out
+        # positives far better than chance
+        assert result.best_score.score > 0.3
+
     def test_dedup_latest_rating_wins(self, app, mesh8):
         from predictionio_tpu.models import recommendation as R
         insert(app, "rate", "user", "u1", "item", "i1", {"rating": 1.0},
