@@ -1,0 +1,69 @@
+"""Multi-algorithm serving: one query fanned to N models, combined by
+Serving (the reference's per-query algorithm loop, CreateServer.scala:515
+— SURVEY hard part #6)."""
+
+import datetime as dt
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.data.storage.base import EngineInstance
+from predictionio_tpu.serving import EngineServer, ServerConfig
+from tests.sample_engine import (Algo0, AParams, DataSource0, DSParams,
+                                 Preparator0, PParams, Query, Serving0,
+                                 SParams)
+
+
+class CombiningServing(Serving0):
+    """Serves the ids of every algorithm's prediction."""
+
+    def serve(self, query, predictions):
+        return {"algoIds": [p.id for p in predictions],
+                "queryId": query.id}
+
+
+class QueryById:
+    @staticmethod
+    def from_dict(d):
+        return Query(id=int(d["id"]))
+
+
+@pytest.fixture
+def server():
+    engine = Engine({"": DataSource0}, {"": Preparator0},
+                    {"algo": Algo0}, {"": CombiningServing})
+    ep = EngineParams(
+        data_source_params=("", DSParams(id=1)),
+        preparator_params=("", PParams(id=2)),
+        algorithm_params_list=[("algo", AParams(id=10)),
+                               ("algo", AParams(id=20)),
+                               ("algo", AParams(id=30))],
+        serving_params=("", SParams()))
+    tr = engine.train(ep)
+    for algo in tr.algorithms:
+        algo.QUERY_CLASS = QueryById
+    s = EngineServer(ServerConfig(ip="127.0.0.1", port=0), engine=engine,
+                     engine_params=ep)
+    now = dt.datetime.now(dt.timezone.utc)
+    s.engine_instance = EngineInstance(
+        id="multi", status="COMPLETED", start_time=now, end_time=now,
+        engine_id="multi", engine_version="0", engine_variant="v",
+        engine_factory="")
+    s.algorithms = tr.algorithms
+    s.models = tr.models
+    s.serving = engine.make_serving(ep)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_query_fans_out_to_all_algorithms(server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.config.port}/queries.json",
+        data=json.dumps({"id": 7}).encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = json.loads(resp.read())
+    assert body["algoIds"] == [10, 20, 30]
+    assert body["queryId"] == 7
