@@ -77,6 +77,7 @@ class ActualResult:
 @dataclass(frozen=True)
 class DataSourceParams(Params):
     app_name: str = "default"
+    channel_name: Optional[str] = None
     eval_k: Optional[int] = None  # enable k-fold read_eval when set
 
 
@@ -88,7 +89,8 @@ class ClassificationDataSource(DataSource):
 
     def _read_points(self) -> List[LabeledPoint]:
         props = PEventStore.aggregate_properties(
-            app_name=self.params.app_name, entity_type="user",
+            app_name=self.params.app_name,
+            channel_name=self.params.channel_name, entity_type="user",
             required=["plan", "attr0", "attr1", "attr2"])
         points = []
         for entity_id, pm in props.items():

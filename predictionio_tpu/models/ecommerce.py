@@ -84,6 +84,7 @@ class PreparedData:
 @dataclass(frozen=True)
 class DataSourceParams(Params):
     app_name: str = "default"
+    channel_name: Optional[str] = None
     rate_events: Tuple[str, ...] = ("rate", "buy")
     buy_rating: float = 4.0
 
@@ -97,16 +98,20 @@ class ECommerceDataSource(DataSource):
     def read_training(self) -> TrainingData:
         from predictionio_tpu.data.event import to_millis
         app = self.params.app_name
+        chan = self.params.channel_name
         users = {eid: dict(pm.fields) for eid, pm in
                  PEventStore.aggregate_properties(
-                     app_name=app, entity_type="user").items()}
+                     app_name=app, channel_name=chan,
+                     entity_type="user").items()}
         items = {}
         for eid, pm in PEventStore.aggregate_properties(
-                app_name=app, entity_type="item").items():
+                app_name=app, channel_name=chan,
+                entity_type="item").items():
             cats = pm.get_opt("categories", list)
             items[eid] = Item(tuple(cats) if cats is not None else None)
         rates = []
-        for e in PEventStore.find(app_name=app, entity_type="user",
+        for e in PEventStore.find(app_name=app, channel_name=chan,
+                                  entity_type="user",
                                   event_names=list(self.params.rate_events),
                                   target_entity_type="item"):
             rating = (e.properties.get("rating", float)

@@ -73,6 +73,7 @@ class PreparedData:
 @dataclass(frozen=True)
 class DataSourceParams(Params):
     app_name: str = "default"
+    channel_name: Optional[str] = None
     event_names: Tuple[str, ...] = ("rate", "buy")
     buy_rating: float = 4.0  # implicit rating assigned to buy events
     eval_k: Optional[int] = None    # enable k-fold read_eval when set
@@ -95,7 +96,9 @@ class RecommendationDataSource(DataSource):
     def _read_ratings(self) -> List[Rating]:
         p = self.params
         ratings = []
-        for e in PEventStore.find(app_name=p.app_name, entity_type="user",
+        for e in PEventStore.find(app_name=p.app_name,
+                                  channel_name=p.channel_name,
+                                  entity_type="user",
                                   target_entity_type="item",
                                   event_names=list(p.event_names)):
             if e.event == "rate":

@@ -84,6 +84,7 @@ class PreparedData:
 @dataclass(frozen=True)
 class DataSourceParams(Params):
     app_name: str = "default"
+    channel_name: Optional[str] = None
 
 
 class SimilarProductDataSource(DataSource):
@@ -96,17 +97,21 @@ class SimilarProductDataSource(DataSource):
 
     def read_training(self) -> TrainingData:
         app = self.params.app_name
+        chan = self.params.channel_name
         users = {eid: dict(pm.fields) for eid, pm in
                  PEventStore.aggregate_properties(
-                     app_name=app, entity_type="user").items()}
+                     app_name=app, channel_name=chan,
+                     entity_type="user").items()}
         items = {}
         for eid, pm in PEventStore.aggregate_properties(
-                app_name=app, entity_type="item").items():
+                app_name=app, channel_name=chan,
+                entity_type="item").items():
             cats = pm.get_opt("categories", list)
             items[eid] = Item(tuple(cats) if cats is not None else None)
         views = []
         from predictionio_tpu.data.event import to_millis
-        for e in PEventStore.find(app_name=app, entity_type="user",
+        for e in PEventStore.find(app_name=app, channel_name=chan,
+                                  entity_type="user",
                                   event_names=["view"],
                                   target_entity_type="item"):
             views.append(ViewEvent(e.entity_id, e.target_entity_id,
