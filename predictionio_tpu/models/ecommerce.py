@@ -129,6 +129,7 @@ class ECommercePreparator(Preparator):
 @dataclass(frozen=True)
 class ECommAlgorithmParams(Params):
     app_name: str = "default"
+    channel_name: Optional[str] = None  # serve-time reads use this channel
     unseen_only: bool = True
     seen_events: Tuple[str, ...] = ("buy", "view")
     rank: int = 10
@@ -195,7 +196,8 @@ class ECommAlgorithm(P2LAlgorithm):
             return []
         try:
             events = LEventStore.find_by_entity(
-                app_name=self.params.app_name, entity_type="user",
+                app_name=self.params.app_name,
+                channel_name=self.params.channel_name, entity_type="user",
                 entity_id=user, event_names=list(self.params.seen_events),
                 target_entity_type="item", timeout_ms=200)
             return [e.target_entity_id for e in events
@@ -207,7 +209,9 @@ class ECommAlgorithm(P2LAlgorithm):
     def _unavailable_items(self) -> List[str]:
         try:
             events = LEventStore.find_by_entity(
-                app_name=self.params.app_name, entity_type="constraint",
+                app_name=self.params.app_name,
+                channel_name=self.params.channel_name,
+                entity_type="constraint",
                 entity_id="unavailableItems", event_names=["$set"],
                 limit=1, latest=True, timeout_ms=200)
             if events:
@@ -253,7 +257,8 @@ class ECommAlgorithm(P2LAlgorithm):
         """Recent-views cosine fallback (ALSAlgorithm.scala:283-364)."""
         try:
             recent = LEventStore.find_by_entity(
-                app_name=self.params.app_name, entity_type="user",
+                app_name=self.params.app_name,
+                channel_name=self.params.channel_name, entity_type="user",
                 entity_id=query.user, event_names=["view"],
                 target_entity_type="item", limit=10, latest=True,
                 timeout_ms=200)

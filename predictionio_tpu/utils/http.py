@@ -101,6 +101,11 @@ class HttpServer:
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # one buffered write + TCP_NODELAY: without these, the
+            # header/body write split interacts with Nagle + delayed ACK
+            # for ~40-200 ms per response
+            wbufsize = 1 << 16
+            disable_nagle_algorithm = True
 
             def _handle(self):
                 parsed = urllib.parse.urlsplit(self.path)
