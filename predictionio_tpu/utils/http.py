@@ -121,6 +121,10 @@ class HttpServer:
                     resp = router.dispatch(req)
                 except ValueError as e:
                     resp = Response(400, {"message": str(e)})
+                except KeyError as e:
+                    # missing required field in a JSON body
+                    resp = Response(400, {"message":
+                                          f"missing field {e}"})
                 except Exception as e:
                     logger.exception("handler error")
                     resp = Response(500, {"message": str(e)})
