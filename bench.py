@@ -141,34 +141,105 @@ def bench_rest_latency(model, n_queries=200):
     server.models = [rec_model]
     server.serving = FirstServing()
     server.start()
+    client = _Client(server.config.port)
     try:
-        port = server.config.port
         rng = np.random.default_rng(0)
         users = rng.integers(0, n_users, n_queries)
         # warmup (first call compiles the serve kernel on-device)
         for u in users[:10]:
-            _post(port, {"user": str(int(u)), "num": 10}, timeout=600)
+            client.post({"user": str(int(u)), "num": 10}, timeout=600)
         lat = []
         for u in users:
             t0 = time.perf_counter()
-            _post(port, {"user": str(int(u)), "num": 10})
+            client.post({"user": str(int(u)), "num": 10})
             lat.append(time.perf_counter() - t0)
         lat = np.array(lat)
+
+        # concurrent throughput: 16 keep-alive clients (serial p50 on a
+        # tunneled chip is dominated by the per-transfer D2H floor; the
+        # path pipelines, so concurrency recovers throughput)
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        n_workers, n_total = 16, 320
+        tls = threading.local()
+        all_clients = []
+        lock = threading.Lock()
+
+        def worker(uid):
+            c = getattr(tls, "client", None)
+            if c is None:
+                c = _Client(server.config.port)
+                tls.client = c
+                with lock:
+                    all_clients.append(c)
+            c.post({"user": str(int(uid)), "num": 10})
+        jobs = [users[i % len(users)] for i in range(n_total)]
+        with ThreadPoolExecutor(n_workers) as ex:
+            t0 = time.perf_counter()
+            list(ex.map(worker, jobs))
+            conc_dt = time.perf_counter() - t0
+        for c in all_clients:
+            c.close()
         return {"p50_ms": float(np.percentile(lat, 50) * 1000),
                 "p95_ms": float(np.percentile(lat, 95) * 1000),
-                "qps_serial": float(1.0 / lat.mean())}
+                "qps_serial": float(1.0 / lat.mean()),
+                "qps_concurrent16": float(n_total / conc_dt)}
     finally:
+        client.close()
         server.stop()
 
 
-def _post(port, body, timeout=30):
-    import urllib.request
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/queries.json",
-        data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return resp.read()
+class _Client:
+    """Keep-alive HTTP client with TCP_NODELAY — stdlib urllib opens a new
+    connection per request and writes headers/body separately, so Nagle +
+    delayed ACK adds ~40-200 ms per request that has nothing to do with the
+    server under test."""
+
+    def __init__(self, port):
+        self.port = port
+        self.conn = None
+
+    def _connect(self, timeout):
+        import http.client
+        import socket
+        self.conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                               timeout=timeout)
+        self.conn.connect()
+        self.conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def post(self, body, timeout=30):
+        if self.conn is None:
+            self._connect(timeout)
+        try:
+            self.conn.request("POST", "/queries.json",
+                              body=json.dumps(body),
+                              headers={"Content-Type": "application/json"})
+            resp = self.conn.getresponse()
+            return resp.read()
+        except Exception:
+            self.close()
+            raise
+
+    def close(self):
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+
+def measure_d2h_floor_ms() -> float:
+    """Per-transfer device->host latency floor of this machine's link to
+    the chip. On a tunneled/remote chip this dominates serial serve p50;
+    reported so throughput numbers can be interpreted."""
+    import jax
+    x = jax.device_put(np.arange(10, dtype=np.float32))
+    f = jax.jit(lambda a: a * 2)
+    np.asarray(f(x))
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        ts.append(time.perf_counter() - t0)
+    return float(np.percentile(ts, 50) * 1000)
 
 
 def main():
@@ -177,6 +248,7 @@ def main():
     full_scale = backend not in ("cpu",)
     als_stats, model = bench_als(full_scale)
     rest_stats = bench_rest_latency(model)
+    rest_stats["d2h_floor_ms"] = round(measure_d2h_floor_ms(), 3)
     value = als_stats["ratings_per_sec_per_chip"]
     out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
