@@ -265,4 +265,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # emit a parseable line even on env failure
+        print(json.dumps({
+            "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
+            "value": 0, "unit": "ratings/s/chip", "vs_baseline": 0,
+            "error": f"{type(e).__name__}: {e}"}))
+        raise SystemExit(1)

@@ -114,3 +114,12 @@ class ZeroMetric(Metric[EI, Q, P, A]):
 
     def calculate(self, eval_data: EvalDataSet) -> float:
         return 0.0
+
+
+class QPAMetric(Generic[Q, P, A], abc.ABC):
+    """Single-(query, prediction, actual) scoring hook
+    (controller/Metric.scala QPAMetric) — compose with the aggregate
+    metrics above via their calculate_one."""
+
+    @abc.abstractmethod
+    def calculate(self, query: Q, predicted: P, actual: A) -> float: ...

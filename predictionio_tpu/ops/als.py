@@ -183,10 +183,13 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
     item_plan = plan_for_items(ratings, work_budget=cfg.work_budget,
                                batch_multiple=dp)
     logger.info(
-        "ALS: %d users, %d items, %d ratings; %d user batches %s, "
-        "%d item batches %s", ratings.n_users, ratings.n_items, ratings.nnz,
+        "ALS: %d users, %d items, %d ratings; %d user batches %s "
+        "(pad %.2fx), %d item batches %s (pad %.2fx)",
+        ratings.n_users, ratings.n_items, ratings.nnz,
         len(user_plan.batches), user_plan.kernel_shapes,
-        len(item_plan.batches), item_plan.kernel_shapes)
+        user_plan.padding_overhead,
+        len(item_plan.batches), item_plan.kernel_shapes,
+        item_plan.padding_overhead)
 
     if cfg.factor_sharding == "model":
         put_factors = mesh.put_model_sharded

@@ -106,26 +106,41 @@ class SolvePlan:
     def kernel_shapes(self):
         return sorted({b.shape for b in self.batches})
 
+    @property
+    def padding_overhead(self) -> float:
+        """padded work / real work — the Gram FLOP inflation from the
+        ragged->fixed bucketing (1.0 = no waste)."""
+        if self.nnz == 0:
+            return 1.0
+        padded = sum(int(np.count_nonzero(b.rows >= 0)) * b.shape[1]
+                     for b in self.batches)
+        return padded / self.nnz
+
 
 def _next_pow2(x: int, floor: int) -> int:
     return max(floor, 1 << int(np.ceil(np.log2(max(x, 1)))))
 
 
 def bucket_lengths(max_count: int, min_k: int = 8,
-                   ratio: float = 1.2) -> np.ndarray:
-    """Padded segment lengths: powers of two up to 512 (few compiles for
-    the long tail of small entities), then a geometric ladder rounded to
-    multiples of 128 (lane-aligned) so heavy entities waste ~ratio-1
-    padding instead of up to 2x."""
+                   ratio: float = 1.25) -> np.ndarray:
+    """Padded segment lengths: powers of two up to 64, then a geometric
+    ladder (ratio ~1.25) rounded to sublane multiples of 8 up to 512 and
+    lane multiples of 128 beyond, bounding Gram padding waste at ~ratio-1
+    instead of the up-to-2x of pure pow2 buckets. ~30 sizes to 16k keeps
+    the compile count manageable (one XLA program per size per side,
+    amortized by the persistent compilation cache)."""
     sizes = []
     k = min_k
-    while k <= min(512, _next_pow2(max_count, min_k)):
+    while k <= 64:
         sizes.append(k)
+        if k >= _next_pow2(max_count, min_k):
+            break
         k *= 2
     while sizes[-1] < max_count:
-        k = int(np.ceil(sizes[-1] * ratio / 128.0) * 128)
+        step = 8 if sizes[-1] < 512 else 128
+        k = int(np.ceil(sizes[-1] * ratio / step) * step)
         if k <= sizes[-1]:
-            k = sizes[-1] + 128
+            k = sizes[-1] + step
         sizes.append(k)
     return np.array(sizes, dtype=np.int64)
 

@@ -138,6 +138,18 @@ def cmd_eval(args) -> int:
 
 def cmd_deploy(args) -> int:
     from predictionio_tpu.serving import EngineServer, ServerConfig
+    # undeploy a stale server occupying the target port first, as the
+    # reference MasterActor does (CreateServer.scala:288-310)
+    try:
+        stop_ip = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+        req = urllib.request.Request(
+            f"http://{stop_ip}:{args.port}/stop", method="POST", data=b"")
+        urllib.request.urlopen(req, timeout=3).read()
+        _print(f"Undeployed a stale engine server on port {args.port}.")
+        import time
+        time.sleep(1)
+    except Exception:
+        pass
     config = ServerConfig(
         ip=args.ip, port=args.port,
         engine_instance_id=args.engine_instance_id,

@@ -142,9 +142,25 @@ class HttpServer:
 
         return _Handler
 
-    def start(self, background: bool = True):
-        self._httpd = ThreadingHTTPServer((self.host, self.port),
-                                          self._make_handler())
+    def start(self, background: bool = True, bind_retries: int = 3,
+              retry_delay: float = 1.0):
+        # bind retry x3 mirrors the reference MasterActor
+        # (CreateServer.scala:363-373)
+        import time as _time
+        last_err = None
+        for attempt in range(bind_retries):
+            try:
+                self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                                  self._make_handler())
+                break
+            except OSError as e:
+                last_err = e
+                logger.warning("bind %s:%d failed (%s), retry %d/%d",
+                               self.host, self.port, e, attempt + 1,
+                               bind_retries)
+                _time.sleep(retry_delay)
+        else:
+            raise last_err
         self.port = self._httpd.server_address[1]  # resolve port 0
         if background:
             self._thread = threading.Thread(
