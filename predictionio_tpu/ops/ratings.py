@@ -137,10 +137,11 @@ def bucket_lengths(max_count: int, min_k: int = 8,
             break
         k *= 2
     while sizes[-1] < max_count:
-        step = 8 if sizes[-1] < 512 else 128
-        k = int(np.ceil(sizes[-1] * ratio / step) * step)
+        k = int(np.ceil(sizes[-1] * ratio / 8) * 8)
+        if k > 512:  # lane-align once past the sublane regime
+            k = int(np.ceil(sizes[-1] * ratio / 128) * 128)
         if k <= sizes[-1]:
-            k = sizes[-1] + step
+            k = sizes[-1] + 128
         sizes.append(k)
     return np.array(sizes, dtype=np.int64)
 
