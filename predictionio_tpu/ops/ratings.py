@@ -137,11 +137,11 @@ def bucket_lengths(max_count: int, min_k: int = 8,
             break
         k *= 2
     while sizes[-1] < max_count:
-        k = int(np.ceil(sizes[-1] * ratio / 8) * 8)
-        if k > 512:  # lane-align once past the sublane regime
-            k = int(np.ceil(sizes[-1] * ratio / 128) * 128)
+        # K is the contraction (sublane) dim: multiples of 16 satisfy the
+        # bf16 tile constraint at every size, keeping the ratio tight
+        k = int(np.ceil(sizes[-1] * ratio / 16) * 16)
         if k <= sizes[-1]:
-            k = sizes[-1] + 128
+            k = sizes[-1] + 16
         sizes.append(k)
     return np.array(sizes, dtype=np.int64)
 
