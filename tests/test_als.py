@@ -109,8 +109,9 @@ class TestSolvePlan:
         assert {8, 16, 32, 64}.issubset(set(sizes.tolist()))
         assert np.all(sizes[sizes > 64] % 16 == 0)  # bf16 sublane tiles
         assert sizes[-1] >= 10_000
-        # step ratio bounds the padding waste
-        assert np.all(np.diff(sizes) / sizes[:-1] <= 0.3)
+        # step ratio bounds the padding waste in the geometric regime
+        geo = sizes[sizes >= 64]
+        assert np.all(np.diff(geo) / geo[:-1] <= 0.3)
         assert np.all(np.diff(sizes) > 0)
 
     def test_empty(self):
