@@ -111,7 +111,9 @@ class TestSolvePlan:
         assert sizes[-1] >= 10_000
         # step ratio bounds the padding waste in the geometric regime
         geo = sizes[sizes >= 64]
-        assert np.all(np.diff(geo) / geo[:-1] <= 0.3)
+        # rounding to 16 inflates the ratio at small sizes; still well
+        # under the 2x of pow2 buckets
+        assert np.all(np.diff(geo) / geo[:-1] <= 0.45)
         assert np.all(np.diff(sizes) > 0)
 
     def test_empty(self):
