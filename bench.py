@@ -130,7 +130,9 @@ def bench_rest_latency(model, n_queries=200):
     algo = R.ALSAlgorithm(R.ALSAlgorithmParams(rank=model.rank))
 
     engine = R.RecommendationEngineFactory.apply()
-    server = EngineServer(ServerConfig(ip="127.0.0.1", port=0),
+    server = EngineServer(ServerConfig(ip="127.0.0.1", port=0,
+                                       micro_batch=32,
+                                       micro_batch_wait_ms=2.0),
                           engine=engine)
     now = dt.datetime.now(dt.timezone.utc)
     server.engine_instance = EngineInstance(

@@ -212,7 +212,13 @@ class ALSAlgorithm(P2LAlgorithm):
         if known:
             uvecs = model.als.user_factors[[uix for _, _, uix in known]]
             k_max = min(max(q.num for _, q, _ in known), model.als.n_items)
-            seen = np.zeros((len(known), model.als.n_items), dtype=bool)
+            # pad the batch dim to a power of two so the jitted scorer
+            # compiles once per size class, not per request-batch size
+            b = 1 << (len(known) - 1).bit_length()
+            pad = b - len(known)
+            if pad:
+                uvecs = np.pad(uvecs, ((0, pad), (0, 0)))
+            seen = np.zeros((b, model.als.n_items), dtype=bool)
             scores, idx = _topk_scores(
                 uvecs, cached_put(model.als.item_factors), seen, k_max)
             scores = np.asarray(scores)

@@ -1,0 +1,91 @@
+"""Micro-batching for the query path (beyond-parity).
+
+The reference serves queries one at a time per request thread
+(CreateServer.scala:515 "TODO: Parallelize"). On a TPU the per-call
+dispatch + device->host fetch dominates single-query latency, so under
+concurrent load the server can coalesce queries that arrive within a short
+window into ONE batched device call (Algorithm.batch_predict) and fan the
+results back out — the standard accelerator-serving pattern.
+
+Opt-in via ServerConfig.micro_batch > 1. Falls back to per-query predict
+when only one query is pending, so idle-traffic latency is unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class _Pending:
+    __slots__ = ("query", "event", "result", "error")
+
+    def __init__(self, query):
+        self.query = query
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    def __init__(self, process_batch, max_batch: int = 32,
+                 max_wait_ms: float = 2.0):
+        """process_batch: fn(List[query]) -> List[result]."""
+        self.process_batch = process_batch
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1000.0
+        self._q: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, query) -> Any:
+        """Blocking: enqueue and wait for the batched result."""
+        p = _Pending(query)
+        self._q.put(p)
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            # adaptive batching: drain the backlog that accumulated while
+            # the previous batch was on the device — never stall a lone
+            # query waiting for company (max_wait is an upper bound used
+            # only when the backlog is still filling)
+            import time
+            t0 = time.perf_counter()
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    if (self._q.qsize() == 0
+                            or time.perf_counter() - t0 > self.max_wait_s):
+                        break
+            try:
+                results = self.process_batch([p.query for p in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"batch handler returned {len(results)} results "
+                        f"for {len(batch)} queries")
+                for p, r in zip(batch, results):
+                    p.result = r
+                    p.event.set()
+            except BaseException as e:  # propagate to every waiter
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)

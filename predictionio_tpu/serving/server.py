@@ -49,6 +49,8 @@ class ServerConfig:
     event_server_ip: str = "0.0.0.0"
     event_server_port: int = 7070
     feedback: bool = False
+    micro_batch: int = 1       # >1 coalesces concurrent queries into one
+    micro_batch_wait_ms: float = 2.0  # batched device call (beyond-parity)
 
 
 class EngineServer:
@@ -72,6 +74,12 @@ class EngineServer:
         self.last_serving_sec = 0.0
         self.start_time = utcnow()
         self.server: Optional[HttpServer] = None
+        self.batcher = None
+        if config.micro_batch > 1:
+            from predictionio_tpu.serving.batcher import MicroBatcher
+            self.batcher = MicroBatcher(
+                self.handle_query_batch, max_batch=config.micro_batch,
+                max_wait_ms=config.micro_batch_wait_ms)
         self.router = self._build_router()
 
     # -- model loading (createServerActorWithEngine, :206-265) -------------
@@ -161,6 +169,42 @@ class EngineServer:
             self.last_serving_sec = dt
         return pred_dict
 
+    def handle_query_batch(self, query_dicts: List[dict]) -> List[dict]:
+        """Batched query path: one Algorithm.batch_predict device call for
+        all queries in the window (serving/batcher.py)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            algorithms = self.algorithms
+            models = self.models
+            serving = self.serving
+        if not algorithms:
+            raise RuntimeError("no engine loaded")
+        qc = algorithms[0].query_class
+        queries = [qc.from_dict(d) if qc is not None else d
+                   for d in query_dicts]
+        indexed = [(i, serving.supplement(q)) for i, q in enumerate(queries)]
+        per_algo = [dict(algo.batch_predict(model, indexed))
+                    for algo, model in zip(algorithms, models)]
+        out = []
+        for i, (q, d) in enumerate(zip(queries, query_dicts)):
+            prediction = serving.serve(q, [pa[i] for pa in per_algo])
+            pred_dict = (prediction.to_dict()
+                         if hasattr(prediction, "to_dict") else prediction)
+            if not isinstance(pred_dict, dict):
+                pred_dict = {"result": pred_dict}
+            if self.config.feedback:
+                pr_id = d.get("prId") or self.engine_instance.id
+                pred_dict = dict(pred_dict, prId=pr_id)
+                self._send_feedback(d, pred_dict, pr_id)
+            out.append(self.plugin_context.apply_output(
+                self.engine_instance, d, pred_dict))
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.request_count += len(queries)
+            self.serving_seconds += dt
+            self.last_serving_sec = dt / max(len(queries), 1)
+        return out
+
     # -- feedback loop (:526-596) ------------------------------------------
     def _send_feedback(self, query: dict, prediction: dict, pr_id: str):
         event = {
@@ -207,6 +251,8 @@ class EngineServer:
         d = req.json()
         if not isinstance(d, dict):
             raise ValueError("query must be a JSON object")
+        if self.batcher is not None:
+            return Response(200, self.batcher.submit(d))
         return Response(200, self.handle_query(d))
 
     def _reload(self, req: Request) -> Response:
@@ -266,6 +312,8 @@ class EngineServer:
         return self
 
     def stop(self):
+        if self.batcher is not None:
+            self.batcher.stop()
         if self.server:
             self.server.stop()
             self.server = None
