@@ -149,7 +149,8 @@ def bucket_lengths(max_count: int, min_k: int = 8,
 def build_solve_plan(group_idx: np.ndarray, counter_idx: np.ndarray,
                      values: np.ndarray, n_groups: int,
                      work_budget: int = 1 << 20, min_k: int = 8,
-                     batch_multiple: int = 1) -> SolvePlan:
+                     batch_multiple: int = 1,
+                     bucket_ratio: float = 1.15) -> SolvePlan:
     """Group COO entries by `group_idx`, bucket groups by padded segment
     length K (power of two), and emit [B, K] batches with B ~= work_budget/K
     rounded up to `batch_multiple` (the mesh data-parallel degree).
@@ -172,7 +173,8 @@ def build_solve_plan(group_idx: np.ndarray, counter_idx: np.ndarray,
     present = np.nonzero(counts)[0]
     if present.size == 0:
         return SolvePlan(batches=(), n_entities=n_groups, nnz=0)
-    sizes = bucket_lengths(int(counts[present].max()), min_k)
+    sizes = bucket_lengths(int(counts[present].max()), min_k,
+                           ratio=bucket_ratio)
     ks = sizes[np.searchsorted(sizes, counts[present], side="left")]
 
     batches: List[SolveBatch] = []
