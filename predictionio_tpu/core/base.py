@@ -28,9 +28,7 @@ import abc
 from typing import Any, Generic, Iterable, List, Optional, Sequence, Tuple, TypeVar
 
 from predictionio_tpu.core.params import Params
-from predictionio_tpu.core.persistence import (PersistentModel,
-                                               PersistentModelManifest,
-                                               RETRAIN)
+from predictionio_tpu.core.persistence import PersistentModel, RETRAIN
 
 TD = TypeVar("TD")  # training data
 EI = TypeVar("EI")  # evaluation info

@@ -14,7 +14,7 @@ import json
 import logging
 import os
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
 from predictionio_tpu.core.metrics import Metric

@@ -14,9 +14,9 @@ by params JSON so identical settings share HBM rather than re-ingesting.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict
 
-from predictionio_tpu.core.engine import (Engine, EngineParams, TrainResult,
+from predictionio_tpu.core.engine import (Engine, EngineParams,
                                           WorkflowParams)
 from predictionio_tpu.core.params import params_to_dict
 

@@ -20,7 +20,7 @@ Semantics (verified against the reference):
 from __future__ import annotations
 
 import datetime as _dt
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 from predictionio_tpu.data.datamap import PropertyMap

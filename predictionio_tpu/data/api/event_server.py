@@ -20,7 +20,6 @@ event names it may write. `channel` param scopes to a named channel.
 from __future__ import annotations
 
 import base64
-import datetime as _dt
 import logging
 from dataclasses import dataclass
 from typing import Optional

@@ -21,9 +21,7 @@ import subprocess
 import threading
 from typing import Dict, Optional, Tuple
 
-from predictionio_tpu.data.datamap import DataMap
-from predictionio_tpu.data.event import (Event, from_millis, new_event_id,
-                                         to_millis)
+from predictionio_tpu.data.event import Event, new_event_id, to_millis
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import ABSENT
 

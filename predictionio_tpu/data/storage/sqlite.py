@@ -23,7 +23,7 @@ from typing import List, Optional
 
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import (Event, from_millis, new_event_id,
-                                         to_millis, utcnow)
+                                         to_millis)
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (ABSENT, AccessKey, App,
                                                 Channel, EngineInstance,

@@ -7,8 +7,8 @@ Engine.scala Query/PredictedResult/ItemScore case classes).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Set
+from dataclasses import dataclass
+from typing import Optional, Sequence
 
 import numpy as np
 

@@ -11,8 +11,8 @@ event). The MLlib call becomes ops.als explicit training on the mesh.
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
 
 import numpy as np
 

@@ -11,7 +11,7 @@ jax.Array over the mesh — no central driver ever holds the full data.
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Sequence, Tuple
+from typing import Iterable, Optional, Tuple
 
 import numpy as np
 

@@ -20,7 +20,7 @@ import logging
 import math
 import os
 import threading
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

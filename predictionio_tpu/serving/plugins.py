@@ -13,7 +13,7 @@ import abc
 import importlib
 import logging
 import os
-from typing import Any, Dict, List
+from typing import Dict, List
 
 logger = logging.getLogger(__name__)
 

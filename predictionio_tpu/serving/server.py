@@ -15,14 +15,13 @@ lastServingSec counters match the reference status page.
 
 from __future__ import annotations
 
-import datetime as _dt
 import json
 import logging
 import threading
 import time
 import urllib.request
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from dataclasses import dataclass
+from typing import List, Optional
 
 from predictionio_tpu.core.engine import Engine, EngineParams
 from predictionio_tpu.data.event import format_event_time, utcnow

@@ -8,7 +8,6 @@ format as /events.json.
 
 from __future__ import annotations
 
-import json
 from typing import Optional
 
 from predictionio_tpu.data.event import Event, EventValidation

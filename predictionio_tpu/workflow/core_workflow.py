@@ -19,9 +19,7 @@ from typing import Optional, Sequence
 
 from predictionio_tpu.core.engine import (Engine, EngineParams,
                                           WorkflowParams)
-from predictionio_tpu.core.evaluation import (EngineParamsGenerator,
-                                              Evaluation, MetricEvaluator)
-from predictionio_tpu.core.params import params_to_json
+from predictionio_tpu.core.evaluation import Evaluation, MetricEvaluator
 from predictionio_tpu.data.storage.base import (EngineInstance,
                                                 EvaluationInstance, Model)
 from predictionio_tpu.data.storage.registry import Storage

@@ -15,7 +15,7 @@ import importlib
 import json
 import logging
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from predictionio_tpu.core.engine import WorkflowParams

@@ -14,7 +14,7 @@ from typing import Callable
 
 from predictionio_tpu.core import (DataSource, Engine, EngineParams,
                                    Evaluation, FirstServing,
-                                   IdentityPreparator, LAlgorithm, Metric,
+                                   IdentityPreparator, LAlgorithm,
                                    ZeroMetric)
 from predictionio_tpu.parallel.mesh import MeshContext, current_mesh
 
