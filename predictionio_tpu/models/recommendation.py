@@ -165,6 +165,7 @@ class ALSAlgorithmParams(Params):
     num_iterations: int = 10
     lam: float = 0.01
     seed: Optional[int] = None
+    compute_dtype: Optional[str] = None  # None = bf16 on TPU, f32 on CPU
 
 
 @dataclass
@@ -186,8 +187,11 @@ class ALSAlgorithm(P2LAlgorithm):
         p = self.params
         if pd.ratings_coo.nnz == 0:
             raise ValueError("No ratings to train on")
+        from predictionio_tpu.ops.als import default_compute_dtype
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
-                        seed=p.seed if p.seed is not None else 0)
+                        seed=p.seed if p.seed is not None else 0,
+                        compute_dtype=p.compute_dtype
+                        or default_compute_dtype())
         model = als_train(pd.ratings_coo, cfg)
         return RecommendationModel(model, pd.user_ix, pd.item_ix)
 

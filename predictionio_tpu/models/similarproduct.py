@@ -131,6 +131,7 @@ class ALSAlgorithmParams(Params):
     lam: float = 0.01
     alpha: float = 1.0
     seed: Optional[int] = None
+    compute_dtype: Optional[str] = None  # None = bf16 on TPU, f32 on CPU
 
 
 @dataclass
@@ -166,9 +167,12 @@ class ALSAlgorithm(P2LAlgorithm):
         # ((u,i),1).reduceByKey(_+_)  — view counts
         ui, ii, counts = dedup_ratings(ui, ii, ones, policy="sum")
         coo = RatingsCOO(ui, ii, counts, len(user_ix), len(item_ix))
+        from predictionio_tpu.ops.als import default_compute_dtype
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
                         implicit_prefs=True, alpha=p.alpha,
-                        seed=p.seed if p.seed is not None else 0)
+                        seed=p.seed if p.seed is not None else 0,
+                        compute_dtype=p.compute_dtype
+                        or default_compute_dtype())
         model = als_train(coo, cfg)
         item_categories = []
         for ix in range(len(item_ix)):

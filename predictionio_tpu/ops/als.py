@@ -58,6 +58,13 @@ class ALSConfig:
     # shuffles, but compiler-scheduled over ICI.
 
 
+def default_compute_dtype() -> str:
+    """bf16 Gram einsums on TPU (MXU-native, f32 accumulation), f32 on
+    CPU where bf16 is emulated."""
+    import jax
+    return "bfloat16" if jax.default_backend() == "tpu" else "float32"
+
+
 @dataclass
 class ALSModel:
     """Trained factorization. Arrays are host numpy after training; serving

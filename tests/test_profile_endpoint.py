@@ -1,0 +1,46 @@
+"""/profile.json trace endpoint (beyond-parity observability)."""
+
+import json
+import urllib.request
+
+import pytest
+
+
+def test_profile_start_stop(tmp_path):
+    import datetime as dt
+
+    from predictionio_tpu.core import Engine, EngineParams
+    from predictionio_tpu.data.storage.base import EngineInstance
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    from tests.sample_engine import (Algo0, DataSource0, Preparator0,
+                                     Serving0)
+
+    engine = Engine({"": DataSource0}, {"": Preparator0}, {"": Algo0},
+                    {"": Serving0})
+    s = EngineServer(ServerConfig(ip="127.0.0.1", port=0), engine=engine)
+    s.start()
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{s.config.port}/profile.json",
+                data=json.dumps(body).encode(), method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=15) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        trace_dir = str(tmp_path / "trace")
+        status, body = post({"action": "start", "dir": trace_dir})
+        assert status == 200 and body["message"] == "tracing"
+        import jax
+        import numpy as np
+        jax.jit(lambda x: x * 2)(np.arange(8.0)).block_until_ready()
+        status, body = post({"action": "stop"})
+        assert status == 200
+        import os
+        assert os.path.exists(trace_dir)  # trace files written
+        status, _ = post({"action": "nope"})
+        assert status == 400
+    finally:
+        s.stop()
