@@ -207,24 +207,23 @@ class ALSAlgorithm(P2LAlgorithm):
     def batch_predict(self, model, queries):
         """Evaluation path: one batched device top-k for all known users
         (vs the reference's per-query driver loop)."""
-        from predictionio_tpu.ops.als import _topk_scores
+        from predictionio_tpu.ops.als import _users_topk
         from predictionio_tpu.utils.device_cache import cached_put
         out = {ix: ItemScoreResult(()) for ix, _ in queries}
         known = [(ix, q, int(model.user_ix.get(q.user, -1)))
                  for ix, q in queries]
         known = [(ix, q, uix) for ix, q, uix in known if uix >= 0]
         if known:
-            uvecs = model.als.user_factors[[uix for _, _, uix in known]]
             k_max = min(max(q.num for _, q, _ in known), model.als.n_items)
             # pad the batch dim to a power of two so the jitted scorer
-            # compiles once per size class, not per request-batch size
+            # compiles once per size class, not per request-batch size;
+            # only the [B] index vector crosses to the device
             b = 1 << (len(known) - 1).bit_length()
-            pad = b - len(known)
-            if pad:
-                uvecs = np.pad(uvecs, ((0, pad), (0, 0)))
-            seen = np.zeros((b, model.als.n_items), dtype=bool)
-            scores, idx = _topk_scores(
-                uvecs, cached_put(model.als.item_factors), seen, k_max)
+            user_ixs = np.zeros(b, dtype=np.int32)
+            user_ixs[:len(known)] = [uix for _, _, uix in known]
+            scores, idx = _users_topk(
+                cached_put(model.als.user_factors),
+                cached_put(model.als.item_factors), user_ixs, k_max)
             scores = np.asarray(scores)
             idx = np.asarray(idx)
             for row, (ix, q, _) in enumerate(known):

@@ -248,6 +248,18 @@ def _pad_exclude(exclude, multiple: int = 64) -> np.ndarray:
 
 
 @functools.partial(__import__("jax").jit, static_argnames=("k",))
+def _users_topk(user_factors, item_factors, user_ixs, k: int):
+    """Batched serve/eval path: [B] user indices in, top-k out; factor
+    tables device-resident so only B int32s move host->device."""
+    import jax
+    import jax.numpy as jnp
+    u = user_factors[user_ixs]                                # [B, R]
+    scores = jnp.einsum("br,ir->bi", u, item_factors,
+                        preferred_element_type=jnp.float32)
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("k",))
 def _topk_scores(user_vecs, item_factors, seen_mask, k: int):
     """scores = u . V^T with seen items masked out; returns (scores, idx)."""
     import jax.numpy as jnp
