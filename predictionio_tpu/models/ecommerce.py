@@ -224,20 +224,25 @@ class ECommAlgorithm(P2LAlgorithm):
             logger.error("Error when reading unavailableItems: %s", e)
         return []
 
-    def predict(self, model: ECommerceModel, query: Query
-                ) -> ItemScoreResult:
-        black = list(query.black_list or ())
-        black += self._seen_items(query.user)
-        black += self._unavailable_items()
+    def _build_mask(self, model: ECommerceModel, query: Query,
+                    seen: List[str], unavailable: List[str]) -> np.ndarray:
+        """Candidate mask shared by the single and batched paths: query
+        blacklist + live seen-items + unavailableItems merged into the
+        exclusion set (ALSAlgorithm.scala:217-257)."""
+        black = list(query.black_list or ()) + seen + unavailable
         white = (resolve_ids(model.item_ix, query.white_list)
                  if query.white_list is not None else None)
-        mask = build_filter_mask(
+        return build_filter_mask(
             len(model.item_ix),
             exclude=resolve_ids(model.item_ix, black),
             white_list=white,
             item_categories=model.item_categories,
             categories=set(query.categories) if query.categories else None)
 
+    def predict(self, model: ECommerceModel, query: Query
+                ) -> ItemScoreResult:
+        mask = self._build_mask(model, query, self._seen_items(query.user),
+                                self._unavailable_items())
         uix = model.user_ix.get(query.user, -1)
         if uix >= 0:
             # known user: raw dot-product scoring (ALSAlgorithm.scala:230-257)
@@ -256,14 +261,15 @@ class ECommAlgorithm(P2LAlgorithm):
         keep = np.isfinite(scores) & (scores > 0)  # reference keeps score>0
         return scores[keep], idx[keep]
 
-    def _predict_new_user(self, model: ECommerceModel, query: Query,
-                          mask: np.ndarray) -> ItemScoreResult:
-        """Recent-views cosine fallback (ALSAlgorithm.scala:283-364)."""
+    def _recent_view_indices(self, model: ECommerceModel,
+                             user: str) -> np.ndarray:
+        """Dense indices of the user's 10 most recent viewed items
+        (ALSAlgorithm.scala:283-364 fallback input)."""
         try:
             recent = LEventStore.find_by_entity(
                 app_name=self.params.app_name,
                 channel_name=self.params.channel_name, entity_type="user",
-                entity_id=query.user, event_names=["view"],
+                entity_id=user, event_names=["view"],
                 target_entity_type="item", limit=10, latest=True,
                 timeout_ms=200)
             recent_items = {e.target_entity_id for e in recent
@@ -275,6 +281,13 @@ class ECommAlgorithm(P2LAlgorithm):
         if len(r_ix) == 0:
             logger.info("No productFeatures vector for recent items %s.",
                         recent_items)
+        return r_ix
+
+    def _predict_new_user(self, model: ECommerceModel, query: Query,
+                          mask: np.ndarray) -> ItemScoreResult:
+        """Recent-views cosine fallback (ALSAlgorithm.scala:283-364)."""
+        r_ix = self._recent_view_indices(model, query.user)
+        if len(r_ix) == 0:
             return ItemScoreResult(())
         query_vecs = model.item_factors_normalized[r_ix]
         scores, idx = cosine_top_k(model.item_factors_normalized, query_vecs,
@@ -282,7 +295,54 @@ class ECommAlgorithm(P2LAlgorithm):
         return top_scores_to_result(model.item_ix, scores, idx)
 
     def batch_predict(self, model, queries):
-        return [(ix, self.predict(model, q)) for ix, q in queries]
+        """Batched path (serving coalescer + eval): business-rule event
+        reads stay host-side and only mutate candidate masks; the
+        query-independent unavailableItems read happens once per batch,
+        the per-user reads run concurrently (they are I/O-bound with a
+        200 ms deadline each). The batch then needs at most two device
+        calls — one masked-matmul top-k for known users (raw dot scoring)
+        and one for new-user cosine fallbacks."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from predictionio_tpu.ops.similarity import (masked_top_k_batch,
+                                                     unpack_top_k_rows)
+        out = {ix: ItemScoreResult(()) for ix, _ in queries}
+        unavailable = self._unavailable_items()
+        known = []     # (ix, query, user_vec [R], mask [I])
+        fallback = []  # (ix, query, qsum [R], mask [I])
+        with ThreadPoolExecutor(max_workers=min(8, max(1, len(queries)))) \
+                as pool:
+            seen_futs = {ix: pool.submit(self._seen_items, q.user)
+                         for ix, q in queries}
+            recent_futs = {ix: pool.submit(self._recent_view_indices,
+                                           model, q.user)
+                           for ix, q in queries
+                           if model.user_ix.get(q.user, -1) < 0}
+            for ix, q in queries:
+                mask = self._build_mask(model, q, seen_futs[ix].result(),
+                                        unavailable)
+                uix = model.user_ix.get(q.user, -1)
+                if uix >= 0:
+                    known.append((ix, q, model.user_factors[int(uix)], mask))
+                    continue
+                logger.info("No userFeature found for user %s.", q.user)
+                recent = recent_futs[ix].result()
+                if len(recent) == 0:
+                    continue
+                qsum = model.item_factors_normalized[recent].sum(axis=0)
+                fallback.append((ix, q, qsum, mask))
+        for rows, table in ((known, model.item_factors),
+                            (fallback, model.item_factors_normalized)):
+            if not rows:
+                continue
+            k_max = max(q.num for _, q, _, _ in rows)
+            scores, idx = masked_top_k_batch(
+                table, np.stack([r[2] for r in rows]),
+                np.stack([r[3] for r in rows]), k_max)
+            for row, (ix, q, _, _) in enumerate(rows):
+                s, i = unpack_top_k_rows(scores[row], idx[row], q.num)
+                out[ix] = top_scores_to_result(model.item_ix, s, i)
+        return list(out.items())
 
 
 class ECommerceEngineFactory(EngineFactory):

@@ -185,6 +185,21 @@ class ALSAlgorithm(P2LAlgorithm):
             items=dict(td.items),
             item_categories=item_categories)
 
+    @staticmethod
+    def _build_mask(model: SimilarProductModel, query: Query,
+                    q_ix: np.ndarray) -> np.ndarray:
+        """Candidate mask shared by the single and batched paths
+        (isCandidateItem, ALSAlgorithm.scala:192+); query items excluded."""
+        white = (resolve_ids(model.item_ix, query.white_list)
+                 if query.white_list is not None else None)
+        black = resolve_ids(model.item_ix, query.black_list or ())
+        return build_filter_mask(
+            len(model.item_ix),
+            exclude=np.concatenate([q_ix, black]),
+            white_list=white,
+            item_categories=model.item_categories,
+            categories=set(query.categories) if query.categories else None)
+
     def predict(self, model: SimilarProductModel, query: Query
                 ) -> ItemScoreResult:
         q_ix = resolve_ids(model.item_ix, query.items)
@@ -193,21 +208,38 @@ class ALSAlgorithm(P2LAlgorithm):
                         query.items)
             return ItemScoreResult(())
         query_vecs = model.item_factors_normalized[q_ix]
-        white = (resolve_ids(model.item_ix, query.white_list)
-                 if query.white_list is not None else None)
-        black = resolve_ids(model.item_ix, query.black_list or ())
-        mask = build_filter_mask(
-            len(model.item_ix),
-            exclude=np.concatenate([q_ix, black]),  # query items excluded
-            white_list=white,
-            item_categories=model.item_categories,
-            categories=set(query.categories) if query.categories else None)
+        mask = self._build_mask(model, query, q_ix)
         scores, idx = cosine_top_k(model.item_factors_normalized, query_vecs,
                                    query.num, mask)
         return top_scores_to_result(model.item_ix, scores, idx)
 
     def batch_predict(self, model, queries):
-        return [(ix, self.predict(model, q)) for ix, q in queries]
+        """Batched path (serving coalescer + eval): the cosine score is
+        linear over query items, so each query collapses to one summed
+        normalized vector and the whole batch is a single masked matmul +
+        top-k device call (vs the reference's per-query driver scan)."""
+        from predictionio_tpu.ops.similarity import (masked_top_k_batch,
+                                                     unpack_top_k_rows)
+        out = {ix: ItemScoreResult(()) for ix, _ in queries}
+        rows = []  # (ix, query, qsum [R], mask [I])
+        for ix, q in queries:
+            q_ix = resolve_ids(model.item_ix, q.items)
+            if len(q_ix) == 0:
+                logger.info("No productFeatures vector for query items %s.",
+                            q.items)
+                continue
+            qsum = model.item_factors_normalized[q_ix].sum(axis=0)
+            rows.append((ix, q, qsum, self._build_mask(model, q, q_ix)))
+        if rows:
+            k_max = max(q.num for _, q, _, _ in rows)
+            scores, idx = masked_top_k_batch(
+                model.item_factors_normalized,
+                np.stack([r[2] for r in rows]),
+                np.stack([r[3] for r in rows]), k_max)
+            for row, (ix, q, _, _) in enumerate(rows):
+                s, i = unpack_top_k_rows(scores[row], idx[row], q.num)
+                out[ix] = top_scores_to_result(model.item_ix, s, i)
+        return list(out.items())
 
 
 class SimilarProductEngineFactory(EngineFactory):

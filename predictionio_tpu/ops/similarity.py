@@ -31,6 +31,54 @@ def _cosine_topk(query_vecs, item_norms, allowed, k: int):
     return jax.lax.top_k(scores, k)
 
 
+@functools.partial(__import__("jax").jit, static_argnames=("k",))
+def _batched_masked_topk(query_mat, item_table, allowed, k: int):
+    """query_mat [B, R], item_table [I, R], allowed [B, I] bool.
+    Score = query_mat @ item_table.T; items with score <= 0 or not allowed
+    are excluded (score -> -inf). One device call for the whole batch."""
+    import jax
+    import jax.numpy as jnp
+    scores = jnp.einsum("br,ir->bi", query_mat, item_table,
+                        preferred_element_type=jnp.float32)
+    scores = jnp.where(allowed & (scores > 0), scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
+                       masks: np.ndarray, k: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched positive-masked dot top-k: one jitted call for B queries.
+
+    query_vecs [B, R] (already in the scoring space: raw user factors for
+    dot scoring, summed-normalized item vectors for cosine), masks [B, I]
+    bool. Both the batch dim and k are padded to powers of two so the
+    kernel compiles once per (batch, k) size class even though q.num is
+    client-controlled. Returns ([B, k'], [B, k']) numpy arrays with
+    k' >= min(k, I); rows may contain -inf for excluded slots (caller
+    filters non-finite and slices to its own num)."""
+    from predictionio_tpu.utils.device_cache import cached_put
+    n_items = item_table.shape[0]
+    n = query_vecs.shape[0]
+    b = 1 << max(0, (n - 1).bit_length())
+    qp = np.zeros((b, query_vecs.shape[1]), dtype=np.float32)
+    qp[:n] = query_vecs
+    mp = np.zeros((b, n_items), dtype=bool)
+    mp[:n] = masks
+    k_eff = min(1 << max(0, (k - 1).bit_length()), n_items)
+    scores, idx = _batched_masked_topk(qp, cached_put(item_table), mp, k_eff)
+    return np.asarray(scores)[:n], np.asarray(idx)[:n]
+
+
+def unpack_top_k_rows(scores_row: np.ndarray, idx_row: np.ndarray,
+                      num: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-query view of one masked_top_k_batch row: slice to the query's
+    own num and drop -inf (excluded) slots."""
+    scores_row = scores_row[:num]
+    idx_row = idx_row[:num]
+    keep = np.isfinite(scores_row)
+    return scores_row[keep], idx_row[keep]
+
+
 def normalize_rows(factors: np.ndarray) -> np.ndarray:
     norms = np.linalg.norm(factors, axis=-1, keepdims=True)
     return (factors / np.maximum(norms, 1e-12)).astype(np.float32)

@@ -156,6 +156,30 @@ class TestClassificationTemplate:
         assert len(td.labeled_points) == 1
 
 
+def assert_results_match(batched, single, query):
+    """Batched and single paths must return the same ranking; items whose
+    scores tie (to f32 noise) may come back in either order."""
+    b = [(s.item, s.score) for s in batched.item_scores]
+    s = [(s.item, s.score) for s in single.item_scores]
+    assert len(b) == len(s), query
+    np.testing.assert_allclose([x[1] for x in b], [x[1] for x in s],
+                               rtol=1e-4, err_msg=str(query))
+
+    def tie_groups(pairs):
+        groups, cur = [], []
+        for item, score in pairs:
+            if cur and abs(score - cur[-1][1]) > 1e-4 * max(
+                    abs(score), abs(cur[-1][1]), 1e-9):
+                groups.append({i for i, _ in cur})
+                cur = []
+            cur.append((item, score))
+        if cur:
+            groups.append({i for i, _ in cur})
+        return groups
+
+    assert tie_groups(b) == tie_groups(s), query
+
+
 class TestSimilarProductTemplate:
     def seed(self, app_id):
         rng = np.random.default_rng(2)
@@ -214,6 +238,26 @@ class TestSimilarProductTemplate:
         # unknown query item -> empty
         res = algo.predict(model, S.Query(items=("nope",), num=3))
         assert res.item_scores == ()
+
+    def test_batch_predict_matches_single(self, app, mesh8):
+        from predictionio_tpu.models import similarproduct as S
+        self.seed(app)
+        engine = S.SimilarProductEngineFactory.apply()
+        tr = engine.train(self.params())
+        algo = tr.algorithms[0]
+        model = tr.models[0]
+        queries = [
+            S.Query(items=("i00",), num=3),
+            S.Query(items=("i00", "i01"), num=5),
+            S.Query(items=("i10",), num=8, categories=("catB",)),
+            S.Query(items=("i00",), num=8, black_list=("i01",)),
+            S.Query(items=("i00",), num=8, white_list=("i02", "i03")),
+            S.Query(items=("nope",), num=3),
+        ]
+        batched = dict(algo.batch_predict(
+            model, list(enumerate(queries))))
+        for ix, q in enumerate(queries):
+            assert_results_match(batched[ix], algo.predict(model, q), q)
 
 
 class TestECommerceTemplate:
@@ -280,6 +324,30 @@ class TestECommerceTemplate:
         # new user with no views at all -> empty
         res = algo.predict(tr.models[0], E.Query(user="ghost", num=4))
         assert res.item_scores == ()
+
+    def test_batch_predict_matches_single(self, app, mesh8):
+        from predictionio_tpu.models import ecommerce as E
+        self.seed(app)
+        insert(app, "view", "user", "u0", "item", "i00", sec=500)
+        insert(app, "view", "user", "fresh", "item", "i10", sec=700)
+        insert(app, "$set", "constraint", "unavailableItems",
+               props={"items": ["i11"]}, sec=600)
+        engine = E.ECommerceEngineFactory.apply()
+        tr = engine.train(self.params(unseen_only=True,
+                                      seen_events=("view",)))
+        algo = tr.algorithms[0]
+        model = tr.models[0]
+        queries = [
+            E.Query(user="u0", num=4),                       # known + seen
+            E.Query(user="u1", num=8, categories=("catB",)),  # known
+            E.Query(user="u2", num=8, black_list=("i02",)),   # known
+            E.Query(user="fresh", num=4),                     # cosine fallback
+            E.Query(user="ghost", num=4),                     # empty
+        ]
+        batched = dict(algo.batch_predict(
+            model, list(enumerate(queries))))
+        for ix, q in enumerate(queries):
+            assert_results_match(batched[ix], algo.predict(model, q), q)
 
     def test_model_survives_serialization(self, app, mesh8):
         from predictionio_tpu.models import ecommerce as E
