@@ -2,7 +2,8 @@
 
 Usage:
     python examples/quickstart.py [recommendation|classification|
-                                   similarproduct|ecommercerecommendation]
+                                   similarproduct|ecommercerecommendation|
+                                   recommendeduser]
 
 Seeds a temporary event store with synthetic events, trains the engine via
 the workflow runtime, deploys the engine server on a local port, and fires
@@ -26,6 +27,21 @@ def seed_events(app_id, family):
     rng = np.random.default_rng(0)
     ev = Storage.get_events()
     events = []
+    if family == "recommendeduser":
+        # two follow communities: even users follow even users, odd odd
+        for u in range(10):
+            events.append(Event(event="$set", entity_type="user",
+                                entity_id=f"u{u}"))
+        for u in range(10):
+            for v in range(10):
+                if u != v and u % 2 == v % 2 and rng.random() < 0.8:
+                    events.append(Event(
+                        event="follow", entity_type="user",
+                        entity_id=f"u{u}", target_entity_type="user",
+                        target_entity_id=f"u{v}"))
+        ev.insert_batch(events, app_id)
+        print(f"Seeded {len(events)} events.")
+        return
     if family == "classification":
         for j in range(60):
             label = float(j % 2)
@@ -66,6 +82,7 @@ QUERIES = {
     "classification": {"attr0": 9.0, "attr1": 1.0, "attr2": 1.0},
     "similarproduct": {"items": ["i00"], "num": 4},
     "ecommercerecommendation": {"user": "u1", "num": 4},
+    "recommendeduser": {"users": ["u1"], "num": 4},
 }
 
 

@@ -10,12 +10,14 @@ from typing import Dict, Type
 
 def _registry() -> Dict[str, type]:
     from predictionio_tpu.models import (classification, ecommerce,
-                                         recommendation, similarproduct)
+                                         recommendation, recommendeduser,
+                                         similarproduct)
     return {
         "recommendation": recommendation.RecommendationEngineFactory,
         "classification": classification.ClassificationEngineFactory,
         "similarproduct": similarproduct.SimilarProductEngineFactory,
         "ecommercerecommendation": ecommerce.ECommerceEngineFactory,
+        "recommendeduser": recommendeduser.RecommendedUserEngineFactory,
     }
 
 

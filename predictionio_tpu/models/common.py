@@ -7,8 +7,8 @@ Engine.scala Query/PredictedResult/ItemScore case classes).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
@@ -17,11 +17,22 @@ from predictionio_tpu.data.bimap import EntityIdIxMap
 
 @dataclass(frozen=True)
 class ItemScore:
+    """Optional extra item properties ride along in the result JSON — the
+    custom-query variant returns creationYear on each ItemScore
+    (custom-query/Engine.scala:12) and add-and-return-item-properties does
+    the same for arbitrary properties."""
     item: str
     score: float
+    properties: Optional[Mapping] = field(default=None, compare=False)
 
     def to_dict(self):
-        return {"item": self.item, "score": float(self.score)}
+        d = {"item": self.item, "score": float(self.score)}
+        if self.properties:
+            # never let a property named "item"/"score" clobber the wire
+            # fields
+            d.update({k: v for k, v in self.properties.items()
+                      if k not in ("item", "score")})
+        return d
 
 
 @dataclass(frozen=True)
@@ -43,7 +54,14 @@ def resolve_ids(ix_map: EntityIdIxMap, ids: Optional[Sequence[str]]
 
 
 def top_scores_to_result(ix_map: EntityIdIxMap, scores: np.ndarray,
-                         idx: np.ndarray) -> ItemScoreResult:
+                         idx: np.ndarray,
+                         properties_of=None) -> ItemScoreResult:
+    """properties_of: optional callable dense-index -> property dict (or
+    None) merged into each ItemScore's JSON."""
     items = ix_map.ids_of(idx) if len(idx) else []
+    if properties_of is None:
+        return ItemScoreResult(tuple(
+            ItemScore(item, float(s)) for item, s in zip(items, scores)))
     return ItemScoreResult(tuple(
-        ItemScore(item, float(s)) for item, s in zip(items, scores)))
+        ItemScore(item, float(s), properties_of(int(i)))
+        for item, s, i in zip(items, scores, idx)))

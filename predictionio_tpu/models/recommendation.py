@@ -45,6 +45,7 @@ class Rating:
 @dataclass
 class TrainingData(SanityCheck):
     ratings: List[Rating]
+    items: Optional[dict] = None  # id -> property dict (read_items variants)
 
     def sanity_check(self):
         if not self.ratings:
@@ -53,12 +54,23 @@ class TrainingData(SanityCheck):
 
 @dataclass(frozen=True)
 class Query:
+    """Base query is (user, num); the custom-query and filter-by-category
+    variants add creationYear and categories (custom-query/Engine.scala:6,
+    filter-by-category/Engine.scala:6-10) — optional here, so the base wire
+    format is unchanged."""
     user: str
     num: int
+    categories: Optional[Tuple[str, ...]] = None
+    creation_year: Optional[int] = None
 
     @staticmethod
     def from_dict(d: dict) -> "Query":
-        return Query(user=str(d["user"]), num=int(d["num"]))
+        cats = d.get("categories")
+        return Query(user=str(d["user"]), num=int(d["num"]),
+                     categories=tuple(cats) if cats is not None else None,
+                     creation_year=(int(d["creationYear"])
+                                    if d.get("creationYear") is not None
+                                    else None))
 
 
 @dataclass
@@ -66,6 +78,7 @@ class PreparedData:
     ratings_coo: RatingsCOO
     user_ix: EntityIdIxMap
     item_ix: EntityIdIxMap
+    items: Optional[dict] = None  # id -> property dict
 
 
 # -- DASE components --------------------------------------------------------
@@ -78,6 +91,9 @@ class DataSourceParams(Params):
     buy_rating: float = 4.0  # implicit rating assigned to buy events
     eval_k: Optional[int] = None    # enable k-fold read_eval when set
     eval_query_num: int = 10        # query.num used for eval queries
+    # custom-query / filter-by-category variants: read $set item properties
+    # (categories, creationYear, ...) for predict-time filters
+    read_items: bool = False
 
 
 @dataclass(frozen=True)
@@ -109,8 +125,17 @@ class RecommendationDataSource(DataSource):
                                   to_millis(e.event_time)))
         return ratings
 
+    def _read_items(self) -> Optional[dict]:
+        if not self.params.read_items:
+            return None
+        return {eid: dict(pm.fields) for eid, pm in
+                PEventStore.aggregate_properties(
+                    app_name=self.params.app_name,
+                    channel_name=self.params.channel_name,
+                    entity_type="item").items()}
+
     def read_training(self) -> TrainingData:
-        return TrainingData(self._read_ratings())
+        return TrainingData(self._read_ratings(), items=self._read_items())
 
     def read_eval(self):
         """k-fold split of rating events; one query per test-fold user with
@@ -156,7 +181,7 @@ class RecommendationPreparator(Preparator):
         ts = np.array([r.t for r in td.ratings], dtype=np.int64)
         ui, ii, vals = dedup_ratings(ui, ii, vals, ts, self.params.dedup)
         coo = RatingsCOO(ui, ii, vals, len(user_ix), len(item_ix))
-        return PreparedData(coo, user_ix, item_ix)
+        return PreparedData(coo, user_ix, item_ix, items=td.items)
 
 
 @dataclass(frozen=True)
@@ -166,6 +191,9 @@ class ALSAlgorithmParams(Params):
     lam: float = 0.01
     seed: Optional[int] = None
     compute_dtype: Optional[str] = None  # None = bf16 on TPU, f32 on CPU
+    # custom-query variant: property keys copied onto each ItemScore in the
+    # result JSON (e.g. ("creationYear",)); requires data source read_items
+    return_properties: Tuple[str, ...] = ()
 
 
 @dataclass
@@ -173,6 +201,55 @@ class RecommendationModel:
     als: ALSModel
     user_ix: EntityIdIxMap
     item_ix: EntityIdIxMap
+    # by dense item index; present when the data source read item properties
+    item_properties: Optional[List[Optional[dict]]] = None
+    # derived at train time so per-query masks are vectorized, not
+    # O(n_items) Python loops on the serve path
+    item_categories: Optional[List[Optional[set]]] = None
+    item_years: Optional[np.ndarray] = None  # float32, NaN = undated
+
+    @staticmethod
+    def derive_filters(item_properties):
+        if item_properties is None:
+            return None, None
+        cats = [set(p["categories"]) if p and p.get("categories") else None
+                for p in item_properties]
+        years = np.array(
+            [float(p["creationYear"])
+             if p and p.get("creationYear") is not None else np.nan
+             for p in item_properties], dtype=np.float32)
+        return cats, years
+
+    def properties_of(self, keys: Tuple[str, ...]):
+        """ItemScore property passthrough: requested keys always present
+        (missing -> None/null, the Option[Int] wire shape of
+        custom-query/Engine.scala:12)."""
+        if not keys or self.item_properties is None:
+            return None
+        props = self.item_properties
+
+        def get(ix: int):
+            p = props[ix] or {}
+            return {k: p.get(k) for k in keys}
+        return get
+
+    def allowed_mask(self, query: Query) -> Optional[np.ndarray]:
+        """Candidate mask for the filter variants; None = no filtering.
+        categories: item must share a category (filter-by-category; empty
+        list = no filter, as in the other templates); creationYear: undated
+        items pass, dated items need year >= query's
+        (custom-query/ALSAlgorithm.scala:141-148)."""
+        from predictionio_tpu.ops.similarity import build_filter_mask
+        want_cats = set(query.categories) if query.categories else None
+        if want_cats is None and query.creation_year is None:
+            return None
+        n = len(self.item_ix)
+        mask = build_filter_mask(
+            n, item_categories=self.item_categories, categories=want_cats)
+        if query.creation_year is not None and self.item_years is not None:
+            dated = ~np.isnan(self.item_years)
+            mask &= ~(dated & (self.item_years < query.creation_year))
+        return mask
 
 
 class ALSAlgorithm(P2LAlgorithm):
@@ -193,7 +270,14 @@ class ALSAlgorithm(P2LAlgorithm):
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype())
         model = als_train(pd.ratings_coo, cfg)
-        return RecommendationModel(model, pd.user_ix, pd.item_ix)
+        item_properties = None
+        if pd.items is not None:
+            item_properties = [pd.items.get(pd.item_ix.id_of(ix))
+                               for ix in range(len(pd.item_ix))]
+        cats, years = RecommendationModel.derive_filters(item_properties)
+        return RecommendationModel(model, pd.user_ix, pd.item_ix,
+                                   item_properties=item_properties,
+                                   item_categories=cats, item_years=years)
 
     def predict(self, model: RecommendationModel, query: Query
                 ) -> ItemScoreResult:
@@ -201,34 +285,73 @@ class ALSAlgorithm(P2LAlgorithm):
         if uix < 0:
             logger.info("No prediction for unknown user %s.", query.user)
             return ItemScoreResult(())
-        scores, idx = recommend_products(model.als, int(uix), query.num)
-        return top_scores_to_result(model.item_ix, scores, idx)
+        props_of = model.properties_of(self.params.return_properties)
+        mask = model.allowed_mask(query)
+        if mask is None:
+            scores, idx = recommend_products(model.als, int(uix), query.num)
+            return top_scores_to_result(model.item_ix, scores, idx,
+                                        properties_of=props_of)
+        # filtered path: ship the fixed-shape [I] bool mask, not a dense
+        # exclude-index array whose length would recompile the kernel
+        from predictionio_tpu.ops.similarity import (masked_top_k_batch,
+                                                     unpack_top_k_rows)
+        scores, idx = masked_top_k_batch(
+            model.als.item_factors,
+            model.als.user_factors[int(uix)][None], mask[None],
+            query.num, filter_positive=False)
+        s, i = unpack_top_k_rows(scores[0], idx[0], query.num)
+        return top_scores_to_result(model.item_ix, s, i,
+                                    properties_of=props_of)
 
     def batch_predict(self, model, queries):
-        """Evaluation path: one batched device top-k for all known users
-        (vs the reference's per-query driver loop)."""
+        """Evaluation/serving path: one batched device top-k for all known
+        users (vs the reference's per-query driver loop). Queries carrying
+        category/year filters take a second batched call with per-query
+        candidate masks."""
         from predictionio_tpu.ops.als import _users_topk
         from predictionio_tpu.utils.device_cache import cached_put
+        props_of = model.properties_of(self.params.return_properties)
         out = {ix: ItemScoreResult(()) for ix, _ in queries}
-        known = [(ix, q, int(model.user_ix.get(q.user, -1)))
-                 for ix, q in queries]
-        known = [(ix, q, uix) for ix, q, uix in known if uix >= 0]
-        if known:
-            k_max = min(max(q.num for _, q, _ in known), model.als.n_items)
+        plain, masked = [], []
+        for ix, q in queries:
+            uix = int(model.user_ix.get(q.user, -1))
+            if uix < 0:
+                logger.info("No prediction for unknown user %s.", q.user)
+                continue
+            mask = model.allowed_mask(q)
+            (plain if mask is None else masked).append((ix, q, uix, mask))
+        if plain:
+            k_max = min(max(q.num for _, q, _, _ in plain),
+                        model.als.n_items)
             # pad the batch dim to a power of two so the jitted scorer
             # compiles once per size class, not per request-batch size;
             # only the [B] index vector crosses to the device
-            b = 1 << (len(known) - 1).bit_length()
+            b = 1 << (len(plain) - 1).bit_length()
             user_ixs = np.zeros(b, dtype=np.int32)
-            user_ixs[:len(known)] = [uix for _, _, uix in known]
+            user_ixs[:len(plain)] = [uix for _, _, uix, _ in plain]
             scores, idx = _users_topk(
                 cached_put(model.als.user_factors),
                 cached_put(model.als.item_factors), user_ixs, k_max)
             scores = np.asarray(scores)
             idx = np.asarray(idx)
-            for row, (ix, q, _) in enumerate(known):
+            for row, (ix, q, _, _) in enumerate(plain):
                 out[ix] = top_scores_to_result(
-                    model.item_ix, scores[row][:q.num], idx[row][:q.num])
+                    model.item_ix, scores[row][:q.num], idx[row][:q.num],
+                    properties_of=props_of)
+        if masked:
+            from predictionio_tpu.ops.similarity import (masked_top_k_batch,
+                                                         unpack_top_k_rows)
+            k_max = max(q.num for _, q, _, _ in masked)
+            scores, idx = masked_top_k_batch(
+                model.als.item_factors,
+                np.stack([model.als.user_factors[uix]
+                          for _, _, uix, _ in masked]),
+                np.stack([mask for _, _, _, mask in masked]),
+                k_max, filter_positive=False)
+            for row, (ix, q, _, _) in enumerate(masked):
+                s, i = unpack_top_k_rows(scores[row], idx[row], q.num)
+                out[ix] = top_scores_to_result(model.item_ix, s, i,
+                                               properties_of=props_of)
         return list(out.items())
 
 

@@ -35,6 +35,8 @@ logger = logging.getLogger(__name__)
 @dataclass(frozen=True)
 class Item:
     categories: Optional[Tuple[str, ...]] = None
+    # full $set property bag (add-and-return-item-properties variant)
+    properties: Optional[dict] = None
 
 
 @dataclass(frozen=True)
@@ -44,11 +46,21 @@ class ViewEvent:
     t: int = 0
 
 
+@dataclass(frozen=True)
+class LikeEvent:
+    """like/dislike event (multi variant: LikeAlgorithm.scala:15-76)."""
+    user: str
+    item: str
+    like: bool
+    t: int = 0
+
+
 @dataclass
 class TrainingData(SanityCheck):
     users: Dict[str, dict]
     items: Dict[str, Item]
     view_events: List[ViewEvent]
+    like_events: List[LikeEvent] = None  # filled when read_like_events on
 
     def sanity_check(self):
         if not self.view_events:
@@ -64,16 +76,22 @@ class Query:
     categories: Optional[Tuple[str, ...]] = None
     white_list: Optional[Tuple[str, ...]] = None
     black_list: Optional[Tuple[str, ...]] = None
+    # filterbyyear variant (filterbyyear/Engine.scala:22,
+    # ALSAlgorithm.scala:231): only items with year > recommendFromYear
+    recommend_from_year: Optional[int] = None
 
     @staticmethod
     def from_dict(d: dict) -> "Query":
         def opt(key):
             v = d.get(key)
             return tuple(v) if v is not None else None
+        rfy = d.get("recommendFromYear")
         return Query(items=tuple(d["items"]), num=int(d["num"]),
                      categories=opt("categories"),
                      white_list=opt("whiteList"),
-                     black_list=opt("blackList"))
+                     black_list=opt("blackList"),
+                     recommend_from_year=(int(rfy) if rfy is not None
+                                          else None))
 
 
 @dataclass
@@ -85,11 +103,18 @@ class PreparedData:
 class DataSourceParams(Params):
     app_name: str = "default"
     channel_name: Optional[str] = None
+    # add-rateevent variant: treat rate events as views as well
+    rate_as_view: bool = False
+    # multi variant: also read like/dislike events for LikeAlgorithm
+    read_like_events: bool = False
 
 
 class SimilarProductDataSource(DataSource):
     """(multi/DataSource.scala readTraining: $set user, $set item with
-    categories, view events)"""
+    categories, view events, like/dislike events). The add-rateevent
+    variant's rate-as-view mapping and the no-set-user variant (users are
+    inferred from view events; $set user events are optional) are folded in
+    as parameters."""
     PARAMS_CLASS = DataSourceParams
 
     def __init__(self, params=None):
@@ -107,16 +132,29 @@ class SimilarProductDataSource(DataSource):
                 app_name=app, channel_name=chan,
                 entity_type="item").items():
             cats = pm.get_opt("categories", list)
-            items[eid] = Item(tuple(cats) if cats is not None else None)
+            items[eid] = Item(tuple(cats) if cats is not None else None,
+                              properties=dict(pm.fields))
+        view_names = ["view", "rate"] if self.params.rate_as_view \
+            else ["view"]
         views = []
         from predictionio_tpu.data.event import to_millis
         for e in PEventStore.find(app_name=app, channel_name=chan,
                                   entity_type="user",
-                                  event_names=["view"],
+                                  event_names=view_names,
                                   target_entity_type="item"):
             views.append(ViewEvent(e.entity_id, e.target_entity_id,
                                    to_millis(e.event_time)))
-        return TrainingData(users=users, items=items, view_events=views)
+        likes = []
+        if self.params.read_like_events:
+            for e in PEventStore.find(app_name=app, channel_name=chan,
+                                      entity_type="user",
+                                      event_names=["like", "dislike"],
+                                      target_entity_type="item"):
+                likes.append(LikeEvent(e.entity_id, e.target_entity_id,
+                                       e.event == "like",
+                                       to_millis(e.event_time)))
+        return TrainingData(users=users, items=items, view_events=views,
+                            like_events=likes)
 
 
 class SimilarProductPreparator(Preparator):
@@ -132,6 +170,9 @@ class ALSAlgorithmParams(Params):
     alpha: float = 1.0
     seed: Optional[int] = None
     compute_dtype: Optional[str] = None  # None = bf16 on TPU, f32 on CPU
+    # add-and-return-item-properties variant: property keys copied onto
+    # each ItemScore in the result JSON (missing -> null)
+    return_properties: Tuple[str, ...] = ()
 
 
 @dataclass
@@ -142,6 +183,30 @@ class SimilarProductModel:
     item_ix: EntityIdIxMap
     items: Dict[str, Item]
     item_categories: List[Optional[set]]  # by dense index
+    item_years: Optional[np.ndarray] = None  # float32, NaN = undated
+
+    @staticmethod
+    def derive_years(items: Dict[str, Item],
+                     item_ix: EntityIdIxMap) -> np.ndarray:
+        years = np.full(len(item_ix), np.nan, dtype=np.float32)
+        for ix in range(len(item_ix)):
+            item = items.get(item_ix.id_of(ix))
+            y = (item.properties or {}).get("year") if item else None
+            if y is not None:
+                years[ix] = float(y)
+        return years
+
+    def properties_of(self, keys: Tuple[str, ...]):
+        """ItemScore property passthrough (add-and-return-item-properties
+        variant): requested keys always present, missing -> None/null."""
+        if not keys:
+            return None
+
+        def get(ix: int):
+            item = self.items.get(self.item_ix.id_of(ix))
+            p = (item.properties if item and item.properties else {})
+            return {k: p.get(k) for k in keys}
+        return get
 
 
 class ALSAlgorithm(P2LAlgorithm):
@@ -151,22 +216,27 @@ class ALSAlgorithm(P2LAlgorithm):
     def __init__(self, params=None):
         super().__init__(params or ALSAlgorithmParams())
 
-    def train(self, pd: PreparedData) -> SimilarProductModel:
-        td = pd.td
-        p = self.params
+    def _build_ratings(self, td: TrainingData
+                       ) -> Tuple[EntityIdIxMap, EntityIdIxMap, RatingsCOO]:
+        """((u,i),1).reduceByKey(_+_) — view counts. Item vocabulary covers
+        all $set items (so unseen-in-views items still resolve), users only
+        those with views."""
         if not td.view_events:
             raise ValueError("No view events to train on")
-        # item vocabulary covers all $set items (so unseen-in-views items
-        # still resolve), users only those with views
         user_ix = EntityIdIxMap.build(v.user for v in td.view_events)
         item_ix = EntityIdIxMap.build(list(td.items.keys()) +
                                       [v.item for v in td.view_events])
         ui = user_ix.to_indices([v.user for v in td.view_events])
         ii = item_ix.to_indices([v.item for v in td.view_events])
         ones = np.ones(len(td.view_events), dtype=np.float32)
-        # ((u,i),1).reduceByKey(_+_)  — view counts
         ui, ii, counts = dedup_ratings(ui, ii, ones, policy="sum")
-        coo = RatingsCOO(ui, ii, counts, len(user_ix), len(item_ix))
+        return user_ix, item_ix, RatingsCOO(ui, ii, counts,
+                                            len(user_ix), len(item_ix))
+
+    def train(self, pd: PreparedData) -> SimilarProductModel:
+        td = pd.td
+        p = self.params
+        user_ix, item_ix, coo = self._build_ratings(td)
         from predictionio_tpu.ops.als import default_compute_dtype
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
                         implicit_prefs=True, alpha=p.alpha,
@@ -183,7 +253,8 @@ class ALSAlgorithm(P2LAlgorithm):
             item_factors_normalized=normalize_rows(model.item_factors),
             item_ix=item_ix,
             items=dict(td.items),
-            item_categories=item_categories)
+            item_categories=item_categories,
+            item_years=SimilarProductModel.derive_years(td.items, item_ix))
 
     @staticmethod
     def _build_mask(model: SimilarProductModel, query: Query,
@@ -193,12 +264,19 @@ class ALSAlgorithm(P2LAlgorithm):
         white = (resolve_ids(model.item_ix, query.white_list)
                  if query.white_list is not None else None)
         black = resolve_ids(model.item_ix, query.black_list or ())
-        return build_filter_mask(
+        mask = build_filter_mask(
             len(model.item_ix),
             exclude=np.concatenate([q_ix, black]),
             white_list=white,
             item_categories=model.item_categories,
             categories=set(query.categories) if query.categories else None)
+        if query.recommend_from_year is not None and \
+                model.item_years is not None:
+            # filterbyyear: dated items need year > recommendFromYear
+            # (undated items pass)
+            dated = ~np.isnan(model.item_years)
+            mask &= ~(dated & (model.item_years <= query.recommend_from_year))
+        return mask
 
     def predict(self, model: SimilarProductModel, query: Query
                 ) -> ItemScoreResult:
@@ -211,7 +289,9 @@ class ALSAlgorithm(P2LAlgorithm):
         mask = self._build_mask(model, query, q_ix)
         scores, idx = cosine_top_k(model.item_factors_normalized, query_vecs,
                                    query.num, mask)
-        return top_scores_to_result(model.item_ix, scores, idx)
+        return top_scores_to_result(
+            model.item_ix, scores, idx,
+            properties_of=model.properties_of(self.params.return_properties))
 
     def batch_predict(self, model, queries):
         """Batched path (serving coalescer + eval): the cosine score is
@@ -236,10 +316,38 @@ class ALSAlgorithm(P2LAlgorithm):
                 model.item_factors_normalized,
                 np.stack([r[2] for r in rows]),
                 np.stack([r[3] for r in rows]), k_max)
+            props_of = model.properties_of(self.params.return_properties)
             for row, (ix, q, _, _) in enumerate(rows):
                 s, i = unpack_top_k_rows(scores[row], idx[row], q.num)
-                out[ix] = top_scores_to_result(model.item_ix, s, i)
+                out[ix] = top_scores_to_result(model.item_ix, s, i,
+                                               properties_of=props_of)
         return list(out.items())
+
+
+class LikeAlgorithm(ALSAlgorithm):
+    """Implicit ALS on like/dislike events (multi variant,
+    LikeAlgorithm.scala:15-76): latest event per (user, item) wins — a user
+    may like an item and change to dislike later — like maps to rating 1,
+    dislike to -1 (a negative implicit signal: confidence with preference
+    0). Serve path is the same cosine scan as ALSAlgorithm."""
+
+    def _build_ratings(self, td: TrainingData
+                       ) -> Tuple[EntityIdIxMap, EntityIdIxMap, RatingsCOO]:
+        likes = td.like_events or []
+        if not likes:
+            raise ValueError("No like/dislike events to train on "
+                             "(set read_like_events on the data source)")
+        user_ix = EntityIdIxMap.build(e.user for e in likes)
+        item_ix = EntityIdIxMap.build(list(td.items.keys()) +
+                                      [e.item for e in likes])
+        ui = user_ix.to_indices([e.user for e in likes])
+        ii = item_ix.to_indices([e.item for e in likes])
+        vals = np.array([1.0 if e.like else -1.0 for e in likes],
+                        dtype=np.float32)
+        ts = np.array([e.t for e in likes], dtype=np.int64)
+        ui, ii, vals = dedup_ratings(ui, ii, vals, ts, policy="latest")
+        return user_ix, item_ix, RatingsCOO(ui, ii, vals,
+                                            len(user_ix), len(item_ix))
 
 
 class SimilarProductEngineFactory(EngineFactory):
@@ -248,7 +356,7 @@ class SimilarProductEngineFactory(EngineFactory):
         return Engine(
             {"": SimilarProductDataSource},
             {"": SimilarProductPreparator},
-            {"als": ALSAlgorithm},
+            {"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
             {"": FirstServing})
 
     @classmethod

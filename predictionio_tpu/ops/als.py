@@ -19,9 +19,12 @@ Math parity with MLlib 1.3:
   explicit  — ALS-WR: minimize sum (r - x.v)^2 + lambda * (n_u |x|^2 + ...)
               i.e. per-entity regularizer lambda * n ratings (`lambda_scaling
               ='nratings'`, MLlib's default behavior in 1.3).
-  implicit  — Hu-Koren confidence c = 1 + alpha * r, preference p = 1(r>0),
+  implicit  — Hu-Koren confidence c = 1 + alpha * |r|, preference p = 1(r>0),
               solve (G + V_u^T (C_u - I) V_u + lambda*n*I) x = V_u^T C_u p
-              with G = V^T V computed once per half-sweep.
+              with G = V^T V computed once per half-sweep. Negative ratings
+              (e.g. "dislike" events mapped to r = -1) contribute confidence
+              with preference 0, exactly MLlib 1.3's c1 = alpha*|r| /
+              b += (c1+1)*x when r > 0.
 """
 
 from __future__ import annotations
@@ -103,12 +106,15 @@ def _solve_scatter(factors_out, counter_factors, gram, rows, idx, val, mask,
     Vg = counter_factors[idx]                       # [B, K, R] gather
     Vc = Vg.astype(cd)
     if implicit:
-        conf_minus_1 = (alpha * val) * mask          # c - 1, zero on padding
+        absval = jnp.abs(val)
+        conf_minus_1 = (alpha * absval) * mask       # c - 1, zero on padding
         A = gram + jnp.einsum("bk,bkr,bks->brs", conf_minus_1.astype(cd),
                               Vc, Vc,
                               preferred_element_type=jnp.float32)
+        # preference p = 1(r>0): negative signals add confidence to A only
+        pos = (val > 0).astype(val.dtype) * mask
         b = jnp.einsum("bk,bkr->br",
-                       ((1.0 + alpha * val) * mask).astype(cd), Vc,
+                       ((1.0 + alpha * absval) * pos).astype(cd), Vc,
                        preferred_element_type=jnp.float32)
     else:
         A = jnp.einsum("bk,bkr,bks->brs", mask.astype(cd), Vc, Vc,

@@ -31,31 +31,39 @@ def _cosine_topk(query_vecs, item_norms, allowed, k: int):
     return jax.lax.top_k(scores, k)
 
 
-@functools.partial(__import__("jax").jit, static_argnames=("k",))
-def _batched_masked_topk(query_mat, item_table, allowed, k: int):
+@functools.partial(__import__("jax").jit,
+                   static_argnames=("k", "filter_positive"))
+def _batched_masked_topk(query_mat, item_table, allowed, k: int,
+                         filter_positive: bool):
     """query_mat [B, R], item_table [I, R], allowed [B, I] bool.
-    Score = query_mat @ item_table.T; items with score <= 0 or not allowed
+    Score = query_mat @ item_table.T; not-allowed items (and, when
+    filter_positive, items with score <= 0 — the cosine templates' rule)
     are excluded (score -> -inf). One device call for the whole batch."""
     import jax
     import jax.numpy as jnp
     scores = jnp.einsum("br,ir->bi", query_mat, item_table,
                         preferred_element_type=jnp.float32)
-    scores = jnp.where(allowed & (scores > 0), scores, -jnp.inf)
+    if filter_positive:
+        allowed = allowed & (scores > 0)
+    scores = jnp.where(allowed, scores, -jnp.inf)
     return jax.lax.top_k(scores, k)
 
 
 def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
-                       masks: np.ndarray, k: int
+                       masks: np.ndarray, k: int,
+                       filter_positive: bool = True
                        ) -> Tuple[np.ndarray, np.ndarray]:
-    """Batched positive-masked dot top-k: one jitted call for B queries.
+    """Batched masked dot top-k: one jitted call for B queries.
 
     query_vecs [B, R] (already in the scoring space: raw user factors for
     dot scoring, summed-normalized item vectors for cosine), masks [B, I]
     bool. Both the batch dim and k are padded to powers of two so the
     kernel compiles once per (batch, k) size class even though q.num is
-    client-controlled. Returns ([B, k'], [B, k']) numpy arrays with
-    k' >= min(k, I); rows may contain -inf for excluded slots (caller
-    filters non-finite and slices to its own num)."""
+    client-controlled. filter_positive additionally drops score <= 0
+    (cosine-template semantics; explicit-ALS callers pass False). Returns
+    ([B, k'], [B, k']) numpy arrays with k' >= min(k, I); rows may contain
+    -inf for excluded slots (caller filters non-finite and slices to its
+    own num)."""
     from predictionio_tpu.utils.device_cache import cached_put
     n_items = item_table.shape[0]
     n = query_vecs.shape[0]
@@ -65,7 +73,8 @@ def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
     mp = np.zeros((b, n_items), dtype=bool)
     mp[:n] = masks
     k_eff = min(1 << max(0, (k - 1).bit_length()), n_items)
-    scores, idx = _batched_masked_topk(qp, cached_put(item_table), mp, k_eff)
+    scores, idx = _batched_masked_topk(qp, cached_put(item_table), mp, k_eff,
+                                       filter_positive)
     return np.asarray(scores)[:n], np.asarray(idx)[:n]
 
 

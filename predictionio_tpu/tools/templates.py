@@ -53,6 +53,19 @@ TEMPLATES = {
         },
         "query_example": {"items": ["i1"], "num": 4},
     },
+    "recommendeduser": {
+        "description": "Implicit-ALS similar-user recommendation "
+                       "(follow events)",
+        "engine_json": {
+            "id": "default",
+            "description": "Default settings",
+            "engineFactory": "recommendeduser",
+            "datasource": {"params": {"app_name": "MyApp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 10, "num_iterations": 20, "lam": 0.01, "seed": 3}}],
+        },
+        "query_example": {"users": ["u1"], "num": 4},
+    },
     "ecommercerecommendation": {
         "description": "ALS + live business rules (seen-item/"
                        "unavailable-item blacklists)",

@@ -45,9 +45,10 @@ def np_als_half_sweep(r: RatingsCOO, factors, counter, lam, nratings_reg,
         n = sel.sum()
         reg = lam * max(n, 1) if nratings_reg else lam
         if implicit:
-            cm1 = alpha * vals
+            cm1 = alpha * np.abs(vals)
             A = gram + (Vu * cm1[:, None]).T @ Vu + reg * np.eye(rank)
-            b = ((1 + alpha * vals)[:, None] * Vu).sum(0)
+            pos = (vals > 0).astype(np.float64)
+            b = (((1 + alpha * np.abs(vals)) * pos)[:, None] * Vu).sum(0)
         else:
             A = Vu.T @ Vu + reg * np.eye(rank)
             b = Vu.T @ vals
@@ -188,6 +189,43 @@ class TestALSImplicit:
         ref = np_als(r, cfg)
         np.testing.assert_allclose(model.user_factors, ref.user_factors,
                                    rtol=3e-3, atol=3e-3)
+
+    def test_negative_preferences_match_numpy_reference(self, mesh8):
+        """MLlib trainImplicit semantics for like/dislike: c1 = alpha*|r|
+        enters A for every observation, b only accumulates where r > 0."""
+        r = synthetic_ratings(seed=11)
+        signs = np.where(np.arange(r.nnz) % 3 == 0, -1.0, 1.0)
+        r = RatingsCOO(r.user_idx, r.item_idx,
+                       (np.abs(r.rating) + 0.5) * signs,
+                       r.n_users, r.n_items)
+        cfg = ALSConfig(rank=4, iterations=2, lam=0.1, implicit_prefs=True,
+                        alpha=2.0, work_budget=512)
+        model = als_train(r, cfg, mesh8)
+        ref = np_als(r, cfg)
+        np.testing.assert_allclose(model.user_factors, ref.user_factors,
+                                   rtol=3e-3, atol=3e-3)
+
+    def test_disliked_items_rank_below_liked(self, mesh8):
+        rng = np.random.default_rng(5)
+        n_users, n_items = 24, 12
+        ui, ii, vv = [], [], []
+        for u in range(n_users):
+            for i in range(n_items):
+                if rng.random() < 0.7:
+                    ui.append(u)
+                    ii.append(i)
+                    # everyone likes even items, dislikes odd items
+                    vv.append(1.0 if i % 2 == 0 else -1.0)
+        r = RatingsCOO(np.array(ui, np.int32), np.array(ii, np.int32),
+                       np.array(vv, np.float32), n_users, n_items)
+        model = als_train(r, ALSConfig(rank=4, iterations=8, lam=0.01,
+                                       implicit_prefs=True, alpha=5.0),
+                          mesh8)
+        scores, idx = recommend_products(model, 0, n_items)
+        ranks = {int(i): pos for pos, i in enumerate(idx)}
+        liked_mean = np.mean([ranks[i] for i in range(0, n_items, 2)])
+        disliked_mean = np.mean([ranks[i] for i in range(1, n_items, 2)])
+        assert liked_mean < disliked_mean
 
     def test_implicit_ranks_observed_items_high(self, mesh8):
         rng = np.random.default_rng(0)

@@ -98,6 +98,61 @@ class TestRecommendationTemplate:
         assert result.best_score.score > 0.1
         assert "PrecisionAtK" in result.metric_header
 
+    def test_query_filters_and_item_properties(self, app, mesh8):
+        """custom-query + filter-by-category variants: categories /
+        creationYear filters at predict time, item properties echoed on
+        each ItemScore."""
+        from predictionio_tpu.models import recommendation as R
+        self.seed(app)
+        for g, items in enumerate([["iA0", "iA1", "iA2"],
+                                   ["iB0", "iB1", "iB2"]]):
+            for j, item in enumerate(items):
+                insert(app, "$set", "item", item, props={
+                    "categories": ["catA" if g == 0 else "catB"],
+                    "creationYear": 1990 + 10 * j})
+        engine = R.RecommendationEngineFactory.apply()
+        ep = EngineParams(
+            data_source_params=("", R.DataSourceParams(
+                app_name="testapp", read_items=True)),
+            preparator_params=("", R.PreparatorParams()),
+            algorithm_params_list=[("als", R.ALSAlgorithmParams(
+                rank=4, num_iterations=8, lam=0.05, seed=3,
+                return_properties=("creationYear",)))],
+            serving_params=("", None))
+        tr = engine.train(ep)
+        algo = tr.algorithms[0]
+        model = tr.models[0]
+        # category filter: group-A user constrained to catB items
+        res = algo.predict(model, R.Query(user="uA2", num=6,
+                                          categories=("catB",)))
+        assert res.item_scores and all(
+            s.item.startswith("iB") for s in res.item_scores)
+        # creationYear filter: only items from 2000 on remain
+        res = algo.predict(model, R.Query(user="uA2", num=6,
+                                          creation_year=2000))
+        years = [s.properties["creationYear"] for s in res.item_scores]
+        assert res.item_scores and all(y >= 2000 for y in years)
+        # properties ride along on the unfiltered path too
+        res = algo.predict(model, R.Query(user="uA2", num=3))
+        assert all("creationYear" in s.to_dict() for s in res.item_scores)
+        # empty categories list means "no filter", like the other templates
+        res_empty = algo.predict(model, R.Query(user="uA2", num=3,
+                                                categories=()))
+        res_plain = algo.predict(model, R.Query(user="uA2", num=3))
+        assert [s.item for s in res_empty.item_scores] == \
+            [s.item for s in res_plain.item_scores]
+        # batched path matches single for a mixed batch
+        queries = [R.Query(user="uA2", num=3),
+                   R.Query(user="uB0", num=6, categories=("catA",)),
+                   R.Query(user="uA0", num=6, creation_year=2010),
+                   R.Query(user="nobody", num=3)]
+        batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+        for ix, q in enumerate(queries):
+            assert_results_match(batched[ix], algo.predict(model, q), q)
+        # wire format: creationYear appears in the result JSON
+        d = batched[0].to_dict()
+        assert all("creationYear" in s for s in d["itemScores"])
+
     def test_dedup_latest_rating_wins(self, app, mesh8):
         from predictionio_tpu.models import recommendation as R
         insert(app, "rate", "user", "u1", "item", "i1", {"rating": 1.0},
@@ -239,6 +294,94 @@ class TestSimilarProductTemplate:
         res = algo.predict(model, S.Query(items=("nope",), num=3))
         assert res.item_scores == ()
 
+    def test_filter_by_year(self, app, mesh8):
+        """filterbyyear variant: only items with year > recommendFromYear."""
+        from predictionio_tpu.models import similarproduct as S
+        self.seed(app)
+        for i in range(4):
+            insert(app, "$set", "item", f"i0{i}", props={"year": 1990 + i})
+        engine = S.SimilarProductEngineFactory.apply()
+        tr = engine.train(self.params())
+        algo, model = tr.algorithms[0], tr.models[0]
+        q = S.Query.from_dict({"items": ["i00"], "num": 8,
+                               "recommendFromYear": 1991})
+        assert q.recommend_from_year == 1991
+        res = algo.predict(model, q)
+        items = [s.item for s in res.item_scores]
+        assert "i01" not in items  # year 1991, not > threshold
+        # group-1 items carry no year and still pass
+        batched = dict(algo.batch_predict(model, [(0, q)]))
+        assert_results_match(batched[0], res, q)
+
+    def test_return_item_properties_and_rate_as_view(self, app, mesh8):
+        """add-and-return-item-properties + add-rateevent variants:
+        properties echoed on ItemScore; rate events count as views."""
+        from predictionio_tpu.models import similarproduct as S
+        self.seed(app)
+        insert(app, "$set", "item", "i00", props={"title": "The Item"})
+        # a rate event that only counts when rate_as_view is on
+        insert(app, "rate", "user", "u0", "item", "i01",
+               {"rating": 5.0}, sec=90)
+        ep = EngineParams(
+            data_source_params=("", S.DataSourceParams(
+                app_name="testapp", rate_as_view=True)),
+            preparator_params=("", None),
+            algorithm_params_list=[("als", S.ALSAlgorithmParams(
+                rank=4, num_iterations=10, lam=0.01, alpha=5.0, seed=1,
+                return_properties=("title",)))],
+            serving_params=("", None))
+        engine = S.SimilarProductEngineFactory.apply()
+        ds = S.SimilarProductDataSource(S.DataSourceParams(
+            app_name="testapp", rate_as_view=True))
+        base = S.SimilarProductDataSource(S.DataSourceParams(
+            app_name="testapp"))
+        assert len(ds.read_training().view_events) == \
+            len(base.read_training().view_events) + 1
+        tr = engine.train(ep)
+        algo, model = tr.algorithms[0], tr.models[0]
+        res = algo.predict(model, S.Query(items=("i01",), num=4))
+        d = res.to_dict()
+        assert all("title" in s for s in d["itemScores"])
+        by_item = {s["item"]: s for s in d["itemScores"]}
+        if "i00" in by_item:
+            assert by_item["i00"]["title"] == "The Item"
+
+    def test_like_algorithm_multi_engine(self, app, mesh8):
+        """multi variant: LikeAlgorithm on like/dislike events served
+        alongside the view-count ALS (LikeAlgorithm.scala:15-76)."""
+        from predictionio_tpu.models import similarproduct as S
+        self.seed(app)
+        # group-0 users like group-0 items; u0 disliked i03 (latest wins:
+        # earlier like at sec=1, dislike at sec=50)
+        for u in range(8):
+            g = u % 2
+            for i in range(4):
+                insert(app, "like", "user", f"u{u}", "item", f"i{g}{i}",
+                       sec=1)
+        insert(app, "dislike", "user", "u0", "item", "i03", sec=50)
+        engine = S.SimilarProductEngineFactory.apply()
+        ep = EngineParams(
+            data_source_params=("", S.DataSourceParams(
+                app_name="testapp", read_like_events=True)),
+            preparator_params=("", None),
+            algorithm_params_list=[
+                ("als", S.ALSAlgorithmParams(rank=4, num_iterations=10,
+                                             lam=0.01, alpha=5.0, seed=1)),
+                ("likealgo", S.ALSAlgorithmParams(rank=4, num_iterations=10,
+                                                  lam=0.01, alpha=5.0,
+                                                  seed=2))],
+            serving_params=("", None))
+        tr = engine.train(ep)
+        assert len(tr.models) == 2
+        like_algo, like_model = tr.algorithms[1], tr.models[1]
+        assert isinstance(like_algo, S.LikeAlgorithm)
+        res = like_algo.predict(like_model, S.Query(items=("i00",), num=3))
+        items = [s.item for s in res.item_scores]
+        assert len(items) >= 1 and "i00" not in items
+        # liked same-group items should dominate
+        assert sum(1 for i in items if i.startswith("i0")) >= \
+            sum(1 for i in items if i.startswith("i1"))
+
     def test_batch_predict_matches_single(self, app, mesh8):
         from predictionio_tpu.models import similarproduct as S
         self.seed(app)
@@ -258,6 +401,79 @@ class TestSimilarProductTemplate:
             model, list(enumerate(queries))))
         for ix, q in enumerate(queries):
             assert_results_match(batched[ix], algo.predict(model, q), q)
+
+
+class TestRecommendedUserTemplate:
+    def seed(self, app_id):
+        rng = np.random.default_rng(4)
+        # two follow communities: even users follow even, odd follow odd
+        for u in range(10):
+            insert(app_id, "$set", "user", f"u{u}")
+        for u in range(10):
+            for v in range(10):
+                if u != v and u % 2 == v % 2 and rng.random() < 0.8:
+                    insert(app_id, "follow", "user", f"u{u}", "user",
+                           f"u{v}", sec=int(rng.integers(100)))
+
+    def params(self):
+        from predictionio_tpu.models import recommendeduser as RU
+        return EngineParams(
+            data_source_params=("", RU.DataSourceParams(app_name="testapp")),
+            preparator_params=("", None),
+            algorithm_params_list=[("als", RU.ALSAlgorithmParams(
+                rank=4, num_iterations=10, lam=0.01, seed=1))],
+            serving_params=("", None))
+
+    def test_similar_users_same_community(self, app, mesh8):
+        from predictionio_tpu.models import recommendeduser as RU
+        self.seed(app)
+        engine = RU.RecommendedUserEngineFactory.apply()
+        tr = engine.train(self.params())
+        algo = tr.algorithms[0]
+        res = algo.predict(tr.models[0], RU.Query(users=("u0",), num=3))
+        users = [s.user for s in res.similar_user_scores]
+        assert "u0" not in users  # query users excluded
+        assert len(users) >= 1
+        even = sum(1 for u in users if int(u[1:]) % 2 == 0)
+        odd = len(users) - even
+        assert even >= odd
+        # black list respected; unknown query user -> empty
+        res = algo.predict(tr.models[0], RU.Query(
+            users=("u0",), num=8, black_list=("u2",)))
+        assert "u2" not in [s.user for s in res.similar_user_scores]
+        res = algo.predict(tr.models[0], RU.Query(users=("nobody",), num=3))
+        assert res.similar_user_scores == ()
+
+    def test_batch_predict_matches_single(self, app, mesh8):
+        from predictionio_tpu.models import recommendeduser as RU
+        self.seed(app)
+        engine = RU.RecommendedUserEngineFactory.apply()
+        tr = engine.train(self.params())
+        algo = tr.algorithms[0]
+        model = tr.models[0]
+        queries = [
+            RU.Query(users=("u0",), num=3),
+            RU.Query(users=("u0", "u2"), num=5),
+            RU.Query(users=("u1",), num=8, white_list=("u3", "u5")),
+            RU.Query(users=("nobody",), num=3),
+        ]
+        batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+        for ix, q in enumerate(queries):
+            single = algo.predict(model, q)
+            b = [(s.user, s.score) for s in batched[ix].similar_user_scores]
+            s = [(s.user, s.score) for s in single.similar_user_scores]
+            assert len(b) == len(s), q
+            np.testing.assert_allclose([x[1] for x in b],
+                                       [x[1] for x in s], rtol=1e-4)
+
+    def test_wire_format(self, app, mesh8):
+        from predictionio_tpu.models import recommendeduser as RU
+        q = RU.Query.from_dict(
+            {"users": ["u1", "u2"], "num": 3, "blackList": ["u9"]})
+        assert q.users == ("u1", "u2") and q.black_list == ("u9",)
+        r = RU.UserScoreResult((RU.UserScore("u3", 0.5),))
+        assert r.to_dict() == {
+            "similarUserScores": [{"user": "u3", "score": 0.5}]}
 
 
 class TestECommerceTemplate:
@@ -383,7 +599,7 @@ class TestQueryJson:
     def test_registry(self):
         from predictionio_tpu.models import (get_engine_factory,
                                              list_engine_factories)
-        assert len(list_engine_factories()) == 4
+        assert len(list_engine_factories()) == 5
         f = get_engine_factory("recommendation")
         assert f.apply() is not None
         f2 = get_engine_factory(
