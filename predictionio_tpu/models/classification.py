@@ -23,6 +23,7 @@ from predictionio_tpu.core import (AverageMetric, DataSource, Engine,
                                    SanityCheck)
 from predictionio_tpu.core.cross_validation import split_data
 from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.forest import ForestModel, forest_train
 from predictionio_tpu.ops.naive_bayes import (MultinomialNBModel,
                                               multinomial_nb_train)
 
@@ -157,6 +158,53 @@ class NaiveBayesAlgorithm(P2LAlgorithm):
                 for (ix, _), lab in zip(queries, labels)]
 
 
+@dataclass(frozen=True)
+class RandomForestAlgorithmParams(Params):
+    """Knob-for-knob with the add-algorithm variant's
+    RandomForestAlgorithmParams (RandomForestAlgorithm.scala:12-19)."""
+    num_classes: int = 4
+    num_trees: int = 10
+    feature_subset_strategy: str = "auto"
+    impurity: str = "gini"
+    max_depth: int = 5
+    max_bins: int = 32
+    seed: int = 42
+
+
+class RandomForestAlgorithm(P2LAlgorithm):
+    """add-algorithm variant (RandomForestAlgorithm.scala:23-52): same
+    P2L placement — cluster-scale train, host-resident model — with the
+    level-synchronous TPU forest of ops/forest.py replacing MLlib's
+    RandomForest.trainClassifier."""
+    PARAMS_CLASS = RandomForestAlgorithmParams
+    QUERY_CLASS = Query
+
+    def __init__(self, params=None):
+        super().__init__(params or RandomForestAlgorithmParams())
+
+    def train(self, td: TrainingData) -> ForestModel:
+        X = np.array([p.features for p in td.labeled_points],
+                     dtype=np.float32)
+        y = np.array([p.label for p in td.labeled_points], dtype=np.float64)
+        p = self.params
+        return forest_train(
+            X, y, num_classes=p.num_classes, num_trees=p.num_trees,
+            feature_subset_strategy=p.feature_subset_strategy,
+            impurity=p.impurity, max_depth=p.max_depth,
+            max_bins=p.max_bins, seed=p.seed)
+
+    def predict(self, model: ForestModel, query: Query) -> PredictedResult:
+        return PredictedResult(label=model.predict(query.features))
+
+    def batch_predict(self, model, queries):
+        if not queries:
+            return []
+        X = np.stack([q.features for _, q in queries]).astype(np.float32)
+        labels = model.predict_batch(X)
+        return [(ix, PredictedResult(label=float(lab)))
+                for (ix, _), lab in zip(queries, labels)]
+
+
 class Accuracy(AverageMetric):
     """(quickstart Evaluation.scala Accuracy metric)"""
 
@@ -170,7 +218,8 @@ class ClassificationEngineFactory(EngineFactory):
         return Engine(
             {"": ClassificationDataSource},
             {"": ClassificationPreparator},
-            {"naive": NaiveBayesAlgorithm},
+            {"naive": NaiveBayesAlgorithm,
+             "randomforest": RandomForestAlgorithm},
             {"": FirstServing})
 
     @classmethod

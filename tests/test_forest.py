@@ -1,0 +1,146 @@
+"""Random forest kernel + classification add-algorithm variant parity
+(reference: examples/scala-parallel-classification/add-algorithm/
+RandomForestAlgorithm.scala)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.forest import (feature_subset_size, forest_train)
+
+
+@pytest.fixture
+def app(tmp_env):
+    from predictionio_tpu.data.storage import App, Storage
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "testapp"))
+    Storage.get_events().init(app_id)
+    return app_id
+
+
+def four_class(n, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+         + 2 * (X[:, 2] > 0.3)).astype(int)
+    return X, y
+
+
+class TestForestOp:
+    def test_learns_separable_4class(self, mesh8):
+        X, y = four_class(600)
+        m = forest_train(X, y, num_classes=4, num_trees=15, max_depth=6)
+        Xt, yt = four_class(400, seed=1)
+        assert (m.predict_batch(Xt) == yt).mean() > 0.85
+        # single-query path agrees with the batch path
+        for i in range(10):
+            assert m.predict(Xt[i]) == m.predict_batch(Xt[i:i + 1])[0]
+
+    def test_deterministic_given_seed(self, mesh8):
+        X, y = four_class(300)
+        a = forest_train(X, y, num_classes=4, num_trees=8, seed=7)
+        b = forest_train(X, y, num_classes=4, num_trees=8, seed=7)
+        assert np.array_equal(a.feature, b.feature)
+        assert np.array_equal(a.threshold, b.threshold)
+        c = forest_train(X, y, num_classes=4, num_trees=8, seed=8)
+        assert not np.array_equal(a.threshold, c.threshold)
+
+    def test_entropy_impurity(self, mesh8):
+        X, y = four_class(400)
+        m = forest_train(X, y, num_classes=4, num_trees=10,
+                         impurity="entropy")
+        Xt, yt = four_class(300, seed=2)
+        assert (m.predict_batch(Xt) == yt).mean() > 0.8
+
+    def test_bad_knobs_raise(self):
+        X, y = four_class(50)
+        with pytest.raises(ValueError):
+            forest_train(X, y, num_classes=4, impurity="variance")
+        with pytest.raises(ValueError):
+            feature_subset_size("most", 4, 10)
+
+    def test_label_contract_enforced(self):
+        # trainClassifier parity: labels outside [0, numClasses) throw
+        # rather than silently vanishing from the histograms.
+        X, y = four_class(50)
+        with pytest.raises(ValueError, match=r"\[0, 2\)"):
+            forest_train(X, y, num_classes=2)
+        with pytest.raises(ValueError, match="integer"):
+            forest_train(X, y + 0.5, num_classes=5)
+
+    def test_subset_strategy_sizes(self):
+        # RandomForest.scala: auto = sqrt for a forest, all for one tree.
+        assert feature_subset_size("auto", 9, 10) == 3
+        assert feature_subset_size("auto", 9, 1) == 9
+        assert feature_subset_size("all", 9, 10) == 9
+        assert feature_subset_size("sqrt", 10, 10) == 4
+        assert feature_subset_size("log2", 16, 10) == 4
+        assert feature_subset_size("log2", 10, 10) == 4   # ceil, like MLlib
+        assert feature_subset_size("onethird", 9, 10) == 3
+        assert feature_subset_size("onethird", 4, 10) == 2  # ceil(4/3)
+
+    def test_pure_node_becomes_leaf(self, mesh8):
+        # Perfectly separable on one feature: depth-1 trees suffice and
+        # deeper growth must not corrupt the vote.
+        base = np.array([[0.0, 5.0], [0.1, -3.0], [0.9, 2.0], [1.0, -1.0]],
+                        np.float32)
+        X = np.tile(base, (10, 1))
+        y = np.tile(np.array([0, 0, 1, 1]), 10)
+        m = forest_train(X, y, num_classes=2, num_trees=5, max_depth=4,
+                         feature_subset_strategy="all", max_bins=4)
+        assert list(m.predict_batch(base)) == [0.0, 0.0, 1.0, 1.0]
+
+
+class TestRandomForestAlgorithm:
+    def seed(self, app_id, insert):
+        rng = np.random.default_rng(1)
+        for j in range(40):
+            label = float(j % 2)
+            base = np.array([8.0, 1.0, 1.0]) if label == 0 else \
+                np.array([1.0, 1.0, 8.0])
+            attrs = base + rng.integers(0, 2, 3)
+            insert(app_id, "$set", "user", f"u{j}", props={
+                "plan": label, "attr0": float(attrs[0]),
+                "attr1": float(attrs[1]), "attr2": float(attrs[2])},
+                sec=j)
+
+    def test_engine_with_both_algorithms(self, app, mesh8):
+        from tests.test_templates import insert
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.models import classification as C
+        self.seed(app, insert)
+        engine = C.ClassificationEngineFactory.apply()
+        # add-algorithm variant: both algorithms trained, serving takes the
+        # head result (Serving.scala: predictedResults.head).
+        ep = EngineParams(
+            data_source_params=("", C.DataSourceParams(app_name="testapp")),
+            preparator_params=("", None),
+            algorithm_params_list=[
+                ("randomforest", C.RandomForestAlgorithmParams(
+                    num_classes=2, num_trees=10, max_depth=4)),
+                ("naive", C.NaiveBayesAlgorithmParams(lam=1.0)),
+            ],
+            serving_params=("", None))
+        tr = engine.train(ep)
+        assert len(tr.models) == 2
+        rf = tr.algorithms[0]
+        assert isinstance(rf, C.RandomForestAlgorithm)
+        assert rf.predict(tr.models[0], C.Query(9.0, 1.0, 1.0)).label == 0.0
+        assert rf.predict(tr.models[0], C.Query(1.0, 1.0, 9.0)).label == 1.0
+        # batch path mirrors single-query predictions
+        queries = [(i, C.Query(float(a), 1.0, float(b)))
+                   for i, (a, b) in enumerate([(9, 1), (1, 9), (8, 2)])]
+        batched = dict(rf.batch_predict(tr.models[0], queries))
+        for ix, q in queries:
+            assert batched[ix].label == rf.predict(tr.models[0], q).label
+
+    def test_params_from_engine_json(self):
+        from predictionio_tpu.core.params import params_from_dict
+        from predictionio_tpu.models import classification as C
+        p = params_from_dict(C.RandomForestAlgorithmParams, {
+            "num_classes": 4, "num_trees": 7,
+            "feature_subset_strategy": "auto", "impurity": "entropy",
+            "max_depth": 3, "max_bins": 16})
+        assert p.num_trees == 7 and p.impurity == "entropy"
+        assert p.max_depth == 3 and p.num_classes == 4
+        with pytest.raises(ValueError):
+            params_from_dict(C.RandomForestAlgorithmParams, {"numTrees": 7})
