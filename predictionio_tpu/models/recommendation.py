@@ -162,6 +162,9 @@ class RecommendationDataSource(DataSource):
 @dataclass(frozen=True)
 class PreparatorParams(Params):
     dedup: str = "latest"
+    # custom-prepartor variant (Preparator.scala:13-27): newline-separated
+    # item ids excluded from training before the vocabulary is built.
+    exclude_items_file: Optional[str] = None
 
 
 class RecommendationPreparator(Preparator):
@@ -173,6 +176,12 @@ class RecommendationPreparator(Preparator):
         super().__init__(params or PreparatorParams())
 
     def prepare(self, td: TrainingData) -> PreparedData:
+        if self.params.exclude_items_file:
+            with open(self.params.exclude_items_file) as f:
+                no_train = {line.strip() for line in f if line.strip()}
+            td = TrainingData(
+                ratings=[r for r in td.ratings if r.item not in no_train],
+                items=td.items)
         user_ix = EntityIdIxMap.build((r.user for r in td.ratings))
         item_ix = EntityIdIxMap.build((r.item for r in td.ratings))
         ui = user_ix.to_indices([r.user for r in td.ratings])

@@ -20,6 +20,7 @@ import numpy as np
 from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
                                    EngineParams, FirstServing, P2LAlgorithm,
                                    Params, Preparator, SanityCheck)
+from predictionio_tpu.core.persistence import PersistentModel
 from predictionio_tpu.data.bimap import EntityIdIxMap
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.models.common import (ItemScoreResult, resolve_ids,
@@ -27,6 +28,7 @@ from predictionio_tpu.models.common import (ItemScoreResult, resolve_ids,
 from predictionio_tpu.ops.als import ALSConfig, als_train
 from predictionio_tpu.ops.ratings import RatingsCOO, dedup_ratings
 from predictionio_tpu.ops.similarity import (build_filter_mask, cosine_top_k,
+                                             item_cosine_similarities,
                                              normalize_rows)
 
 logger = logging.getLogger(__name__)
@@ -175,11 +177,10 @@ class ALSAlgorithmParams(Params):
     return_properties: Tuple[str, ...] = ()
 
 
-@dataclass
-class SimilarProductModel:
-    """productFeatures + id maps + item metadata (ALSAlgorithm.scala
-    ALSModel)."""
-    item_factors_normalized: np.ndarray   # [I, R] L2-normalized rows
+@dataclass(kw_only=True)
+class ItemMetadataModel:
+    """Id maps + item metadata shared by every similarproduct model flavor
+    (the ALSModel fields minus the factors)."""
     item_ix: EntityIdIxMap
     items: Dict[str, Item]
     item_categories: List[Optional[set]]  # by dense index
@@ -196,6 +197,20 @@ class SimilarProductModel:
                 years[ix] = float(y)
         return years
 
+    @classmethod
+    def metadata_kwargs(cls, items: Dict[str, Item],
+                        item_ix: EntityIdIxMap) -> dict:
+        """Constructor kwargs for the shared fields, derived once from the
+        training data's item bag."""
+        item_categories = []
+        for ix in range(len(item_ix)):
+            item = items.get(item_ix.id_of(ix))
+            item_categories.append(
+                set(item.categories) if item and item.categories else None)
+        return dict(item_ix=item_ix, items=dict(items),
+                    item_categories=item_categories,
+                    item_years=cls.derive_years(items, item_ix))
+
     def properties_of(self, keys: Tuple[str, ...]):
         """ItemScore property passthrough (add-and-return-item-properties
         variant): requested keys always present, missing -> None/null."""
@@ -207,6 +222,13 @@ class SimilarProductModel:
             p = (item.properties if item and item.properties else {})
             return {k: p.get(k) for k in keys}
         return get
+
+
+@dataclass(kw_only=True)
+class SimilarProductModel(ItemMetadataModel):
+    """productFeatures + id maps + item metadata (ALSAlgorithm.scala
+    ALSModel)."""
+    item_factors_normalized: np.ndarray   # [I, R] L2-normalized rows
 
 
 class ALSAlgorithm(P2LAlgorithm):
@@ -244,17 +266,9 @@ class ALSAlgorithm(P2LAlgorithm):
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype())
         model = als_train(coo, cfg)
-        item_categories = []
-        for ix in range(len(item_ix)):
-            item = td.items.get(item_ix.id_of(ix))
-            item_categories.append(
-                set(item.categories) if item and item.categories else None)
         return SimilarProductModel(
             item_factors_normalized=normalize_rows(model.item_factors),
-            item_ix=item_ix,
-            items=dict(td.items),
-            item_categories=item_categories,
-            item_years=SimilarProductModel.derive_years(td.items, item_ix))
+            **ItemMetadataModel.metadata_kwargs(td.items, item_ix))
 
     @staticmethod
     def _build_mask(model: SimilarProductModel, query: Query,
@@ -350,13 +364,106 @@ class LikeAlgorithm(ALSAlgorithm):
                                             len(user_ix), len(item_ix))
 
 
+@dataclass(frozen=True)
+class DIMSUMAlgorithmParams(Params):
+    """dimsum variant (DIMSUMAlgorithm.scala:23): `threshold` drops
+    sub-threshold similarity entries. The TPU build computes the exact
+    cosine (ops/similarity.item_cosine_similarities) rather than DIMSUM's
+    shuffle-bounding sampling approximation."""
+    threshold: float = 0.0
+    return_properties: Tuple[str, ...] = ()
+
+
+@dataclass(kw_only=True)
+class DIMSUMModel(ItemMetadataModel, PersistentModel):
+    """Precomputed item-item similarity rows + id maps
+    (DIMSUMAlgorithm.scala DIMSUMModel). Implements the manual-persistence
+    contract the variant demonstrates (IPersistentModel.save to
+    /tmp/<id> -> here, <PIO_FS_BASEDIR>/dimsum/<instance_id>)."""
+    similarities: np.ndarray              # [I, I] f32, zero diagonal
+
+    @classmethod
+    def _dir(cls, instance_id: str) -> str:
+        import os
+        from predictionio_tpu.data.storage.registry import base_dir
+        return os.path.join(base_dir(), "dimsum", instance_id)
+
+    def save(self, instance_id: str, params) -> bool:
+        import os
+        import pickle
+        d = self._dir(instance_id)
+        os.makedirs(d, exist_ok=True)
+        np.save(os.path.join(d, "similarities.npy"), self.similarities)
+        with open(os.path.join(d, "maps.pkl"), "wb") as f:
+            pickle.dump({"item_ix": self.item_ix, "items": self.items,
+                         "item_categories": self.item_categories,
+                         "item_years": self.item_years}, f)
+        return True
+
+    @classmethod
+    def load(cls, instance_id: str, params) -> "DIMSUMModel":
+        import os
+        import pickle
+        d = cls._dir(instance_id)
+        sims = np.load(os.path.join(d, "similarities.npy"))
+        with open(os.path.join(d, "maps.pkl"), "rb") as f:
+            maps = pickle.load(f)
+        return cls(similarities=sims, **maps)
+
+
+class DIMSUMAlgorithm(P2LAlgorithm):
+    """dimsum variant (DIMSUMAlgorithm.scala:67-220): all-pairs item
+    cosine similarity from binary view co-occurrence, precomputed at train
+    time; predict sums the query items' similarity rows and applies the
+    standard candidate filters. Serving is a host row-gather — the model
+    IS the score table (the reference serves it from an RDD lookup)."""
+    PARAMS_CLASS = DIMSUMAlgorithmParams
+    QUERY_CLASS = Query
+
+    def __init__(self, params=None):
+        super().__init__(params or DIMSUMAlgorithmParams())
+
+    def train(self, pd: PreparedData) -> DIMSUMModel:
+        td = pd.td
+        if not td.view_events:
+            raise ValueError("No view events to train on")
+        user_ix = EntityIdIxMap.build(v.user for v in td.view_events)
+        item_ix = EntityIdIxMap.build(list(td.items.keys()) +
+                                      [v.item for v in td.view_events])
+        ui = user_ix.to_indices([v.user for v in td.view_events])
+        ii = item_ix.to_indices([v.item for v in td.view_events])
+        sims = item_cosine_similarities(
+            ui, ii, len(user_ix), len(item_ix),
+            threshold=self.params.threshold)
+        return DIMSUMModel(
+            similarities=sims,
+            **ItemMetadataModel.metadata_kwargs(td.items, item_ix))
+
+    def predict(self, model: DIMSUMModel, query: Query) -> ItemScoreResult:
+        q_ix = resolve_ids(model.item_ix, query.items)
+        if len(q_ix) == 0:
+            logger.info("No similarity row for query items %s.", query.items)
+            return ItemScoreResult(())
+        scores = model.similarities[q_ix].sum(axis=0)
+        mask = ALSAlgorithm._build_mask(model, query, q_ix)
+        scores = np.where(mask & (scores > 0), scores, -np.inf)
+        k = min(query.num, len(scores))
+        idx = np.argpartition(-scores, k - 1)[:k]
+        idx = idx[np.argsort(-scores[idx], kind="stable")]
+        keep = np.isfinite(scores[idx])
+        return top_scores_to_result(
+            model.item_ix, scores[idx][keep], idx[keep],
+            properties_of=model.properties_of(self.params.return_properties))
+
+
 class SimilarProductEngineFactory(EngineFactory):
     @classmethod
     def apply(cls) -> Engine:
         return Engine(
             {"": SimilarProductDataSource},
             {"": SimilarProductPreparator},
-            {"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+            {"als": ALSAlgorithm, "likealgo": LikeAlgorithm,
+             "dimsum": DIMSUMAlgorithm},
             {"": FirstServing})
 
     @classmethod

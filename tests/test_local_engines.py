@@ -1,0 +1,62 @@
+"""Local (L-path) example engines: helloworld + regression
+(reference: examples/experimental/scala-local-helloworld/HelloWorld.scala,
+examples/experimental/scala-local-regression/Run.scala)."""
+
+import numpy as np
+
+from examples.local_engines import (
+    HWDataSourceParams, MeanSquareError, RegDataSourceParams,
+    RegPreparator, RegPreparatorParams, RegTrainingData,
+    helloworld_engine, regression_engine, _write_sample_data)
+from predictionio_tpu.core import EngineParams, MetricEvaluator
+
+
+def test_helloworld_average_per_day(tmp_path):
+    path = tmp_path / "data.csv"
+    path.write_text("Mon,75\nTue,80\nMon,65\n")
+    engine = helloworld_engine()
+    ep = EngineParams(
+        data_source_params=("", HWDataSourceParams(filepath=str(path))),
+        algorithm_params_list=[("", None)])
+    tr = engine.train(ep)
+    algo, model = tr.algorithms[0], tr.models[0]
+    assert algo.predict(model, {"day": "Mon"})["temperature"] == 70.0
+    assert algo.predict(model, {"day": "Tue"})["temperature"] == 80.0
+
+
+def test_regression_recovers_coefficients(tmp_path, mesh8):
+    path = tmp_path / "reg.txt"
+    _write_sample_data(str(path))
+    engine = regression_engine()
+    ep = EngineParams(
+        data_source_params=("", RegDataSourceParams(filepath=str(path))),
+        preparator_params=("", RegPreparatorParams()),
+        algorithm_params_list=[("", None)])
+    tr = engine.train(ep)
+    np.testing.assert_allclose(tr.models[0], [2.0, -1.0, 0.5], atol=0.01)
+
+
+def test_regression_preparator_drop_rule():
+    td = RegTrainingData(x=np.arange(12).reshape(6, 2).astype(float),
+                         y=np.arange(6).astype(float))
+    out = RegPreparator(RegPreparatorParams(n=3, k=1)).prepare(td)
+    # rows 1 and 4 dropped (index % 3 == 1)
+    np.testing.assert_array_equal(out.y, [0, 2, 3, 5])
+    full = RegPreparator(RegPreparatorParams(n=0)).prepare(td)
+    assert len(full.y) == 6
+
+
+def test_regression_eval_grid_lower_mse_wins(tmp_path, mesh8):
+    path = tmp_path / "reg.txt"
+    _write_sample_data(str(path))
+    engine = regression_engine()
+    grid = [EngineParams(
+        data_source_params=("", RegDataSourceParams(filepath=str(path))),
+        preparator_params=("", RegPreparatorParams(n=n, k=k)),
+        algorithm_params_list=[("", None)])
+        for n, k in [(0, 0), (3, 0)]]
+    result = MetricEvaluator(MeanSquareError()).evaluate_base(engine, grid)
+    assert result.best_score.score < 0.01
+    # MSE comparator: smaller is better
+    m = MeanSquareError()
+    assert m.compare(0.1, 0.5) == 1 and m.compare(0.5, 0.1) == -1

@@ -153,6 +153,23 @@ class TestRecommendationTemplate:
         d = batched[0].to_dict()
         assert all("creationYear" in s for s in d["itemScores"])
 
+    def test_custom_preparator_exclusion_file(self, app, mesh8, tmp_path):
+        # custom-prepartor variant: items listed in the file are dropped
+        # before the vocabulary is built (Preparator.scala:20-26).
+        from predictionio_tpu.models import recommendation as R
+        self.seed(app)
+        path = tmp_path / "no_train.txt"
+        path.write_text("iA0\niB1\n\n")
+        ds = R.RecommendationDataSource(R.DataSourceParams("testapp"))
+        td = ds.read_training()
+        pd = R.RecommendationPreparator(R.PreparatorParams(
+            exclude_items_file=str(path))).prepare(td)
+        assert "iA0" not in pd.item_ix and "iB1" not in pd.item_ix
+        assert "iA1" in pd.item_ix
+        baseline = R.RecommendationPreparator(R.PreparatorParams()
+                                              ).prepare(td)
+        assert len(pd.item_ix) == len(baseline.item_ix) - 2
+
     def test_dedup_latest_rating_wins(self, app, mesh8):
         from predictionio_tpu.models import recommendation as R
         insert(app, "rate", "user", "u1", "item", "i1", {"rating": 1.0},
@@ -164,6 +181,68 @@ class TestRecommendationTemplate:
         pd = R.RecommendationPreparator(R.PreparatorParams()).prepare(td)
         assert pd.ratings_coo.nnz == 1
         assert pd.ratings_coo.rating[0] == 5.0
+
+
+class TestDIMSUMAlgorithm:
+    """dimsum variant: precomputed item-item cosine + manual persistence
+    (experimental/scala-parallel-similarproduct-dimsum)."""
+
+    def dimsum_params(self, threshold=0.0):
+        from predictionio_tpu.models import similarproduct as S
+        return EngineParams(
+            data_source_params=("", S.DataSourceParams(app_name="testapp")),
+            preparator_params=("", None),
+            algorithm_params_list=[("dimsum", S.DIMSUMAlgorithmParams(
+                threshold=threshold))],
+            serving_params=("", None))
+
+    def test_similar_items_same_group(self, app, mesh8):
+        from predictionio_tpu.models import similarproduct as S
+        TestSimilarProductTemplate.seed(self, app)
+        engine = S.SimilarProductEngineFactory.apply()
+        tr = engine.train(self.dimsum_params())
+        algo, model = tr.algorithms[0], tr.models[0]
+        res = algo.predict(model, S.Query(items=("i00",), num=3))
+        items = [s.item for s in res.item_scores]
+        assert items and "i00" not in items
+        # co-viewed group dominates: all scores come from group-0 viewers
+        assert all(i.startswith("i0") for i in items)
+        # category filter applies to the similarity path too; group 1 items
+        # share no viewers with i00, so catB candidates all score zero
+        res = algo.predict(model, S.Query(items=("i00",), num=5,
+                                          categories=("catB",)))
+        assert all(s.item.startswith("i1") for s in res.item_scores)
+
+    def test_threshold_sparsifies(self, app, mesh8):
+        from predictionio_tpu.models import similarproduct as S
+        TestSimilarProductTemplate.seed(self, app)
+        engine = S.SimilarProductEngineFactory.apply()
+        tr = engine.train(self.dimsum_params(threshold=0.999))
+        model = tr.models[0]
+        off_diag = model.similarities[model.similarities > 0]
+        assert (off_diag >= 0.999).all()
+
+    def test_manual_persistence_roundtrip(self, app, mesh8, tmp_env):
+        # IPersistentModel contract: train stores only a manifest; deploy
+        # loads via the model class (Engine.scala:196-265 analog).
+        from predictionio_tpu.models import similarproduct as S
+        from predictionio_tpu.workflow.core_workflow import run_train
+        from predictionio_tpu.data.storage import Storage
+        TestSimilarProductTemplate.seed(self, app)
+        engine = S.SimilarProductEngineFactory.apply()
+        ep = self.dimsum_params()
+        instance_id = run_train(engine, ep, engine_id="dimsum-test")
+        blob = Storage.get_model_data_models().get(instance_id).models
+        persisted = engine.deserialize_models(blob)
+        from predictionio_tpu.core.persistence import PersistentModelManifest
+        assert isinstance(persisted[0], PersistentModelManifest)
+        restored = engine.prepare_deploy(ep, persisted, instance_id)
+        orig = engine.train(ep)
+        np.testing.assert_allclose(restored.models[0].similarities,
+                                   orig.models[0].similarities, rtol=1e-6)
+        res = restored.algorithms[0].predict(
+            restored.models[0], S.Query(items=("i00",), num=3))
+        assert res.item_scores
 
 
 class TestClassificationTemplate:
