@@ -48,10 +48,19 @@ class Doer:
     def apply(cls, params: Optional[Params] = None):
         if params is None:
             return cls()
+        # Decide by signature inspection, not by catching TypeError: a
+        # TypeError raised *inside* a user's __init__ must propagate rather
+        # than silently dropping their params.
+        import inspect
         try:
-            return cls(params)
-        except TypeError:
-            return cls()
+            sig = inspect.signature(cls)
+            takes_params = any(
+                p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                           p.VAR_POSITIONAL)
+                for p in sig.parameters.values())
+        except (ValueError, TypeError):   # builtins without signatures
+            takes_params = True
+        return cls(params) if takes_params else cls()
 
 
 class SanityCheck(abc.ABC):

@@ -120,6 +120,15 @@ class MeshContext:
         jax = _jax()
         return jax.device_put(x, self.replicated())
 
+    def put_stacked(self, x):
+        """Host array -> device array sharded on dim 1 over the data axis:
+        the layout of stacked same-shape batch groups [N, B, ...] that a
+        `lax.scan` consumes along dim 0, each slice staying data-sharded."""
+        jax = _jax()
+        ndim = np.ndim(x)
+        return jax.device_put(
+            x, self.sharding(None, self.DATA_AXIS, *([None] * (ndim - 2))))
+
     def put_model_sharded(self, x):
         """Rows sharded over the model axis (embedding tables)."""
         jax = _jax()
