@@ -135,6 +135,20 @@ class EntityIdIxMap:
         bm = (BiMap.string_int_sorted(keys) if sort else BiMap.string_int(keys))
         return EntityIdIxMap(bm)
 
+    @staticmethod
+    def build_with_indices(ids: np.ndarray
+                           ) -> "tuple[EntityIdIxMap, np.ndarray]":
+        """Vectorized vocabulary build: one np.unique pass yields both the
+        sorted-order map (same order as ``build``) and the dense index of
+        every input row — the ingest-scale replacement for building the map
+        and then re-translating 20M ids through a Python dict."""
+        arr = np.asarray(ids)
+        if arr.dtype == object:
+            arr = arr.astype(str)
+        uniq, inv = np.unique(arr, return_inverse=True)
+        bm = BiMap({str(k): i for i, k in enumerate(uniq)})
+        return EntityIdIxMap(bm), inv.astype(np.int32)
+
     def __getitem__(self, entity_id: str) -> int:
         return self._bimap[entity_id]
 

@@ -20,7 +20,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence
 
 from predictionio_tpu.data.aggregator import aggregate_properties
 from predictionio_tpu.data.datamap import PropertyMap
-from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.event import Event, to_millis as _millis
 
 # Sentinel for "filter requires this field to be absent" (the reference's
 # Option[Option[String]] = Some(None) case in LEvents.futureFind).
@@ -316,6 +316,44 @@ class Events(abc.ABC):
         is only allowed when entity_type/entity_id are specified (enforced by
         callers, as in the reference).
         """
+
+    def find_columnar(self, app_id: int,
+                      channel_id: Optional[int] = None,
+                      property_field: Optional[str] = None,
+                      **filters) -> Dict[str, "object"]:
+        """Columnar bulk read for training ingest (the PEvents scan role,
+        PEvents.scala:77, shaped for vectorized numpy consumption instead of
+        an RDD): returns {'entity_id', 'target_entity_id', 'event', 't',
+        'prop'} as flat numpy arrays — no per-event Python objects on the
+        hot path. `prop` is float32 (NaN where `property_field` is missing)
+        and only present when `property_field` is given; `t` is event-time
+        millis. Backends with a query engine override this with a projected
+        scan; this default streams `find`.
+        """
+        import numpy as np
+
+        ents: list = []
+        tgts: list = []
+        names: list = []
+        ts: list = []
+        props: list = []
+        for e in self.find(app_id, channel_id=channel_id, **filters):
+            ents.append(e.entity_id)
+            tgts.append(e.target_entity_id or "")
+            names.append(e.event)
+            ts.append(_millis(e.event_time))
+            if property_field is not None:
+                v = e.properties.get_opt(property_field, float)
+                props.append(np.nan if v is None else v)
+        out = {
+            "entity_id": np.array(ents, dtype=str),
+            "target_entity_id": np.array(tgts, dtype=str),
+            "event": np.array(names, dtype=str),
+            "t": np.array(ts, dtype=np.int64),
+        }
+        if property_field is not None:
+            out["prop"] = np.array(props, dtype=np.float32)
+        return out
 
     # -- derived queries ----------------------------------------------------
     def aggregate_properties(self, app_id: int,

@@ -501,11 +501,9 @@ class SQLEvents(base.Events):
             f"DELETE FROM {self.t} WHERE appid=? AND channelid=? AND id=?",
             (app_id, self._chan(channel_id), event_id)).rowcount > 0
 
-    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
-             entity_type=None, entity_id=None, event_names=None,
-             target_entity_type=None, target_entity_id=None, limit=None,
-             reversed_order=False):
-        sql = f"SELECT * FROM {self.t} WHERE appid=? AND channelid=?"
+    def _where(self, app_id, channel_id, start_time, until_time, entity_type,
+               entity_id, event_names, target_entity_type, target_entity_id):
+        sql = " WHERE appid=? AND channelid=?"
         params: list = [app_id, self._chan(channel_id)]
         if start_time is not None:
             sql += " AND eventtime>=?"
@@ -534,9 +532,65 @@ class SQLEvents(base.Events):
             else:
                 sql += " AND targetentityid=?"
                 params.append(target_entity_id)
-        sql += f" ORDER BY eventtime {'DESC' if reversed_order else 'ASC'}"
+        return sql, params
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None, limit=None,
+             reversed_order=False):
+        where, params = self._where(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id)
+        sql = (f"SELECT * FROM {self.t}{where} ORDER BY eventtime "
+               f"{'DESC' if reversed_order else 'ASC'}")
         if limit is not None and limit >= 0:
             sql += " LIMIT ?"
             params.append(limit)
         for r in self.c.query(sql, tuple(params)):
             yield self._from_row(r)
+
+    def find_columnar(self, app_id, channel_id=None, property_field=None,
+                      start_time=None, until_time=None, entity_type=None,
+                      entity_id=None, event_names=None,
+                      target_entity_type=None, target_entity_id=None,
+                      limit=None, reversed_order=False):
+        """Projected scan: the property value is extracted SQL-side
+        (json_extract), rows arrive as flat tuples, and no Event/DataMap
+        objects are built — the ML-20M-scale ingest path."""
+        import numpy as np
+
+        cols = "entityid, targetentityid, event, eventtime"
+        params_pre: list = []
+        if property_field is not None:
+            cols += ", json_extract(properties, ?)"
+            params_pre.append(f'$."{property_field}"')
+        where, params = self._where(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id)
+        sql = (f"SELECT {cols} FROM {self.t}{where} ORDER BY eventtime "
+               f"{'DESC' if reversed_order else 'ASC'}")
+        if limit is not None and limit >= 0:
+            sql += " LIMIT ?"
+            params.append(limit)
+        rows = self.c.query(sql, tuple(params_pre) + tuple(params))
+        if not rows:
+            out = {"entity_id": np.array([], dtype=str),
+                   "target_entity_id": np.array([], dtype=str),
+                   "event": np.array([], dtype=str),
+                   "t": np.array([], dtype=np.int64)}
+            if property_field is not None:
+                out["prop"] = np.array([], dtype=np.float32)
+            return out
+        ents, tgts, names, ts, *rest = zip(*rows)
+        out = {
+            "entity_id": np.array(ents, dtype=str),
+            "target_entity_id": np.array(
+                [x or "" for x in tgts], dtype=str),
+            "event": np.array(names, dtype=str),
+            "t": np.array(ts, dtype=np.int64),
+        }
+        if property_field is not None:
+            out["prop"] = np.array(
+                [np.nan if v is None else v for v in rest[0]],
+                dtype=np.float32)
+        return out

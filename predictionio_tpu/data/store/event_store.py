@@ -77,6 +77,18 @@ class EventStore:
             target_entity_id=target_entity_id, limit=limit,
             reversed_order=reversed_order)
 
+    def find_columnar(self, app_name: str,
+                      channel_name: Optional[str] = None,
+                      property_field: Optional[str] = None,
+                      **filters) -> Dict[str, "object"]:
+        """Columnar bulk read (see Events.find_columnar): flat numpy arrays
+        for vectorized training ingest — the PEvents-scan-to-RDD role
+        (PEvents.scala:77) without per-event Python objects."""
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        return self.events.find_columnar(
+            app_id=app_id, channel_id=channel_id,
+            property_field=property_field, **filters)
+
     # -- property aggregation (PEventStore.aggregateProperties) ------------
     def aggregate_properties(self, app_name: str, entity_type: str,
                              channel_name: Optional[str] = None,

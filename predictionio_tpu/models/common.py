@@ -43,6 +43,52 @@ class ItemScoreResult:
         return {"itemScores": [s.to_dict() for s in self.item_scores]}
 
 
+class RatingsData:
+    """Columnar (user, item, rating, t) quadruples — the template training
+    payload as four flat numpy arrays instead of a list of per-event
+    objects (the RDD[Rating] role of the reference DataSources, e.g.
+    scala-parallel-recommendation DataSource.scala:20-46, kept columnar so
+    ML-20M-scale ingest never builds 20M Python objects).
+
+    Iteration yields lightweight row views for code that wants per-row
+    access (eval fold grouping, tests); the hot paths slice the arrays.
+    """
+
+    __slots__ = ("users", "items", "vals", "ts")
+
+    def __init__(self, users, items, vals, ts=None):
+        self.users = np.asarray(users)
+        self.items = np.asarray(items)
+        self.vals = np.asarray(vals, dtype=np.float32)
+        self.ts = (np.zeros(len(self.vals), dtype=np.int64)
+                   if ts is None else np.asarray(ts, dtype=np.int64))
+
+    @staticmethod
+    def from_rows(rows: Sequence) -> "RatingsData":
+        """Rows with .user/.item/.rating (and optional .t) attributes."""
+        return RatingsData(
+            np.array([r.user for r in rows], dtype=str),
+            np.array([r.item for r in rows], dtype=str),
+            np.array([r.rating for r in rows], dtype=np.float32),
+            np.array([getattr(r, "t", 0) for r in rows], dtype=np.int64))
+
+    def __len__(self) -> int:
+        return int(self.vals.shape[0])
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def __iter__(self):
+        from collections import namedtuple
+        Row = namedtuple("Rating", ("user", "item", "rating", "t"))
+        for u, i, v, t in zip(self.users, self.items, self.vals, self.ts):
+            yield Row(str(u), str(i), float(v), int(t))
+
+    def select(self, mask_or_idx) -> "RatingsData":
+        return RatingsData(self.users[mask_or_idx], self.items[mask_or_idx],
+                           self.vals[mask_or_idx], self.ts[mask_or_idx])
+
+
 def resolve_ids(ix_map: EntityIdIxMap, ids: Optional[Sequence[str]]
                 ) -> np.ndarray:
     """String ids -> known dense indices (unknowns dropped, matching the

@@ -21,9 +21,8 @@ from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
                                    P2LAlgorithm, Params, Preparator,
                                    SanityCheck)
 from predictionio_tpu.data.bimap import EntityIdIxMap
-from predictionio_tpu.data.event import to_millis
 from predictionio_tpu.data.store import PEventStore
-from predictionio_tpu.models.common import (ItemScoreResult,
+from predictionio_tpu.models.common import (ItemScoreResult, RatingsData,
                                             top_scores_to_result)
 from predictionio_tpu.ops.als import ALSConfig, ALSModel, als_train, \
     recommend_products
@@ -44,11 +43,17 @@ class Rating:
 
 @dataclass
 class TrainingData(SanityCheck):
-    ratings: List[Rating]
+    """`ratings` is columnar (RatingsData); a plain list of Rating rows is
+    accepted and converted, so hand-built fixtures keep working."""
+    ratings: RatingsData
     items: Optional[dict] = None  # id -> property dict (read_items variants)
 
+    def __post_init__(self):
+        if isinstance(self.ratings, (list, tuple)):
+            self.ratings = RatingsData.from_rows(self.ratings)
+
     def sanity_check(self):
-        if not self.ratings:
+        if not len(self.ratings):
             raise ValueError("ratings is empty; check the data source")
 
 
@@ -109,21 +114,26 @@ class RecommendationDataSource(DataSource):
     def __init__(self, params=None):
         super().__init__(params or DataSourceParams())
 
-    def _read_ratings(self) -> List[Rating]:
+    def _read_ratings(self) -> RatingsData:
+        """Columnar ingest: one projected scan into flat numpy arrays
+        (DataSource.scala:20-46 eventsRDD -> ratingsRDD, without 20M
+        Python objects at ML-20M scale)."""
         p = self.params
-        ratings = []
-        for e in PEventStore.find(app_name=p.app_name,
-                                  channel_name=p.channel_name,
-                                  entity_type="user",
-                                  target_entity_type="item",
-                                  event_names=list(p.event_names)):
-            if e.event == "rate":
-                rating = e.properties.get("rating", float)
-            else:  # buy
-                rating = p.buy_rating
-            ratings.append(Rating(e.entity_id, e.target_entity_id, rating,
-                                  to_millis(e.event_time)))
-        return ratings
+        cols = PEventStore.find_columnar(
+            app_name=p.app_name, channel_name=p.channel_name,
+            property_field="rating", entity_type="user",
+            target_entity_type="item", event_names=list(p.event_names))
+        is_rate = cols["event"] == "rate"
+        missing = is_rate & np.isnan(cols["prop"])
+        if missing.any():
+            raise ValueError(
+                f"{int(missing.sum())} 'rate' event(s) lack the required "
+                f"'rating' property (first entity: "
+                f"{cols['entity_id'][missing][0]!r})")
+        vals = np.where(is_rate, cols["prop"],
+                        np.float32(p.buy_rating)).astype(np.float32)
+        return RatingsData(cols["entity_id"], cols["target_entity_id"],
+                           vals, cols["t"])
 
     def _read_items(self) -> Optional[dict]:
         if not self.params.read_items:
@@ -145,12 +155,13 @@ class RecommendationDataSource(DataSource):
         if not p.eval_k:
             return []
         ratings = self._read_ratings()
+        row_ix = np.arange(len(ratings))
         folds = []
         for fold in range(p.eval_k):
-            train = [r for i, r in enumerate(ratings) if i % p.eval_k != fold]
-            test = [r for i, r in enumerate(ratings) if i % p.eval_k == fold]
+            test_mask = (row_ix % p.eval_k) == fold
+            train = ratings.select(~test_mask)
             by_user = {}
-            for r in test:
+            for r in ratings.select(test_mask):
                 by_user.setdefault(r.user, []).append(r)
             qa = [(Query(user=user, num=p.eval_query_num),
                    ActualResult(tuple(rs)))
@@ -176,19 +187,18 @@ class RecommendationPreparator(Preparator):
         super().__init__(params or PreparatorParams())
 
     def prepare(self, td: TrainingData) -> PreparedData:
+        rd = td.ratings
         if self.params.exclude_items_file:
             with open(self.params.exclude_items_file) as f:
-                no_train = {line.strip() for line in f if line.strip()}
-            td = TrainingData(
-                ratings=[r for r in td.ratings if r.item not in no_train],
-                items=td.items)
-        user_ix = EntityIdIxMap.build((r.user for r in td.ratings))
-        item_ix = EntityIdIxMap.build((r.item for r in td.ratings))
-        ui = user_ix.to_indices([r.user for r in td.ratings])
-        ii = item_ix.to_indices([r.item for r in td.ratings])
-        vals = np.array([r.rating for r in td.ratings], dtype=np.float32)
-        ts = np.array([r.t for r in td.ratings], dtype=np.int64)
-        ui, ii, vals = dedup_ratings(ui, ii, vals, ts, self.params.dedup)
+                no_train = sorted({line.strip() for line in f
+                                   if line.strip()})
+            rd = rd.select(~np.isin(rd.items, no_train))
+        # one np.unique pass per side builds the sorted vocabulary AND the
+        # dense indices (no per-row dict probes)
+        user_ix, ui = EntityIdIxMap.build_with_indices(rd.users)
+        item_ix, ii = EntityIdIxMap.build_with_indices(rd.items)
+        ui, ii, vals = dedup_ratings(ui, ii, rd.vals, rd.ts,
+                                     self.params.dedup)
         coo = RatingsCOO(ui, ii, vals, len(user_ix), len(item_ix))
         return PreparedData(coo, user_ix, item_ix, items=td.items)
 
@@ -364,6 +374,56 @@ class ALSAlgorithm(P2LAlgorithm):
         return list(out.items())
 
 
+class MeshALSAlgorithm(ALSAlgorithm):
+    """P-placement variant: factor tables are trained AND SERVED
+    model-sharded across the mesh — nothing is ever replicated to one
+    device, so catalogs larger than a single chip's HBM serve directly
+    (reference: controller/PAlgorithm.scala:44-125 distributed-model
+    lookup; enable with algorithm name 'als-mesh' in engine.json).
+    Persistence follows the PAlgorithm default: sharded models retrain on
+    deploy (core/base.py make_persistent_model)."""
+    placement = "mesh"
+
+    def train(self, pd: PreparedData) -> RecommendationModel:
+        p = self.params
+        if pd.ratings_coo.nnz == 0:
+            raise ValueError("No ratings to train on")
+        from predictionio_tpu.ops.als import default_compute_dtype
+        cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
+                        seed=p.seed if p.seed is not None else 0,
+                        compute_dtype=p.compute_dtype
+                        or default_compute_dtype(),
+                        factor_sharding="model")
+        model = als_train(pd.ratings_coo, cfg)
+        item_properties = None
+        if pd.items is not None:
+            item_properties = [pd.items.get(pd.item_ix.id_of(ix))
+                               for ix in range(len(pd.item_ix))]
+        cats, years = RecommendationModel.derive_filters(item_properties)
+        return RecommendationModel(model, pd.user_ix, pd.item_ix,
+                                   item_properties=item_properties,
+                                   item_categories=cats, item_years=years)
+
+    def predict(self, model: RecommendationModel, query: Query
+                ) -> ItemScoreResult:
+        from predictionio_tpu.ops.als import recommend_products_sharded
+        uix = model.user_ix.get(query.user, -1)
+        if uix < 0:
+            logger.info("No prediction for unknown user %s.", query.user)
+            return ItemScoreResult(())
+        scores, idx = recommend_products_sharded(
+            model.als, int(uix), query.num,
+            allowed_mask=model.allowed_mask(query))
+        return top_scores_to_result(
+            model.item_ix, scores, idx,
+            properties_of=model.properties_of(
+                self.params.return_properties))
+
+    def batch_predict(self, model, queries):
+        # sharded ranking is already a collective per query; map predict
+        return [(ix, self.predict(model, q)) for ix, q in queries]
+
+
 class PrecisionAtK(Metric):
     """Precision@K with a positive-rating threshold (the recommendation
     template's tuning metric). None (skipped) when a user has no positive
@@ -399,7 +459,7 @@ class RecommendationEngineFactory(EngineFactory):
         return Engine(
             {"": RecommendationDataSource},
             {"": RecommendationPreparator},
-            {"als": ALSAlgorithm},
+            {"als": ALSAlgorithm, "als-mesh": MeshALSAlgorithm},
             {"": FirstServing})
 
     @classmethod

@@ -54,6 +54,9 @@ class ALSConfig:
     seed: int = 0
     work_budget: int = 1 << 20         # B*K per solve batch
     compute_dtype: str = "float32"     # einsum dtype ('bfloat16' on TPU ok)
+    solver: str = "auto"  # see ops/solve.py spd_solve
+    # auto = VMEM-resident CG Pallas kernel on TPU (XLA's batched cholesky
+    # runs at ~0.05% MXU there), LAPACK cholesky on CPU.
     factor_sharding: str = "replicated"  # 'replicated' | 'model'
     # 'model' shards factor-table rows over the mesh model axis (tables too
     # large for one device's HBM); GSPMD inserts the all-gathers the
@@ -91,7 +94,7 @@ class ALSModel:
 
 def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                  lam, alpha, *, nratings_reg: bool, implicit: bool,
-                 rank: int, compute_dtype: str):
+                 rank: int, compute_dtype: str, solver: str):
     """Solve one [B, K] batch of normal equations and scatter results into
     factors_out. Traced inside `_solve_sweep`'s scan body — gather ->
     einsum -> cholesky -> scatter fuse into one XLA program."""
@@ -121,11 +124,8 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
     reg = lam * jnp.maximum(n, 1.0) if nratings_reg else jnp.full_like(n, lam)
     eye = jnp.eye(rank, dtype=jnp.float32)
     A = A + reg[:, None, None] * eye
-    chol = jax.lax.linalg.cholesky(A)
-    x = jax.lax.linalg.triangular_solve(
-        chol, b[..., None], left_side=True, lower=True)
-    x = jax.lax.linalg.triangular_solve(
-        chol, x, left_side=True, lower=True, transpose_a=True)[..., 0]
+    from predictionio_tpu.ops.solve import spd_solve
+    x = spd_solve(A, b, method=solver, compute_dtype=compute_dtype)
     # padding rows (rows == -1) scatter to a dummy tail row
     safe_rows = jnp.where(rows < 0, factors_out.shape[0] - 1, rows)
     return factors_out.at[safe_rows].set(x.astype(factors_out.dtype),
@@ -134,11 +134,12 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
 
 @functools.partial(
     __import__("jax").jit,
-    static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype"),
+    static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
+                     "solver"),
     donate_argnums=(0,))
 def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
                  nratings_reg: bool, implicit: bool, rank: int,
-                 compute_dtype: str):
+                 compute_dtype: str, solver: str):
     """One half-iteration in ONE dispatch: `groups` is a tuple of stacked
     same-shape batch groups (rows [N,B], idx/val/mask [N,B,K]); each group
     is consumed by a `lax.scan` over its leading dim, carrying the donated
@@ -153,7 +154,7 @@ def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
         f = _solve_batch(f, counter_factors, gram, rows, idx, val, mask,
                          lam, alpha, nratings_reg=nratings_reg,
                          implicit=implicit, rank=rank,
-                         compute_dtype=compute_dtype)
+                         compute_dtype=compute_dtype, solver=solver)
         return f, None
 
     for group in groups:
@@ -221,7 +222,7 @@ def _run_side(device_groups, factors, counter_factors, cfg: ALSConfig,
         factors, counter_factors, gram, device_groups, lam, alpha,
         nratings_reg=(cfg.lambda_scaling == "nratings"),
         implicit=cfg.implicit_prefs, rank=cfg.rank,
-        compute_dtype=cfg.compute_dtype)
+        compute_dtype=cfg.compute_dtype, solver=cfg.solver)
 
 
 def als_train(ratings: RatingsCOO, cfg: ALSConfig,
@@ -231,6 +232,11 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
     returned model."""
     import jax
     mesh = mesh or current_mesh()
+    if cfg.solver == "auto":
+        import dataclasses
+        from predictionio_tpu.ops.solve import resolve_solver
+        cfg = dataclasses.replace(
+            cfg, solver=resolve_solver(cfg.solver, mesh.n_devices))
     dp = mesh.data_parallelism
     user_plan = plan_for_users(ratings, work_budget=cfg.work_budget,
                                batch_multiple=dp)
@@ -335,6 +341,61 @@ def recommend_products(model: ALSModel, user_ix: int, k: int,
         np.int32(user_ix),
         _pad_exclude(exclude if exclude is not None else ()), k_eff)
     return np.asarray(scores), np.asarray(idx)
+
+
+def recommend_products_sharded(model: ALSModel, user_ix: int, k: int,
+                               mesh: Optional[MeshContext] = None,
+                               exclude: Optional[np.ndarray] = None,
+                               allowed_mask: Optional[np.ndarray] = None
+                               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Serve-time top-k with BOTH factor tables kept model-sharded on the
+    mesh — the P-model serve path for tables larger than one device's HBM
+    (reference: controller/PAlgorithm.scala:44-125's distributed-model
+    query; MLlib-side analog examples/scala-parallel-similarproduct/multi/
+    src/main/scala/ALSAlgorithm.scala:146-190). The user row is gathered
+    across shards by GSPMD; scoring + ranking run as a two-phase sharded
+    top-k over ICI (ops/topk.sharded_top_k). Nothing is ever replicated."""
+    import jax
+    from predictionio_tpu.ops.topk import sharded_top_k
+    from predictionio_tpu.utils.device_cache import cached_put_padded
+
+    from predictionio_tpu.utils.device_cache import cached_put
+
+    mesh = mesh or current_mesh()
+    mp = mesh.model_parallelism
+    sh = mesh.model_sharded(2)
+    mask_sh = mesh.sharding(mesh.MODEL_AXIS)
+    U = cached_put_padded(model.user_factors, sh, mp)
+    V = cached_put_padded(model.item_factors, sh, mp)
+    has_filter = (allowed_mask is not None or
+                  (exclude is not None and len(np.atleast_1d(exclude))))
+    if not has_filter:
+        # the padding-only mask is a pure function of (table, mp): keep it
+        # alive on the model so cached_put keeps it device-resident — no
+        # per-query H2D on the latency-sensitive serve path
+        base = getattr(model, "_serve_mask", None)
+        if base is None or base.shape[0] != V.shape[0]:
+            base = np.ones(V.shape[0], dtype=bool)
+            base[model.n_items:] = False
+            model._serve_mask = base
+        mask_dev = cached_put(base, mask_sh)
+    else:
+        mask = np.zeros(V.shape[0], dtype=bool)
+        mask[:model.n_items] = (True if allowed_mask is None
+                                else allowed_mask[:model.n_items])
+        if exclude is not None and len(np.atleast_1d(exclude)):
+            mask[np.asarray(exclude, dtype=np.int64)] = False
+        mask_dev = jax.device_put(mask, mask_sh)
+    u = _row_of(U, np.int32(user_ix))     # cross-shard gather -> replicated
+    k_eff = min(k, model.n_items)
+    scores, idx = sharded_top_k(V, u, k_eff, mesh,
+                                allowed_mask_sharded=mask_dev)
+    return scores[:k_eff], idx[:k_eff]
+
+
+@functools.partial(__import__("jax").jit)
+def _row_of(table, ix):
+    return table[ix]
 
 
 def predict_ratings(model: ALSModel, user_ix: np.ndarray,

@@ -1,0 +1,308 @@
+"""Batched SPD solves for the normal-equation sweeps — the MXU-native
+replacement for factorize-and-substitute.
+
+Why not Cholesky: XLA's TPU cholesky + triangular_solve on batched
+[B, rank, rank] systems runs at ~0.05% MXU utilization (measured: ~9.3 s
+of a 9.8 s ML-20M ALS iteration; see docs/benchmarks.md). Iterative
+methods whose only primitive is multiply-accumulate map to the hardware
+instead, and the ALS normal matrix A = Gram + lam*n*I arrives
+pre-regularized — its condition number is bounded by
+~rank*E[v^2]/lam — so fixed iteration counts converge to f32 working
+precision.
+
+Production path (TPU): batched conjugate gradient in a Pallas kernel,
+grid over 16-entity tiles whose [16, R, R] systems stay VMEM-resident for
+every iteration (HBM reads A exactly once). Measured on v5e at B=2048,
+R=200, cond~230: 27 ms and rel err 3e-6, vs 140 ms for XLA
+cholesky+trsm.
+
+Also provided: the Schulz/Hotelling–Bodewig inverse iteration
+X_{k+1} = X_k(2I - A X_k) (pure batched MXU matmuls, bf16-safe because
+self-correcting, plus two f32 refinement steps) in jnp and Pallas forms —
+slower than CG here (~35 ms) but useful where an explicit inverse or a
+matmul-only formulation is wanted — and LAPACK-style `cholesky_solve`,
+the CPU path and numerical reference.
+
+`spd_solve` picks per backend: cholesky on CPU, CG-Pallas on TPU, jnp CG
+under GSPMD meshes.
+
+Replaces the `choleskyDecomposition.solve` step of MLlib ALS
+(reference consumer: examples/scala-parallel-recommendation/custom-prepartor/
+src/main/scala/ALSAlgorithm.scala:55 `ALS.train` -> mllib
+NNLS/CholeskySolver).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def _schulz_iters_default(rank: int) -> int:
+    # quadratic convergence: error after k steps ~ (1 - 1/kappa)^(2^k);
+    # 18 doublings resolve kappa ~ 1e4 to f32 eps with margin
+    return 18
+
+
+def schulz_solve(A, b, iters: int | None = None, compute_dtype="bfloat16"):
+    """Solve A x = b for batched SPD A [B, R, R], b [B, R] by Schulz
+    iteration. Pure jnp — runs on any backend, used as the Pallas
+    kernel's correctness reference."""
+    import jax
+    import jax.numpy as jnp
+
+    rank = A.shape[-1]
+    iters = iters or _schulz_iters_default(rank)
+    cd = jnp.dtype(compute_dtype)
+    alpha = 1.0 / jnp.maximum(
+        jnp.max(jnp.sum(jnp.abs(A), axis=-1), axis=-1), 1e-30)   # 1/||A||_inf
+    eye = jnp.eye(rank, dtype=jnp.float32)
+    X = alpha[:, None, None] * eye
+
+    def body(_, X):
+        Y = jnp.einsum("brs,bst->brt", A.astype(cd), X.astype(cd),
+                       preferred_element_type=jnp.float32)
+        return 2.0 * X - jnp.einsum("brs,bst->brt", X.astype(cd),
+                                    Y.astype(cd),
+                                    preferred_element_type=jnp.float32)
+
+    X = jax.lax.fori_loop(0, iters, body, X)
+    x = jnp.einsum("brs,bs->br", X, b, preferred_element_type=jnp.float32)
+    # two f32 iterative-refinement steps: with X ~ A^-1 to epsilon_it, each
+    # step multiplies the solution error by epsilon_it — recovers near-f32
+    # solutions even when the iterate converged in bf16
+    for _ in range(2):
+        r = b - jnp.einsum("brs,bs->br", A, x,
+                           preferred_element_type=jnp.float32)
+        x = x + jnp.einsum("brs,bs->br", X, r,
+                           preferred_element_type=jnp.float32)
+    return x
+
+
+def _schulz_kernel(a_ref, b_ref, x_ref, *, iters: int, compute_dtype):
+    import jax
+    import jax.numpy as jnp
+
+    A = a_ref[:]                                   # [BT, R, R] f32, VMEM
+    rank = A.shape[-1]
+    cd = jnp.dtype(compute_dtype)
+    alpha = 1.0 / jnp.maximum(
+        jnp.max(jnp.sum(jnp.abs(A), axis=-1), axis=-1), 1e-30)
+    eye = jnp.eye(rank, dtype=jnp.float32)[None]
+    X = alpha[:, None, None] * eye
+    Abf = A.astype(cd)
+    bmm = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+
+    def body(_, X):
+        Y = bmm(Abf, X.astype(cd))
+        return 2.0 * X - bmm(X.astype(cd), Y.astype(cd))
+
+    X = jax.lax.fori_loop(0, iters, body, X)
+    bvec = b_ref[:]
+    bmv = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    x = bmv(X, bvec)
+    for _ in range(2):   # f32 iterative refinement (see schulz_solve)
+        x = x + bmv(X, bvec - bmv(A, x))
+    x_ref[:] = x
+
+
+def schulz_solve_pallas(A, b, iters: int | None = None,
+                        compute_dtype="bfloat16", tile: int = 8):
+    """TPU kernel: grid over batch tiles; each tile's inverse iterate lives
+    in VMEM for all `iters` Schulz steps, so HBM traffic is one read of A +
+    one write of x (vs one read/write of [B,R,R] per step for the XLA
+    loop)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, rank = A.shape[0], A.shape[-1]
+    iters = iters or _schulz_iters_default(rank)
+    if B % tile != 0:
+        pad = tile - B % tile
+        A = jnp.concatenate(
+            [A, jnp.broadcast_to(jnp.eye(rank, dtype=A.dtype),
+                                 (pad, rank, rank))], axis=0)
+        b = jnp.concatenate([b, jnp.zeros((pad, rank), b.dtype)], axis=0)
+    nb = A.shape[0] // tile
+    kernel = functools.partial(_schulz_kernel, iters=iters,
+                               compute_dtype=compute_dtype)
+    x = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((A.shape[0], rank), jnp.float32),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((tile, rank, rank), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, rank), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, rank), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+    )(A.astype(jnp.float32), b)
+    return x[:B]
+
+
+def cholesky_solve(A, b):
+    """LAPACK-style direct solve — the CPU path and the numerical
+    reference."""
+    import jax
+    chol = jax.lax.linalg.cholesky(A)
+    x = jax.lax.linalg.triangular_solve(chol, b[..., None], left_side=True,
+                                        lower=True)
+    return jax.lax.linalg.triangular_solve(
+        chol, x, left_side=True, lower=True, transpose_a=True)[..., 0]
+
+
+def cg_solve(A, b, iters: int = 48):
+    """Batched Jacobi-preconditioned conjugate gradient on SPD A [B,R,R] —
+    pure jnp reference (and the GSPMD-mesh path, where pallas_call can't
+    take sharded operands). The ALS normal matrix's per-entity regularizer
+    lam*n*I plus its dominant diagonal keep the *preconditioned* condition
+    number small, so a fixed iteration count converges to f32 working
+    precision; adversarial spectra need iters ~ sqrt(cond)*ln(1/eps)
+    (tests/test_solve.py covers both)."""
+    import jax
+    import jax.numpy as jnp
+
+    dinv = 1.0 / jnp.maximum(
+        jnp.diagonal(A, axis1=-2, axis2=-1), 1e-30)        # Jacobi M^-1
+    x = jnp.zeros_like(b)
+    r = b
+    z = dinv * r
+    p = z
+    rz = jnp.sum(r * z, axis=1)
+
+    def body(_, c):
+        x, r, p, rz = c
+        Ap = jnp.einsum("brs,bs->br", A, p,
+                        preferred_element_type=jnp.float32)
+        alpha = rz / jnp.maximum(jnp.sum(p * Ap, axis=1), 1e-30)
+        x = x + alpha[:, None] * p
+        r = r - alpha[:, None] * Ap
+        z = dinv * r
+        rz2 = jnp.sum(r * z, axis=1)
+        p = z + (rz2 / jnp.maximum(rz, 1e-30))[:, None] * p
+        return (x, r, p, rz2)
+
+    x, *_ = jax.lax.fori_loop(0, iters, body, (x, r, p, rz))
+    return x
+
+
+def _cg_kernel(a_ref, b_ref, x_ref, *, iters: int):
+    """Per-tile Jacobi-PCG: A stays VMEM-resident for every iteration; the
+    matvec contracts over the sublane axis (A is symmetric, so A[t,s,:]
+    rows serve as columns), which reduces to cheap vreg adds instead of
+    cross-lane shuffles."""
+    import jax
+    import jax.numpy as jnp
+
+    A = a_ref[:]
+    bb = b_ref[:]
+    rank = A.shape[-1]
+    eye = jnp.eye(rank, dtype=jnp.float32)[None]
+    dinv = 1.0 / jnp.maximum(jnp.sum(A * eye, axis=1), 1e-30)
+
+    def mv(p):
+        return jnp.sum(A * p[:, :, None], axis=1)
+
+    x = jnp.zeros_like(bb)
+    r = bb
+    z = dinv * r
+    p = z
+    rz = jnp.sum(r * z, axis=1)
+
+    def body(_, c):
+        x, r, p, rz = c
+        Ap = mv(p)
+        alpha = rz / jnp.maximum(jnp.sum(p * Ap, axis=1), 1e-30)
+        x = x + alpha[:, None] * p
+        r = r - alpha[:, None] * Ap
+        z = dinv * r
+        rz2 = jnp.sum(r * z, axis=1)
+        p = z + (rz2 / jnp.maximum(rz, 1e-30))[:, None] * p
+        return (x, r, p, rz2)
+
+    x, *_ = jax.lax.fori_loop(0, iters, body, (x, r, p, rz))
+    x_ref[:] = x
+
+
+def cg_solve_pallas(A, b, iters: int = 48, tile: int = 16):
+    """TPU production solver: grid over batch tiles of 16 entities, each
+    tile's [16, R, R] system VMEM-resident across all CG iterations.
+    Measured (v5e, B=2048, R=200): ~27 ms vs 140 ms for XLA batched
+    cholesky+trsm — and the full ALS sweep goes from 9.8 s to ~2 s per
+    ML-20M iteration."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, rank = A.shape[0], A.shape[-1]
+    # pad the batch UP to a full tile (never shrink the tile: sub-8 batch
+    # dims produce vector shapes Mosaic can't reduce over)
+    if B % tile != 0:
+        pad = tile - B % tile
+        A = jnp.concatenate(
+            [A, jnp.broadcast_to(jnp.eye(rank, dtype=A.dtype),
+                                 (pad, rank, rank))], axis=0)
+        b = jnp.concatenate([b, jnp.zeros((pad, rank), b.dtype)], axis=0)
+    kernel = functools.partial(_cg_kernel, iters=iters)
+    x = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((A.shape[0], rank), jnp.float32),
+        grid=(A.shape[0] // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, rank, rank), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, rank), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, rank), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(A.astype(jnp.float32), b)
+    return x[:B]
+
+
+def resolve_solver(method: str, n_devices: int = 1) -> str:
+    """'auto' -> concrete method: CG on TPU (Pallas single-device; the jnp
+    formulation under GSPMD meshes, where pallas_call can't consume sharded
+    operands), cholesky on CPU/GPU (LAPACK/cuSOLVER are fine there)."""
+    if method != "auto":
+        return method
+    import jax
+    if jax.default_backend() == "tpu":
+        return "cg_pallas" if n_devices == 1 else "cg"
+    return "cholesky"
+
+
+def spd_solve(A, b, method: str = "auto", iters: int | None = None,
+              compute_dtype: str = "bfloat16"):
+    """Batched SPD solve with backend-appropriate method selection.
+
+    method: 'auto' | 'cholesky' | 'cg' | 'cg_pallas' | 'schulz' |
+            'schulz_pallas'
+    """
+    if method == "auto":
+        method = resolve_solver(method)
+    if method == "cholesky":
+        return cholesky_solve(A, b)
+    if method == "cg":
+        return cg_solve(A, b, iters or 48)
+    if method == "cg_pallas":
+        return cg_solve_pallas(A, b, iters or 48)
+    if method == "schulz":
+        return schulz_solve(A, b, iters, compute_dtype)
+    if method == "schulz_pallas":
+        return schulz_solve_pallas(A, b, iters, compute_dtype)
+    raise ValueError(f"unknown solver {method!r}")

@@ -36,7 +36,12 @@ def sharded_top_k(item_factors_sharded, query_vec, k: int,
     n_items = item_factors_sharded.shape[0]
     mp = mesh.model_parallelism
     shard_rows = n_items // mp
-    k_eff = min(k, shard_rows)
+    # a shard can contribute at most shard_rows candidates, and the global
+    # top-k takes at most shard_rows items from any single shard — so
+    # k_local candidates per shard are sufficient for an exact answer even
+    # when k exceeds shard_rows
+    k_local = min(k, shard_rows)
+    k_final = min(k, mp * k_local)
 
     @functools.partial(
         shard_map, mesh=mesh.mesh,
@@ -47,13 +52,13 @@ def sharded_top_k(item_factors_sharded, query_vec, k: int,
         scores = jnp.einsum("ir,r->i", v_shard, q,
                             preferred_element_type=jnp.float32)
         scores = jnp.where(mask_shard, scores, -jnp.inf)
-        local_s, local_i = jax.lax.top_k(scores, k_eff)
+        local_s, local_i = jax.lax.top_k(scores, k_local)
         # globalize indices: shard offset from the model-axis position
         ax = jax.lax.axis_index("model")
         local_i = local_i + ax * v_shard.shape[0]
         all_s = jax.lax.all_gather(local_s, "model").reshape(-1)
         all_i = jax.lax.all_gather(local_i, "model").reshape(-1)
-        top_s, pos = jax.lax.top_k(all_s, k_eff)
+        top_s, pos = jax.lax.top_k(all_s, k_final)
         return top_s, all_i[pos]
 
     if allowed_mask_sharded is None:
@@ -62,4 +67,4 @@ def sharded_top_k(item_factors_sharded, query_vec, k: int,
     q = jnp.asarray(query_vec, dtype=item_factors_sharded.dtype)
     scores, idx = _local_then_global(item_factors_sharded, q,
                                      allowed_mask_sharded)
-    return np.asarray(scores)[:k], np.asarray(idx)[:k]
+    return np.asarray(scores)[:k_final], np.asarray(idx)[:k_final]

@@ -48,8 +48,12 @@ class ServerConfig:
     event_server_ip: str = "0.0.0.0"
     event_server_port: int = 7070
     feedback: bool = False
-    micro_batch: int = 1       # >1 coalesces concurrent queries into one
-    micro_batch_wait_ms: float = 2.0  # batched device call (beyond-parity)
+    # >1 coalesces concurrent queries into one batched device call
+    # (beyond-parity). On by default so a plain `pio deploy` gets the same
+    # concurrency mitigation the benchmarks measure; single queries pay at
+    # most micro_batch_wait_ms.
+    micro_batch: int = 16
+    micro_batch_wait_ms: float = 2.0
 
 
 class EngineServer:
@@ -67,10 +71,13 @@ class EngineServer:
         self.serving = None
         self.plugin_context = (plugin_context or
                                EngineServerPluginContext.load_from_env())
-        # serving counters (CreateServer.scala:418-420)
+        # serving counters (CreateServer.scala:418-420), plus a predict-time
+        # split so operators can tell device/score time from HTTP+serve
+        # overhead (beyond-parity observability)
         self.request_count = 0
         self.serving_seconds = 0.0
         self.last_serving_sec = 0.0
+        self.predict_seconds = 0.0
         self.start_time = utcnow()
         self.server: Optional[HttpServer] = None
         self.batcher = None
@@ -148,8 +155,10 @@ class EngineServer:
         qc = algorithms[0].query_class
         query = qc.from_dict(query_dict) if qc is not None else query_dict
         supplemented = serving.supplement(query)
+        tp = time.perf_counter()
         predictions = [algo.predict(model, supplemented)
                        for algo, model in zip(algorithms, models)]
+        predict_dt = time.perf_counter() - tp
         prediction = serving.serve(query, predictions)
         pred_dict = (prediction.to_dict()
                      if hasattr(prediction, "to_dict") else prediction)
@@ -166,6 +175,7 @@ class EngineServer:
             self.request_count += 1
             self.serving_seconds += dt
             self.last_serving_sec = dt
+            self.predict_seconds += predict_dt
         return pred_dict
 
     def handle_query_batch(self, query_dicts: List[dict]) -> List[dict]:
@@ -182,8 +192,10 @@ class EngineServer:
         queries = [qc.from_dict(d) if qc is not None else d
                    for d in query_dicts]
         indexed = [(i, serving.supplement(q)) for i, q in enumerate(queries)]
+        tp = time.perf_counter()
         per_algo = [dict(algo.batch_predict(model, indexed))
                     for algo, model in zip(algorithms, models)]
+        predict_dt = time.perf_counter() - tp
         out = []
         for i, (q, d) in enumerate(zip(queries, query_dicts)):
             prediction = serving.serve(q, [pa[i] for pa in per_algo])
@@ -202,6 +214,7 @@ class EngineServer:
             self.request_count += len(queries)
             self.serving_seconds += dt
             self.last_serving_sec = dt / max(len(queries), 1)
+            self.predict_seconds += predict_dt
         return out
 
     # -- feedback loop (:526-596) ------------------------------------------
@@ -272,6 +285,21 @@ class EngineServer:
     def _plugins(self, req: Request) -> Response:
         return Response(200, self.plugin_context.to_dict())
 
+    def _stats(self, req: Request) -> Response:
+        """JSON serving counters with the predict/total latency split: how
+        much of the serving time is the algorithm's device scoring vs
+        serve/HTTP overhead."""
+        with self._lock:
+            n = self.request_count
+            return Response(200, {
+                "requestCount": n,
+                "avgServingSec": self.serving_seconds / n if n else 0.0,
+                "lastServingSec": self.last_serving_sec,
+                "avgPredictSec": self.predict_seconds / n if n else 0.0,
+                "microBatch": self.config.micro_batch,
+                "startTime": self.start_time.isoformat(),
+            })
+
     def _profile(self, req: Request) -> Response:
         """jax.profiler trace control — beyond-parity observability
         (SURVEY.md §5 tracing). POST /profile.json {"action": "start",
@@ -297,6 +325,7 @@ class EngineServer:
         r.add("POST", "/stop", self._stop)
         r.add("GET", "/stop", self._stop)
         r.add("GET", "/plugins.json", self._plugins)
+        r.add("GET", "/stats.json", self._stats)
         r.add("POST", "/profile.json", self._profile)
         return r
 

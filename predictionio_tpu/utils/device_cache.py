@@ -39,6 +39,33 @@ def cached_put(arr, sharding=None):
     return dev
 
 
+def cached_put_padded(arr, sharding, row_multiple: int):
+    """cached_put for sharded uploads whose dim-0 must divide the axis
+    size: pads rows with zeros before upload, memoized on
+    (array identity, sharding, multiple) so per-query serve calls reuse
+    the resident padded table."""
+    import jax
+    import numpy as np
+
+    key = (id(arr), sharding, row_multiple)
+    with _lock:
+        entry = _cache.get(key)
+        if entry is not None and entry[0]() is arr:
+            return entry[1]
+    n = arr.shape[0]
+    target = ((n + row_multiple - 1) // row_multiple) * row_multiple
+    padded = arr if target == n else np.concatenate(
+        [arr, np.zeros((target - n,) + arr.shape[1:], arr.dtype)])
+    dev = jax.device_put(padded, sharding)
+    try:
+        ref = weakref.ref(arr, lambda r, k=key: _cache.pop(k, None))
+    except TypeError:
+        return dev
+    with _lock:
+        _cache[key] = (ref, dev)
+    return dev
+
+
 def cache_size() -> int:
     with _lock:
         return len(_cache)

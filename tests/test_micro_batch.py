@@ -117,3 +117,20 @@ class TestMicroBatchedServer:
             by_user.setdefault(u, set()).add(key)
         assert all(len(v) == 1 for v in by_user.values())
         assert server.request_count == 24
+
+    def test_stats_endpoint_reports_latency_split(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.config.port}/queries.json",
+            data=json.dumps({"user": "u1", "num": 2}).encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=30).read()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.config.port}/stats.json",
+                timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["requestCount"] >= 1
+        assert stats["avgServingSec"] > 0
+        assert stats["avgPredictSec"] > 0
+        # predict time is a component of total serving time
+        assert stats["avgPredictSec"] <= stats["avgServingSec"]
+        assert stats["microBatch"] == 16
