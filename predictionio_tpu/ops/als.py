@@ -273,8 +273,16 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
         U = _run_side(user_batches, U, V, cfg, gram_v, lam_dev, alpha_dev)
         gram_u = _gram(U[:ratings.n_users]) if cfg.implicit_prefs else None
         V = _run_side(item_batches, V, U, cfg, gram_u, lam_dev, alpha_dev)
-    U_host = np.asarray(U)[:ratings.n_users]
-    V_host = np.asarray(V)[:ratings.n_items]
+    from predictionio_tpu.parallel.mesh import host_fetch
+    if cfg.factor_sharding == "model":
+        # gather the model-sharded tables through a replicating jit (a
+        # direct np.asarray on a cross-process sharded array is illegal)
+        import jax.numpy as jnp
+        gather = __import__("jax").jit(lambda a: jnp.asarray(a),
+                                       out_shardings=mesh.replicated())
+        U, V = gather(U), gather(V)
+    U_host = host_fetch(U)[:ratings.n_users]
+    V_host = host_fetch(V)[:ratings.n_items]
     return ALSModel(user_factors=U_host, item_factors=V_host, rank=cfg.rank)
 
 

@@ -110,29 +110,39 @@ class MeshContext:
         return self.sharding(self.MODEL_AXIS, *([None] * (ndim - 1)))
 
     # -- data movement ------------------------------------------------------
+    def put(self, x, sharding):
+        """Host array -> device array with the given sharding. Single
+        process uses device_put; multi-process goes through
+        make_array_from_callback, where each process materializes only its
+        addressable shards — device_put's cross-process assert_equal
+        collective both costs an allgather of the full array and (observed
+        on the gloo CPU backend) false-positives on identical inputs."""
+        jax = _jax()
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
+
     def put_batch(self, x):
         """Host array -> device array sharded on dim 0 over the data axis.
         dim 0 must be divisible by data_parallelism (use pad_to_multiple)."""
-        jax = _jax()
-        return jax.device_put(x, self.batch_sharded(np.ndim(x)))
+        return self.put(x, self.batch_sharded(np.ndim(x)))
 
     def put_replicated(self, x):
-        jax = _jax()
-        return jax.device_put(x, self.replicated())
+        return self.put(x, self.replicated())
 
     def put_stacked(self, x):
         """Host array -> device array sharded on dim 1 over the data axis:
         the layout of stacked same-shape batch groups [N, B, ...] that a
         `lax.scan` consumes along dim 0, each slice staying data-sharded."""
-        jax = _jax()
         ndim = np.ndim(x)
-        return jax.device_put(
+        return self.put(
             x, self.sharding(None, self.DATA_AXIS, *([None] * (ndim - 2))))
 
     def put_model_sharded(self, x):
         """Rows sharded over the model axis (embedding tables)."""
-        jax = _jax()
-        return jax.device_put(x, self.model_sharded(np.ndim(x)))
+        return self.put(x, self.model_sharded(np.ndim(x)))
 
     def pad_to_multiple(self, x: np.ndarray, axis: int = 0,
                         multiple: Optional[int] = None,
@@ -147,6 +157,20 @@ class MeshContext:
         pad_width = [(0, 0)] * x.ndim
         pad_width[axis] = (0, target - n)
         return np.pad(x, pad_width, constant_values=fill), n
+
+
+def host_fetch(x) -> np.ndarray:
+    """Device array -> host numpy, multi-process safe: a replicated array
+    spanning remote processes is not fully addressable, but every local
+    shard holds the complete value."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    shard = x.addressable_data(0)
+    if shard.shape != x.shape:
+        raise ValueError(
+            f"host_fetch needs a replicated array; got sharded shape "
+            f"{shard.shape} of global {x.shape}")
+    return np.asarray(shard)
 
 
 def make_mesh(devices=None, model_parallelism: int = 1) -> MeshContext:

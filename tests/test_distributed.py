@@ -32,16 +32,45 @@ print(f"OK proc {pid}")
 """)
 
 
-@pytest.mark.timeout(180)
-def test_two_process_mesh(tmp_path):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    prog = PROG % {"repo": repo}
+ALS_PROG = textwrap.dedent("""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+from predictionio_tpu.parallel.mesh import init_distributed, make_mesh
+import numpy as np
+init_distributed()
+pid = jax.process_index()
+assert jax.device_count() == 8, jax.device_count()
+mesh = make_mesh()
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.ops.ratings import RatingsCOO
+rng = np.random.default_rng(11)
+n_u, n_i, nnz = 40, 24, 400
+ratings = RatingsCOO(rng.integers(0, n_u, nnz).astype(np.int32),
+                     rng.integers(0, n_i, nnz).astype(np.int32),
+                     (1 + 4 * rng.random(nnz)).astype(np.float32),
+                     n_u, n_i)
+model = als_train(ratings, ALSConfig(rank=6, iterations=3, lam=0.1,
+                                     seed=4, work_budget=256), mesh)
+ref = np.load(os.environ["PIO_TEST_REF_NPZ"])
+np.testing.assert_allclose(model.user_factors, ref["u"],
+                           rtol=1e-4, atol=1e-5)
+np.testing.assert_allclose(model.item_factors, ref["v"],
+                           rtol=1e-4, atol=1e-5)
+print(f"OK proc {pid}")
+""")
+
+
+def _run_two_procs(prog, extra_env, port):
     procs = []
     for pid in range(2):
         env = dict(os.environ,
-                   PIO_COORDINATOR="127.0.0.1:19877",
+                   PIO_COORDINATOR=f"127.0.0.1:{port}",
                    PIO_NUM_PROCESSES="2", PIO_PROCESS_ID=str(pid),
-                   PALLAS_AXON_POOL_IPS="")
+                   PALLAS_AXON_POOL_IPS="", **extra_env)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", prog], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
@@ -52,3 +81,35 @@ def test_two_process_mesh(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"OK proc {i}" in out
+
+
+@pytest.mark.timeout(180)
+def test_two_process_mesh(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _run_two_procs(PROG % {"repo": repo}, {}, 19877)
+
+
+@pytest.mark.timeout(300)
+def test_two_process_als_matches_single_process(tmp_path, mesh8):
+    """als_train over 2 processes x 4 devices produces the same factors as
+    the single-process 8-device mesh (the Spark executor-side training
+    equivalence; reference: controller/Engine.scala:688 train on the
+    cluster)."""
+    import numpy as np
+    from predictionio_tpu.ops.als import ALSConfig, als_train
+    from predictionio_tpu.ops.ratings import RatingsCOO
+
+    rng = np.random.default_rng(11)
+    n_u, n_i, nnz = 40, 24, 400
+    ratings = RatingsCOO(rng.integers(0, n_u, nnz).astype(np.int32),
+                         rng.integers(0, n_i, nnz).astype(np.int32),
+                         (1 + 4 * rng.random(nnz)).astype(np.float32),
+                         n_u, n_i)
+    ref = als_train(ratings, ALSConfig(rank=6, iterations=3, lam=0.1,
+                                       seed=4, work_budget=256), mesh8)
+    ref_path = str(tmp_path / "ref.npz")
+    np.savez(ref_path, u=ref.user_factors, v=ref.item_factors)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _run_two_procs(ALS_PROG % {"repo": repo},
+                   {"PIO_TEST_REF_NPZ": ref_path}, 19879)
