@@ -96,7 +96,7 @@ def _backend_module(type_: str):
         "sqlite": "predictionio_tpu.data.storage.sqlite",
         "memory": "predictionio_tpu.data.storage.memory",
         "localfs": "predictionio_tpu.data.storage.localfs",
-        "pgsql": "predictionio_tpu.data.storage.sqlite",  # same SQL DAO family
+        "pgsql": "predictionio_tpu.data.storage.pgsql",  # wire-protocol PG
         "nativelog": "predictionio_tpu.data.storage.nativelog",  # C++ log
     }
     if type_ not in modules:

@@ -41,3 +41,83 @@ class TestDataView:
         assert set(cols["event"].tolist()) == {"$set", "rate", "view"}
         assert cols["eventTimeMillis"].dtype == np.int64
         assert "" in cols["targetEntityId"].tolist()  # $set has no target
+
+
+class TestCreateView:
+    def test_typed_conversion_and_cache(self, tmp_env):
+        """DataView.create parity: conversion -> typed columns, None drops
+        the event, second call hits the .npz cache."""
+        from dataclasses import dataclass
+
+        from predictionio_tpu.data.view import ColumnarView, create_view
+
+        seed()
+
+        @dataclass
+        class RateRow:
+            user: str
+            item: str
+            rating: float
+
+        calls = {"n": 0}
+
+        def conv(e):
+            calls["n"] += 1
+            if e.event != "rate":
+                return None
+            return RateRow(e.entity_id, e.target_entity_id,
+                           e.properties.get("rating", float))
+
+        import datetime as dt
+        until = dt.datetime(2027, 1, 1, tzinfo=dt.timezone.utc)
+        v = create_view("viewapp", conv, name="rates", version="1",
+                        until_time=until)
+        assert isinstance(v, ColumnarView)
+        assert len(v) == 1
+        assert v.names == ["user", "item", "rating"]
+        assert v["rating"].dtype == np.float64
+        assert v["rating"][0] == 3.0
+        assert v["user"][0] == "u1"
+        n_after_first = calls["n"]
+        # cached: conversion not called again
+        v2 = create_view("viewapp", conv, name="rates", version="1",
+                         until_time=until)
+        assert calls["n"] == n_after_first
+        assert v2["item"].tolist() == ["i1"]
+        # version bump invalidates the cache
+        create_view("viewapp", conv, name="rates", version="2",
+                    until_time=until)
+        assert calls["n"] > n_after_first
+
+    def test_filter_and_mapping_records(self, tmp_env):
+        from predictionio_tpu.data.view import create_view
+
+        seed()
+        import datetime as dt
+        until = dt.datetime(2027, 1, 1, tzinfo=dt.timezone.utc)
+        v = create_view("viewapp",
+                        lambda e: {"ev": e.event, "who": e.entity_id},
+                        name="all", version="1", until_time=until)
+        assert len(v) == 3
+        sub = v.filter(v["ev"] == "rate")
+        assert sub["who"].tolist() == ["u1"]
+
+
+class TestOrderedFold:
+    def test_aggregate_by_entity_ordered(self, tmp_env):
+        """LBatchView.aggregateByEntityOrdered: time-ordered fold per
+        entity."""
+        seed()
+        bv = BatchView("viewapp")
+        seq = bv.aggregate_by_entity_ordered(
+            init=(), op=lambda acc, e: acc + (e.event,))
+        assert seq["u1"] == ("$set", "rate")
+        assert seq["u2"] == ("view",)
+
+    def test_aggregate_properties_time_bounded(self, tmp_env):
+        import datetime as dt
+        seed()
+        bv = BatchView("viewapp")
+        early = dt.datetime(1990, 1, 1, tzinfo=dt.timezone.utc)
+        agg = bv.aggregate_properties("user", until_time=early)
+        assert agg == {}
