@@ -98,6 +98,8 @@ def _backend_module(type_: str):
         "localfs": "predictionio_tpu.data.storage.localfs",
         "pgsql": "predictionio_tpu.data.storage.pgsql",  # wire-protocol PG
         "nativelog": "predictionio_tpu.data.storage.nativelog",  # C++ log
+        "remotefs": "predictionio_tpu.data.storage.remotefs",  # URI blobs
+        "hdfs": "predictionio_tpu.data.storage.remotefs",  # HDFS role
     }
     if type_ not in modules:
         raise StorageError(f"Unknown storage source type: {type_}. "

@@ -57,6 +57,13 @@ class ALSConfig:
     solver: str = "auto"  # see ops/solve.py spd_solve
     # auto = VMEM-resident CG Pallas kernel on TPU (XLA's batched cholesky
     # runs at ~0.05% MXU there), LAPACK cholesky on CPU.
+    dual_solve: str = "auto"  # 'auto' | 'never'
+    # Woodbury/dual formulation for explicit ALS buckets whose padded
+    # segment length K < rank: solve the K x K system
+    # (M M^T + reg I_K) z = y and map back x = M^T z — exact algebra,
+    # K^2*rank Gram + K^3-class solve instead of K*rank^2 + rank^3. Under
+    # a power-law count distribution most entities live in small-K
+    # buckets, so this removes most of the solve work.
     factor_sharding: str = "replicated"  # 'replicated' | 'model'
     # 'model' shards factor-table rows over the mesh model axis (tables too
     # large for one device's HBM); GSPMD inserts the all-gathers the
@@ -94,16 +101,47 @@ class ALSModel:
 
 def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                  lam, alpha, *, nratings_reg: bool, implicit: bool,
-                 rank: int, compute_dtype: str, solver: str):
+                 rank: int, compute_dtype: str, solver: str,
+                 dual_solve: str = "auto"):
     """Solve one [B, K] batch of normal equations and scatter results into
     factors_out. Traced inside `_solve_sweep`'s scan body — gather ->
-    einsum -> cholesky -> scatter fuse into one XLA program."""
+    einsum -> solve -> scatter fuse into one XLA program. Explicit batches
+    with K < rank take the dual (Woodbury) K x K route; K is static per
+    batch group, so the choice costs nothing at runtime."""
     import jax
     import jax.numpy as jnp
+
+    from predictionio_tpu.ops.solve import spd_solve
 
     cd = jnp.dtype(compute_dtype)
     Vg = counter_factors[idx]                       # [B, K, R] gather
     Vc = Vg.astype(cd)
+    K = idx.shape[1]
+    eye = jnp.eye(rank, dtype=jnp.float32)
+    n = mask.sum(axis=-1)                            # ratings per entity
+    reg = lam * jnp.maximum(n, 1.0) if nratings_reg else jnp.full_like(n, lam)
+
+    if dual_solve == "auto" and not implicit and K < rank:
+        # dual/Woodbury: with M = mask-weighted factor rows [K, R],
+        # (M^T M + reg I_R)^-1 M^T y == M^T (M M^T + reg I_K)^-1 y.
+        # Gram is K^2*R instead of K*R^2, solve is K-dimensional.
+        Vm = Vc * mask[..., None].astype(cd)
+        Ad = jnp.einsum("bkr,blr->bkl", Vm, Vm,
+                        preferred_element_type=jnp.float32)
+        Ad = Ad + reg[:, None, None] * jnp.eye(K, dtype=jnp.float32)
+        y = (val * mask)
+        # CG reaches exact K-dim solutions in <= K+margin iterations; tiny
+        # systems skip the Pallas kernel (per-tile overhead dominates)
+        method = solver
+        if K < 32 and solver == "cg_pallas":
+            method = "cg"
+        z = spd_solve(Ad, y, method=method, iters=min(48, K + 8))
+        x = jnp.einsum("bkr,bk->br", Vm, z.astype(cd),
+                       preferred_element_type=jnp.float32)
+        safe_rows = jnp.where(rows < 0, factors_out.shape[0] - 1, rows)
+        return factors_out.at[safe_rows].set(x.astype(factors_out.dtype),
+                                             mode="drop")
+
     if implicit:
         absval = jnp.abs(val)
         conf_minus_1 = (alpha * absval) * mask       # c - 1, zero on padding
@@ -120,11 +158,7 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                        preferred_element_type=jnp.float32)
         b = jnp.einsum("bk,bkr->br", (val * mask).astype(cd), Vc,
                        preferred_element_type=jnp.float32)
-    n = mask.sum(axis=-1)                            # ratings per entity
-    reg = lam * jnp.maximum(n, 1.0) if nratings_reg else jnp.full_like(n, lam)
-    eye = jnp.eye(rank, dtype=jnp.float32)
     A = A + reg[:, None, None] * eye
-    from predictionio_tpu.ops.solve import spd_solve
     x = spd_solve(A, b, method=solver, compute_dtype=compute_dtype)
     # padding rows (rows == -1) scatter to a dummy tail row
     safe_rows = jnp.where(rows < 0, factors_out.shape[0] - 1, rows)
@@ -135,11 +169,11 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
-                     "solver"),
+                     "solver", "dual_solve"),
     donate_argnums=(0,))
 def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
                  nratings_reg: bool, implicit: bool, rank: int,
-                 compute_dtype: str, solver: str):
+                 compute_dtype: str, solver: str, dual_solve: str = "auto"):
     """One half-iteration in ONE dispatch: `groups` is a tuple of stacked
     same-shape batch groups (rows [N,B], idx/val/mask [N,B,K]); each group
     is consumed by a `lax.scan` over its leading dim, carrying the donated
@@ -154,7 +188,8 @@ def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
         f = _solve_batch(f, counter_factors, gram, rows, idx, val, mask,
                          lam, alpha, nratings_reg=nratings_reg,
                          implicit=implicit, rank=rank,
-                         compute_dtype=compute_dtype, solver=solver)
+                         compute_dtype=compute_dtype, solver=solver,
+                         dual_solve=dual_solve)
         return f, None
 
     for group in groups:
@@ -222,7 +257,8 @@ def _run_side(device_groups, factors, counter_factors, cfg: ALSConfig,
         factors, counter_factors, gram, device_groups, lam, alpha,
         nratings_reg=(cfg.lambda_scaling == "nratings"),
         implicit=cfg.implicit_prefs, rank=cfg.rank,
-        compute_dtype=cfg.compute_dtype, solver=cfg.solver)
+        compute_dtype=cfg.compute_dtype, solver=cfg.solver,
+        dual_solve=cfg.dual_solve)
 
 
 def als_train(ratings: RatingsCOO, cfg: ALSConfig,

@@ -111,6 +111,26 @@ class TestCGSolve:
             np.linalg.norm(x_true)
         assert rel < 1e-3
 
+    def test_cg_pallas_interpret_dual_shapes(self):
+        """The dual path feeds the kernel [B, K, K] systems with K down to
+        32 — check the kernel math at a representative small K."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from predictionio_tpu.ops import solve as S
+
+        A, rhs, x_true = make_spd(16, 48, 80.0)
+        kernel = functools.partial(S._cg_kernel, iters=56)
+        x = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((16, 48), jnp.float32),
+            interpret=True,
+        )(jnp.asarray(A), jnp.asarray(rhs))
+        rel = np.linalg.norm(np.asarray(x) - x_true) / \
+            np.linalg.norm(x_true)
+        assert rel < 1e-3
+
     def test_als_with_cg_matches_cholesky(self, mesh8):
         from predictionio_tpu.ops.als import ALSConfig, als_rmse, als_train
         from predictionio_tpu.ops.ratings import RatingsCOO
@@ -127,6 +147,44 @@ class TestCGSolve:
         assert abs(als_rmse(m_chol, r) - als_rmse(m_cg, r)) < 5e-3
         np.testing.assert_allclose(m_cg.user_factors, m_chol.user_factors,
                                    rtol=0.05, atol=0.05)
+
+
+class TestDualSolve:
+    def test_dual_matches_primal(self, mesh8):
+        """Woodbury/dual K<rank route produces the same factors as the
+        primal normal equations (exact algebra, so tight tolerance)."""
+        from predictionio_tpu.ops.als import ALSConfig, als_rmse, als_train
+        from predictionio_tpu.ops.ratings import RatingsCOO
+
+        rng = np.random.default_rng(5)
+        n_u, n_i, nnz = 80, 50, 480   # ~6 ratings/user << rank
+        ui = rng.integers(0, n_u, nnz).astype(np.int32)
+        ii = rng.integers(0, n_i, nnz).astype(np.int32)
+        vv = (1 + 4 * rng.random(nnz)).astype(np.float32)
+        r = RatingsCOO(ui, ii, vv, n_u, n_i)
+        kw = dict(rank=24, iterations=4, lam=0.1, seed=2, work_budget=512,
+                  solver="cholesky")
+        m_dual = als_train(r, ALSConfig(dual_solve="auto", **kw), mesh8)
+        m_prim = als_train(r, ALSConfig(dual_solve="never", **kw), mesh8)
+        np.testing.assert_allclose(m_dual.user_factors, m_prim.user_factors,
+                                   rtol=2e-3, atol=2e-4)
+        assert abs(als_rmse(m_dual, r) - als_rmse(m_prim, r)) < 1e-3
+
+    def test_dual_with_cg(self, mesh8):
+        from predictionio_tpu.ops.als import ALSConfig, als_rmse, als_train
+        from predictionio_tpu.ops.ratings import RatingsCOO
+
+        rng = np.random.default_rng(6)
+        n_u, n_i, nnz = 60, 40, 360
+        r = RatingsCOO(rng.integers(0, n_u, nnz).astype(np.int32),
+                       rng.integers(0, n_i, nnz).astype(np.int32),
+                       (1 + 4 * rng.random(nnz)).astype(np.float32),
+                       n_u, n_i)
+        kw = dict(rank=24, iterations=4, lam=0.1, seed=2, work_budget=512)
+        m_cg = als_train(r, ALSConfig(solver="cg", **kw), mesh8)
+        m_ch = als_train(r, ALSConfig(solver="cholesky",
+                                      dual_solve="never", **kw), mesh8)
+        assert abs(als_rmse(m_cg, r) - als_rmse(m_ch, r)) < 5e-3
 
 
 class TestALSWithSchulz:

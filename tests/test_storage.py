@@ -273,6 +273,53 @@ class TestMetadataDAOs:
         assert not dao.delete("m1")
 
 
+class TestRemoteFSModels:
+    def test_round_trip_and_scheme_registry(self, tmp_path):
+        """URI-addressed blob store (HDFS-role backend): file:// works,
+        unknown schemes demand a registered adapter, custom adapters plug
+        in without touching the DAO."""
+        from predictionio_tpu.data.storage import remotefs
+        from predictionio_tpu.data.storage.registry import (
+            StorageClientConfig, StorageError)
+
+        c = remotefs.StorageClient(StorageClientConfig(
+            "RFS", "remotefs", {"URL": f"file://{tmp_path}/blobs"}))
+        dao = c.get_data_object("models", "ns1")
+        dao.insert(Model("inst/1", b"\x00\xffmodel"))
+        assert dao.get("inst/1").models == b"\x00\xffmodel"
+        assert dao.get("nope") is None
+        assert dao.delete("inst/1") and not dao.delete("inst/1")
+        with pytest.raises(StorageError):
+            c.get_data_object("events", "ns1")
+        with pytest.raises(StorageError):
+            remotefs.adapter_for("s3://bucket/path")
+
+        class Mem(remotefs.SchemeAdapter):
+            store: dict = {}
+
+            def read(self, p):
+                return self.store[p]
+
+            def write(self, p, d):
+                self.store[p] = d
+
+            def delete(self, p):
+                return self.store.pop(p, None) is not None
+
+            def exists(self, p):
+                return p in self.store
+
+        remotefs.register_scheme("mem", Mem())
+        try:
+            c2 = remotefs.StorageClient(StorageClientConfig(
+                "MEM", "remotefs", {"URL": "mem://bucket/models"}))
+            d2 = c2.get_data_object("models", "ns")
+            d2.insert(Model("m", b"x"))
+            assert d2.get("m").models == b"x"
+        finally:
+            remotefs._SCHEMES.pop("mem", None)
+
+
 class TestLocalFSModels:
     def test_round_trip(self, tmp_path):
         c = FSClient(StorageClientConfig(
