@@ -168,9 +168,32 @@ class EntityIdIxMap:
         return [str(x) for x in self._ids[np.asarray(ixs, dtype=np.int64)]]
 
     def to_indices(self, entity_ids: Iterable[str]) -> np.ndarray:
-        """Vectorized id->index; unknown ids map to -1."""
+        """id->index per element via dict probes; unknown ids map to -1."""
         return np.array([self._bimap.get(e, -1) for e in entity_ids],
                         dtype=np.int32)
+
+    def to_indices_array(self, ids: np.ndarray) -> np.ndarray:
+        """Vectorized id->index for numpy id arrays (unknowns -> -1):
+        binary search against the inverse table when the map is in sorted
+        order (the ``build``/``build_with_indices`` default), dict probes
+        otherwise."""
+        arr = np.asarray(ids)
+        if arr.dtype == object:
+            arr = arr.astype(str)
+        keys = self._ids.astype(str)
+        if len(keys) == 0 or arr.size == 0:
+            return np.full(arr.shape, -1, dtype=np.int32)
+        sorted_ok = getattr(self, "_sorted_ok", None)
+        if sorted_ok is None:
+            sorted_ok = bool(np.all(keys[:-1] <= keys[1:])) \
+                if len(keys) > 1 else True
+            self._sorted_ok = sorted_ok
+        if not sorted_ok:
+            return self.to_indices(arr.tolist())
+        pos = np.searchsorted(keys, arr)
+        pos_safe = np.clip(pos, 0, len(keys) - 1)
+        hit = keys[pos_safe] == arr
+        return np.where(hit, pos_safe, -1).astype(np.int32)
 
     @property
     def bimap(self) -> BiMap:

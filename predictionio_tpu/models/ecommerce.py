@@ -27,7 +27,8 @@ from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
                                    Params, Preparator, SanityCheck)
 from predictionio_tpu.data.bimap import EntityIdIxMap
 from predictionio_tpu.data.store import LEventStore, PEventStore
-from predictionio_tpu.models.common import (ItemScoreResult, resolve_ids,
+from predictionio_tpu.models.common import (ItemScoreResult, RatingsData,
+                                            resolve_ids,
                                             top_scores_to_result)
 from predictionio_tpu.models.similarproduct import Item
 from predictionio_tpu.ops.als import ALSConfig, als_train
@@ -48,12 +49,18 @@ class RateEvent:
 
 @dataclass
 class TrainingData(SanityCheck):
+    """rate_events is columnar (RatingsData); plain RateEvent row lists
+    are accepted and converted for hand-built fixtures."""
     users: Dict[str, dict]
     items: Dict[str, Item]
-    rate_events: List[RateEvent]
+    rate_events: RatingsData
+
+    def __post_init__(self):
+        if isinstance(self.rate_events, (list, tuple)):
+            self.rate_events = RatingsData.from_rows(self.rate_events)
 
     def sanity_check(self):
-        if not self.rate_events:
+        if not len(self.rate_events):
             raise ValueError("rate_events is empty; check the data source")
 
 
@@ -96,7 +103,6 @@ class ECommerceDataSource(DataSource):
         super().__init__(params or DataSourceParams())
 
     def read_training(self) -> TrainingData:
-        from predictionio_tpu.data.event import to_millis
         app = self.params.app_name
         chan = self.params.channel_name
         users = {eid: dict(pm.fields) for eid, pm in
@@ -109,15 +115,22 @@ class ECommerceDataSource(DataSource):
                 entity_type="item").items():
             cats = pm.get_opt("categories", list)
             items[eid] = Item(tuple(cats) if cats is not None else None)
-        rates = []
-        for e in PEventStore.find(app_name=app, channel_name=chan,
-                                  entity_type="user",
-                                  event_names=list(self.params.rate_events),
-                                  target_entity_type="item"):
-            rating = (e.properties.get("rating", float)
-                      if e.event == "rate" else self.params.buy_rating)
-            rates.append(RateEvent(e.entity_id, e.target_entity_id, rating,
-                                   to_millis(e.event_time)))
+        # columnar ingest: flat arrays, no per-event Python objects
+        rc = PEventStore.find_columnar(
+            app_name=app, channel_name=chan, property_field="rating",
+            entity_type="user", event_names=list(self.params.rate_events),
+            target_entity_type="item")
+        is_rate = rc["event"] == "rate"
+        missing = is_rate & np.isnan(rc["prop"])
+        if missing.any():
+            raise ValueError(
+                f"{int(missing.sum())} 'rate' event(s) lack the required "
+                "'rating' property")
+        vals = np.where(is_rate, rc["prop"],
+                        np.float32(self.params.buy_rating)
+                        ).astype(np.float32)
+        rates = RatingsData(rc["entity_id"], rc["target_entity_id"],
+                            vals, rc["t"])
         return TrainingData(users=users, items=items, rate_events=rates)
 
 
@@ -162,17 +175,15 @@ class ECommAlgorithm(P2LAlgorithm):
     def train(self, pd: PreparedData) -> ECommerceModel:
         td = pd.td
         p = self.params
-        if not td.rate_events:
+        if not len(td.rate_events):
             raise ValueError("No rate events to train on")
-        user_ix = EntityIdIxMap.build(r.user for r in td.rate_events)
+        rd = td.rate_events
+        user_ix, ui = EntityIdIxMap.build_with_indices(rd.users)
         item_ix = EntityIdIxMap.build(list(td.items.keys()) +
-                                      [r.item for r in td.rate_events])
-        ui = user_ix.to_indices([r.user for r in td.rate_events])
-        ii = item_ix.to_indices([r.item for r in td.rate_events])
-        vals = np.array([r.rating for r in td.rate_events], dtype=np.float32)
-        ts = np.array([r.t for r in td.rate_events], dtype=np.int64)
+                                      rd.items.tolist())
+        ii = item_ix.to_indices_array(rd.items)
         # train-with-rate-event: duplicate ratings keep the latest value
-        ui, ii, vals = dedup_ratings(ui, ii, vals, ts, "latest")
+        ui, ii, vals = dedup_ratings(ui, ii, rd.vals, rd.ts, "latest")
         coo = RatingsCOO(ui, ii, vals, len(user_ix), len(item_ix))
         from predictionio_tpu.ops.als import default_compute_dtype
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,

@@ -26,7 +26,7 @@ from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
                                    Params, Preparator, SanityCheck)
 from predictionio_tpu.data.bimap import EntityIdIxMap
 from predictionio_tpu.data.store import PEventStore
-from predictionio_tpu.models.common import resolve_ids
+from predictionio_tpu.models.common import RatingsData, resolve_ids
 from predictionio_tpu.ops.als import ALSConfig, als_train
 from predictionio_tpu.ops.ratings import RatingsCOO, dedup_ratings
 from predictionio_tpu.ops.similarity import (build_filter_mask, cosine_top_k,
@@ -44,11 +44,23 @@ class FollowEvent:
 
 @dataclass
 class TrainingData(SanityCheck):
+    """follow_events is columnar (RatingsData: users=follower,
+    items=followed); FollowEvent row lists are accepted and converted."""
     users: Dict[str, dict]
-    follow_events: List[FollowEvent]
+    follow_events: RatingsData
+
+    def __post_init__(self):
+        if isinstance(self.follow_events, (list, tuple)):
+            self.follow_events = RatingsData(
+                np.array([e.user for e in self.follow_events], dtype=str),
+                np.array([e.followed_user for e in self.follow_events],
+                         dtype=str),
+                np.ones(len(self.follow_events), dtype=np.float32),
+                np.array([e.t for e in self.follow_events],
+                         dtype=np.int64))
 
     def sanity_check(self):
-        if not self.follow_events:
+        if not len(self.follow_events):
             raise ValueError("follow_events is empty; check the data source")
 
 
@@ -103,20 +115,19 @@ class RecommendedUserDataSource(DataSource):
         super().__init__(params or DataSourceParams())
 
     def read_training(self) -> TrainingData:
-        from predictionio_tpu.data.event import to_millis
         app = self.params.app_name
         chan = self.params.channel_name
         users = {eid: dict(pm.fields) for eid, pm in
                  PEventStore.aggregate_properties(
                      app_name=app, channel_name=chan,
                      entity_type="user").items()}
-        follows = []
-        for e in PEventStore.find(app_name=app, channel_name=chan,
-                                  entity_type="user",
-                                  event_names=["follow"],
-                                  target_entity_type="user"):
-            follows.append(FollowEvent(e.entity_id, e.target_entity_id,
-                                       to_millis(e.event_time)))
+        # columnar ingest: flat arrays, no per-event Python objects
+        fc = PEventStore.find_columnar(
+            app_name=app, channel_name=chan, entity_type="user",
+            event_names=["follow"], target_entity_type="user")
+        follows = RatingsData(fc["entity_id"], fc["target_entity_id"],
+                              np.ones(len(fc["t"]), dtype=np.float32),
+                              fc["t"])
         return TrainingData(users=users, follow_events=follows)
 
 
@@ -151,17 +162,12 @@ class RecommendedUserALSAlgorithm(P2LAlgorithm):
     def train(self, pd: PreparedData) -> RecommendedUserModel:
         td = pd.td
         p = self.params
-        if not td.follow_events:
+        if not len(td.follow_events):
             raise ValueError("No follow events to train on")
-        follower_ix = EntityIdIxMap.build(
-            e.user for e in td.follow_events)
-        followed_ix = EntityIdIxMap.build(
-            e.followed_user for e in td.follow_events)
-        ui = follower_ix.to_indices([e.user for e in td.follow_events])
-        ii = followed_ix.to_indices(
-            [e.followed_user for e in td.follow_events])
-        ones = np.ones(len(td.follow_events), dtype=np.float32)
-        ui, ii, counts = dedup_ratings(ui, ii, ones, policy="sum")
+        fd = td.follow_events
+        follower_ix, ui = EntityIdIxMap.build_with_indices(fd.users)
+        followed_ix, ii = EntityIdIxMap.build_with_indices(fd.items)
+        ui, ii, counts = dedup_ratings(ui, ii, fd.vals, policy="sum")
         coo = RatingsCOO(ui, ii, counts, len(follower_ix), len(followed_ix))
         from predictionio_tpu.ops.als import default_compute_dtype
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,

@@ -23,7 +23,8 @@ from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
 from predictionio_tpu.core.persistence import PersistentModel
 from predictionio_tpu.data.bimap import EntityIdIxMap
 from predictionio_tpu.data.store import PEventStore
-from predictionio_tpu.models.common import (ItemScoreResult, resolve_ids,
+from predictionio_tpu.models.common import (ItemScoreResult, RatingsData,
+                                            resolve_ids,
                                             top_scores_to_result)
 from predictionio_tpu.ops.als import ALSConfig, als_train
 from predictionio_tpu.ops.ratings import RatingsCOO, dedup_ratings
@@ -59,13 +60,31 @@ class LikeEvent:
 
 @dataclass
 class TrainingData(SanityCheck):
+    """view_events/like_events are columnar (RatingsData: like=+1,
+    dislike=-1); plain ViewEvent/LikeEvent row lists are accepted and
+    converted for hand-built fixtures."""
     users: Dict[str, dict]
     items: Dict[str, Item]
-    view_events: List[ViewEvent]
-    like_events: List[LikeEvent] = None  # filled when read_like_events on
+    view_events: RatingsData
+    like_events: RatingsData = None  # filled when read_like_events on
+
+    def __post_init__(self):
+        if isinstance(self.view_events, (list, tuple)):
+            self.view_events = RatingsData(
+                np.array([v.user for v in self.view_events], dtype=str),
+                np.array([v.item for v in self.view_events], dtype=str),
+                np.ones(len(self.view_events), dtype=np.float32),
+                np.array([v.t for v in self.view_events], dtype=np.int64))
+        if isinstance(self.like_events, (list, tuple)):
+            self.like_events = RatingsData(
+                np.array([e.user for e in self.like_events], dtype=str),
+                np.array([e.item for e in self.like_events], dtype=str),
+                np.array([1.0 if e.like else -1.0
+                          for e in self.like_events], dtype=np.float32),
+                np.array([e.t for e in self.like_events], dtype=np.int64))
 
     def sanity_check(self):
-        if not self.view_events:
+        if not len(self.view_events):
             raise ValueError("view_events is empty; check the data source")
         if not self.items:
             raise ValueError("items is empty; check the data source")
@@ -138,23 +157,23 @@ class SimilarProductDataSource(DataSource):
                               properties=dict(pm.fields))
         view_names = ["view", "rate"] if self.params.rate_as_view \
             else ["view"]
-        views = []
-        from predictionio_tpu.data.event import to_millis
-        for e in PEventStore.find(app_name=app, channel_name=chan,
-                                  entity_type="user",
-                                  event_names=view_names,
-                                  target_entity_type="item"):
-            views.append(ViewEvent(e.entity_id, e.target_entity_id,
-                                   to_millis(e.event_time)))
-        likes = []
+        # columnar ingest: flat arrays, no per-event Python objects
+        vc = PEventStore.find_columnar(
+            app_name=app, channel_name=chan, entity_type="user",
+            event_names=view_names, target_entity_type="item")
+        views = RatingsData(vc["entity_id"], vc["target_entity_id"],
+                            np.ones(len(vc["t"]), dtype=np.float32),
+                            vc["t"])
+        likes = None
         if self.params.read_like_events:
-            for e in PEventStore.find(app_name=app, channel_name=chan,
-                                      entity_type="user",
-                                      event_names=["like", "dislike"],
-                                      target_entity_type="item"):
-                likes.append(LikeEvent(e.entity_id, e.target_entity_id,
-                                       e.event == "like",
-                                       to_millis(e.event_time)))
+            lc = PEventStore.find_columnar(
+                app_name=app, channel_name=chan, entity_type="user",
+                event_names=["like", "dislike"],
+                target_entity_type="item")
+            likes = RatingsData(
+                lc["entity_id"], lc["target_entity_id"],
+                np.where(lc["event"] == "like", 1.0, -1.0
+                         ).astype(np.float32), lc["t"])
         return TrainingData(users=users, items=items, view_events=views,
                             like_events=likes)
 
@@ -243,15 +262,14 @@ class ALSAlgorithm(P2LAlgorithm):
         """((u,i),1).reduceByKey(_+_) — view counts. Item vocabulary covers
         all $set items (so unseen-in-views items still resolve), users only
         those with views."""
-        if not td.view_events:
+        if not len(td.view_events):
             raise ValueError("No view events to train on")
-        user_ix = EntityIdIxMap.build(v.user for v in td.view_events)
+        views = td.view_events
+        user_ix, ui = EntityIdIxMap.build_with_indices(views.users)
         item_ix = EntityIdIxMap.build(list(td.items.keys()) +
-                                      [v.item for v in td.view_events])
-        ui = user_ix.to_indices([v.user for v in td.view_events])
-        ii = item_ix.to_indices([v.item for v in td.view_events])
-        ones = np.ones(len(td.view_events), dtype=np.float32)
-        ui, ii, counts = dedup_ratings(ui, ii, ones, policy="sum")
+                                      views.items.tolist())
+        ii = item_ix.to_indices_array(views.items)
+        ui, ii, counts = dedup_ratings(ui, ii, views.vals, policy="sum")
         return user_ix, item_ix, RatingsCOO(ui, ii, counts,
                                             len(user_ix), len(item_ix))
 
@@ -347,19 +365,16 @@ class LikeAlgorithm(ALSAlgorithm):
 
     def _build_ratings(self, td: TrainingData
                        ) -> Tuple[EntityIdIxMap, EntityIdIxMap, RatingsCOO]:
-        likes = td.like_events or []
-        if not likes:
+        likes = td.like_events
+        if likes is None or not len(likes):
             raise ValueError("No like/dislike events to train on "
                              "(set read_like_events on the data source)")
-        user_ix = EntityIdIxMap.build(e.user for e in likes)
+        user_ix, ui = EntityIdIxMap.build_with_indices(likes.users)
         item_ix = EntityIdIxMap.build(list(td.items.keys()) +
-                                      [e.item for e in likes])
-        ui = user_ix.to_indices([e.user for e in likes])
-        ii = item_ix.to_indices([e.item for e in likes])
-        vals = np.array([1.0 if e.like else -1.0 for e in likes],
-                        dtype=np.float32)
-        ts = np.array([e.t for e in likes], dtype=np.int64)
-        ui, ii, vals = dedup_ratings(ui, ii, vals, ts, policy="latest")
+                                      likes.items.tolist())
+        ii = item_ix.to_indices_array(likes.items)
+        ui, ii, vals = dedup_ratings(ui, ii, likes.vals, likes.ts,
+                                     policy="latest")
         return user_ix, item_ix, RatingsCOO(ui, ii, vals,
                                             len(user_ix), len(item_ix))
 
@@ -425,13 +440,13 @@ class DIMSUMAlgorithm(P2LAlgorithm):
 
     def train(self, pd: PreparedData) -> DIMSUMModel:
         td = pd.td
-        if not td.view_events:
+        if not len(td.view_events):
             raise ValueError("No view events to train on")
-        user_ix = EntityIdIxMap.build(v.user for v in td.view_events)
+        views = td.view_events
+        user_ix, ui = EntityIdIxMap.build_with_indices(views.users)
         item_ix = EntityIdIxMap.build(list(td.items.keys()) +
-                                      [v.item for v in td.view_events])
-        ui = user_ix.to_indices([v.user for v in td.view_events])
-        ii = item_ix.to_indices([v.item for v in td.view_events])
+                                      views.items.tolist())
+        ii = item_ix.to_indices_array(views.items)
         sims = item_cosine_similarities(
             ui, ii, len(user_ix), len(item_ix),
             threshold=self.params.threshold)
