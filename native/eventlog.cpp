@@ -59,6 +59,9 @@ struct Handle {
   // scan state
   std::vector<const std::string*> scan_keys;
   std::vector<uint8_t> fetch_buf;
+  // bulk-fetch state (el_scan_fetch)
+  std::vector<uint8_t> bulk_data;
+  std::vector<uint64_t> bulk_offsets;
 };
 
 uint64_t fnv1a(const uint8_t* data, size_t len) {
@@ -220,6 +223,57 @@ int64_t el_scan_key(void* vh, int64_t i, const uint8_t** out) {
   const std::string& k = *h->scan_keys[(size_t)i];
   *out = (const uint8_t*)k.data();
   return (int64_t)k.size();
+}
+
+// Bulk-fetch every current scan result's payload with one sequential pass:
+// payloads are concatenated into one buffer with count+1 offsets. One
+// C call replaces count seek+read round trips through the FFI — the bulk
+// training-read path (HBPEvents scan role). Returns total bytes, or -1 on
+// IO error.
+int64_t el_scan_fetch(void* vh) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  h->bulk_data.clear();
+  h->bulk_offsets.clear();
+  h->bulk_offsets.reserve(h->scan_keys.size() + 1);
+  uint64_t total = 0;
+  for (const std::string* k : h->scan_keys) {
+    auto it = h->index.find(*k);
+    if (it == h->index.end() || it->second.deleted) continue;
+    total += it->second.datalen;
+  }
+  h->bulk_data.reserve(total);
+  h->bulk_offsets.push_back(0);
+  for (const std::string* k : h->scan_keys) {
+    auto it = h->index.find(*k);
+    if (it == h->index.end() || it->second.deleted) continue;
+    const IndexEntry& e = it->second;
+    size_t pos = h->bulk_data.size();
+    h->bulk_data.resize(pos + e.datalen);
+    fseeko(h->f, (off_t)(e.offset + sizeof(RecordHeader) + k->size()),
+           SEEK_SET);
+    if (!read_exact(h->f, h->bulk_data.data() + pos, e.datalen)) {
+      fseeko(h->f, 0, SEEK_END);
+      return -1;
+    }
+    h->bulk_offsets.push_back((uint64_t)h->bulk_data.size());
+  }
+  fseeko(h->f, 0, SEEK_END);
+  return (int64_t)h->bulk_data.size();
+}
+
+const uint8_t* el_scan_data(void* vh) {
+  return ((Handle*)vh)->bulk_data.data();
+}
+
+// count+1 offsets into el_scan_data; valid until the next bulk fetch.
+const uint64_t* el_scan_offsets(void* vh) {
+  return ((Handle*)vh)->bulk_offsets.data();
+}
+
+int64_t el_scan_nfetched(void* vh) {
+  Handle* h = (Handle*)vh;
+  return (int64_t)(h->bulk_offsets.empty() ? 0 : h->bulk_offsets.size() - 1);
 }
 
 int64_t el_count(void* vh) {

@@ -21,7 +21,8 @@ import subprocess
 import threading
 from typing import Dict, Optional, Tuple
 
-from predictionio_tpu.data.event import Event, new_event_id, to_millis
+from predictionio_tpu.data.event import (Event, new_event_id,
+                                         parse_event_time, to_millis)
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import ABSENT
 
@@ -73,6 +74,14 @@ def _load_lib():
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
         lib.el_count.restype = ctypes.c_int64
         lib.el_count.argtypes = [ctypes.c_void_p]
+        lib.el_scan_fetch.restype = ctypes.c_int64
+        lib.el_scan_fetch.argtypes = [ctypes.c_void_p]
+        lib.el_scan_data.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.el_scan_data.argtypes = [ctypes.c_void_p]
+        lib.el_scan_offsets.restype = ctypes.POINTER(ctypes.c_uint64)
+        lib.el_scan_offsets.argtypes = [ctypes.c_void_p]
+        lib.el_scan_nfetched.restype = ctypes.c_int64
+        lib.el_scan_nfetched.argtypes = [ctypes.c_void_p]
         _LIB = lib
         return lib
 
@@ -213,14 +222,15 @@ class NativeLogEvents(base.Events):
             return self.lib.el_delete(h, event_id.encode(),
                                       len(event_id.encode())) == 0
 
-    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
-             entity_type=None, entity_id=None, event_names=None,
-             target_entity_type=None, target_entity_id=None, limit=None,
-             reversed_order=False):
+    def _bulk_scan_payloads(self, app_id, channel_id, start_time,
+                            until_time, entity_type, entity_id,
+                            event_names, target_entity_type,
+                            target_entity_id):
+        """Coarse-filtered scan + ONE bulk payload fetch through the FFI
+        (el_scan_fetch); yields raw JSON payload bytes per record."""
         h = self._handle(app_id, channel_id, create=False)
         if h is None:
-            return iter(())
-        # pushed-down coarse filters
+            return []
         entity_hash = 0
         if entity_type is not None and entity_id is not None:
             entity_hash = _hash(self.lib, f"{entity_type}\x00{entity_id}")
@@ -237,28 +247,102 @@ class NativeLogEvents(base.Events):
             arr = None
             n_names = 0
         with self._lock:
-            count = self.lib.el_scan(
+            self.lib.el_scan(
                 h,
                 to_millis(start_time) if start_time else _INT64_MIN,
                 to_millis(until_time) if until_time else _INT64_MIN,
                 entity_hash, arr, n_names, target_hash)
-            events = []
-            for i in range(count):
-                out = ctypes.POINTER(ctypes.c_uint8)()
-                klen = self.lib.el_scan_key(h, i, ctypes.byref(out))
-                if klen < 0:
-                    continue
-                eid = ctypes.string_at(out, klen)
-                e = self._decode(h, eid)
-                if e is None:
-                    continue
-                # exact residual filtering (hash false-positives + partial
-                # predicates the coarse pass cannot express)
-                if base.match_event(e, start_time, until_time, entity_type,
-                                    entity_id, event_names,
-                                    target_entity_type, target_entity_id):
-                    events.append(e)
+            total = self.lib.el_scan_fetch(h)
+            if total < 0:
+                raise IOError("bulk scan fetch failed")
+            n = self.lib.el_scan_nfetched(h)
+            data = ctypes.string_at(self.lib.el_scan_data(h), total)
+            offs = self.lib.el_scan_offsets(h)
+            return [data[offs[i]:offs[i + 1]] for i in range(n)]
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None, limit=None,
+             reversed_order=False):
+        payloads = self._bulk_scan_payloads(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id)
+        events = []
+        for raw in payloads:
+            e = Event.from_dict(json.loads(raw.decode("utf-8")))
+            # exact residual filtering (hash false-positives + partial
+            # predicates the coarse pass cannot express)
+            if base.match_event(e, start_time, until_time, entity_type,
+                                entity_id, event_names,
+                                target_entity_type, target_entity_id):
+                events.append(e)
         events.sort(key=lambda e: e.event_time, reverse=reversed_order)
         if limit is not None and limit >= 0:
             events = events[:limit]
         return iter(events)
+
+    def find_columnar(self, app_id, channel_id=None, property_field=None,
+                      start_time=None, until_time=None, entity_type=None,
+                      entity_id=None, event_names=None,
+                      target_entity_type=None, target_entity_id=None,
+                      limit=None, reversed_order=False):
+        """Columnar ingest: one C++ bulk fetch, then straight from JSON
+        dicts to flat arrays — no Event/DataMap objects (the HBPEvents
+        scan-to-RDD role)."""
+        import numpy as np
+
+        payloads = self._bulk_scan_payloads(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id)
+        ents, tgts, names, ts, props = [], [], [], [], []
+        want_names = set(event_names) if event_names is not None else None
+        for raw in payloads:
+            d = json.loads(raw.decode("utf-8"))
+            # residual exact filters on the raw dict
+            if want_names is not None and d["event"] not in want_names:
+                continue
+            if entity_type is not None and d["entityType"] != entity_type:
+                continue
+            if entity_id is not None and d["entityId"] != entity_id:
+                continue
+            tgt_type = d.get("targetEntityType")
+            if target_entity_type is not None:
+                if target_entity_type is ABSENT:
+                    if tgt_type is not None:
+                        continue
+                elif tgt_type != target_entity_type:
+                    continue
+            tgt_id = d.get("targetEntityId")
+            if target_entity_id is not None:
+                if target_entity_id is ABSENT:
+                    if tgt_id is not None:
+                        continue
+                elif tgt_id != target_entity_id:
+                    continue
+            ents.append(d["entityId"])
+            tgts.append(tgt_id or "")
+            names.append(d["event"])
+            ts.append(to_millis(parse_event_time(d["eventTime"])))
+            if property_field is not None:
+                v = (d.get("properties") or {}).get(property_field)
+                props.append(np.nan if not isinstance(v, (int, float))
+                             or isinstance(v, bool) else float(v))
+        t_arr = np.array(ts, dtype=np.int64)
+        order = np.argsort(t_arr, kind="stable")
+        if reversed_order:
+            order = order[::-1]
+        if limit is not None and limit >= 0:
+            order = order[:limit]
+        out = {
+            "entity_id": np.array(ents, dtype=str)[order]
+            if ents else np.array([], dtype=str),
+            "target_entity_id": np.array(tgts, dtype=str)[order]
+            if tgts else np.array([], dtype=str),
+            "event": np.array(names, dtype=str)[order]
+            if names else np.array([], dtype=str),
+            "t": t_arr[order],
+        }
+        if property_field is not None:
+            out["prop"] = (np.array(props, dtype=np.float32)[order]
+                           if props else np.array([], dtype=np.float32))
+        return out
