@@ -448,9 +448,36 @@ def measure_d2h_floor_ms() -> dict:
     return out
 
 
+def device_alive(timeout_s: float = 240.0):
+    """Watchdog: the tunneled chip can hang indefinitely (observed: even
+    an 8-float device_put blocks forever when the tunnel is down). Probe
+    backend init + one device round trip in a daemon thread; on timeout
+    the caller emits an error line instead of hanging the driver."""
+    import threading
+    result = []
+
+    def probe():
+        import jax
+        backend = jax.default_backend()
+        x = jax.device_put(np.arange(8, dtype=np.float32))
+        float(np.asarray(x * 2)[3])
+        result.append(backend)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return result[0] if result else None
+
+
 def main():
-    import jax
-    backend = jax.default_backend()
+    backend = device_alive()
+    if backend is None:
+        print(json.dumps({
+            "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
+            "value": 0, "unit": "ratings/s/chip", "vs_baseline": 0,
+            "error": "device unreachable: backend init / device round trip "
+                     "did not complete within 240s (tunnel down?)"}))
+        os._exit(1)
     full_scale = backend not in ("cpu",)
     als_stats, model = bench_als(full_scale)
     rest_stats = bench_rest_latency(model)
