@@ -21,6 +21,7 @@
 // Concurrency: one mutex per handle; scan state is per-handle (the Python
 // wrapper serializes scans per handle).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -62,6 +63,13 @@ struct Handle {
   // bulk-fetch state (el_scan_fetch)
   std::vector<uint8_t> bulk_data;
   std::vector<uint64_t> bulk_offsets;
+  // columnar state (el_scan_columnar)
+  std::vector<int64_t> col_ts;
+  std::string col_entity, col_target, col_event, col_etype, col_ttype;
+  std::vector<uint64_t> col_entity_off, col_target_off, col_event_off,
+      col_etype_off, col_ttype_off;
+  std::vector<double> col_prop;
+  std::vector<uint8_t> col_fallback;  // 1 = record needs python json parse
 };
 
 uint64_t fnv1a(const uint8_t* data, size_t len) {
@@ -274,6 +282,210 @@ const uint64_t* el_scan_offsets(void* vh) {
 int64_t el_scan_nfetched(void* vh) {
   Handle* h = (Handle*)vh;
   return (int64_t)(h->bulk_offsets.empty() ? 0 : h->bulk_offsets.size() - 1);
+}
+
+namespace {
+
+// Extract the string value of top-level `"key":"..."` from a JSON payload
+// WE wrote (data/storage/nativelog.py serializes Event.to_dict with
+// compact separators, string keys in a known shape). Returns false when
+// the key is absent or the value contains escapes / isn't a plain string
+// — the caller then marks the record for exact Python parsing, so this
+// fast path never has to be a general JSON parser to stay correct.
+bool extract_string(const char* p, size_t n, const char* key,
+                    const char** out, size_t* out_len, bool* present) {
+  std::string pat = std::string("\"") + key + "\":";
+  const char* end = p + n;
+  const char* hit =
+      (const char*)memmem(p, n, pat.data(), pat.size());
+  if (!hit) { *present = false; return true; }
+  *present = true;
+  const char* v = hit + pat.size();
+  if (v >= end) return false;
+  if (*v != '"') {
+    if (end - v >= 4 && memcmp(v, "null", 4) == 0) {
+      *present = false;
+      return true;
+    }
+    return false;  // non-string value
+  }
+  v++;
+  const char* q = v;
+  while (q < end && *q != '"') {
+    if (*q == '\\') return false;  // escapes -> python fallback
+    q++;
+  }
+  if (q >= end) return false;
+  *out = v;
+  *out_len = (size_t)(q - v);
+  return true;
+}
+
+// Extract numeric `"key":<number>` inside the "properties" object.
+bool extract_prop_number(const char* p, size_t n, const char* key,
+                         double* out, bool* present) {
+  const char* props =
+      (const char*)memmem(p, n, "\"properties\":{", 14);
+  if (!props) { *present = false; return true; }
+  std::string pat = std::string("\"") + key + "\":";
+  const char* end = p + n;
+  const char* hit = (const char*)memmem(
+      props, (size_t)(end - props), pat.data(), pat.size());
+  if (!hit) { *present = false; return true; }
+  const char* v = hit + pat.size();
+  if (v >= end) return false;
+  if (*v == '"' || *v == '{' || *v == '[' || *v == 't' || *v == 'f') {
+    return false;  // non-number -> python decides coercion semantics
+  }
+  if (end - v >= 4 && memcmp(v, "null", 4) == 0) {
+    *present = false;
+    return true;
+  }
+  char* num_end = nullptr;
+  std::string tmp(v, std::min<size_t>(64, (size_t)(end - v)));
+  double d = strtod(tmp.c_str(), &num_end);
+  if (num_end == tmp.c_str()) return false;
+  *out = d;
+  *present = true;
+  return true;
+}
+
+}  // namespace
+
+// Columnar extraction over the current scan results, C-side: event time
+// comes from the record header (no parse at all); entityId /
+// targetEntityId / event come from a targeted scan of our own JSON
+// serialization; `prop_name` (optional, may be null) is pulled from the
+// properties object as a double (NaN when absent). Records the fast
+// scanner cannot handle exactly (escaped strings, exotic value types)
+// get flag=1 and are re-parsed in Python — correctness never depends on
+// the fast path. Returns the record count, or -1 on IO error.
+int64_t el_scan_columnar(void* vh, const char* prop_name) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  h->col_ts.clear();
+  h->col_entity.clear();
+  h->col_target.clear();
+  h->col_event.clear();
+  h->col_etype.clear();
+  h->col_ttype.clear();
+  h->col_entity_off.assign(1, 0);
+  h->col_target_off.assign(1, 0);
+  h->col_event_off.assign(1, 0);
+  h->col_etype_off.assign(1, 0);
+  h->col_ttype_off.assign(1, 0);
+  h->col_prop.clear();
+  h->col_fallback.clear();
+  std::vector<uint8_t> buf;
+  for (const std::string* k : h->scan_keys) {
+    auto it = h->index.find(*k);
+    if (it == h->index.end() || it->second.deleted) continue;
+    const IndexEntry& e = it->second;
+    buf.resize(e.datalen);
+    fseeko(h->f, (off_t)(e.offset + sizeof(RecordHeader) + k->size()),
+           SEEK_SET);
+    if (!read_exact(h->f, buf.data(), e.datalen)) {
+      fseeko(h->f, 0, SEEK_END);
+      return -1;
+    }
+    const char* p = (const char*)buf.data();
+    const char* s = nullptr;
+    size_t sl = 0;
+    bool present = false;
+    bool ok = true;
+    uint8_t fallback = 0;
+    double prop = 0.0 / 0.0;  // NaN
+
+    ok = extract_string(p, e.datalen, "entityId", &s, &sl, &present);
+    if (ok && present) h->col_entity.append(s, sl);
+    else if (!ok) fallback = 1;
+
+    if (!fallback) {
+      ok = extract_string(p, e.datalen, "targetEntityId", &s, &sl,
+                          &present);
+      if (ok && present) h->col_target.append(s, sl);
+      else if (!ok) fallback = 1;
+    }
+    if (!fallback) {
+      ok = extract_string(p, e.datalen, "event", &s, &sl, &present);
+      if (ok && present) h->col_event.append(s, sl);
+      else fallback = 1;  // event is mandatory
+    }
+    if (!fallback) {
+      ok = extract_string(p, e.datalen, "entityType", &s, &sl, &present);
+      if (ok && present) h->col_etype.append(s, sl);
+      else fallback = 1;  // entityType is mandatory
+    }
+    if (!fallback) {
+      ok = extract_string(p, e.datalen, "targetEntityType", &s, &sl,
+                          &present);
+      if (ok && present) h->col_ttype.append(s, sl);
+      else if (!ok) fallback = 1;
+    }
+    if (!fallback && prop_name && prop_name[0]) {
+      double d;
+      ok = extract_prop_number(p, e.datalen, prop_name, &d, &present);
+      if (!ok) fallback = 1;
+      else if (present) prop = d;
+    }
+    if (fallback) {
+      // keep offsets consistent: no bytes appended for this record
+      h->col_entity.resize(h->col_entity_off.back());
+      h->col_target.resize(h->col_target_off.back());
+      h->col_event.resize(h->col_event_off.back());
+      h->col_etype.resize(h->col_etype_off.back());
+      h->col_ttype.resize(h->col_ttype_off.back());
+      prop = 0.0 / 0.0;
+    }
+    h->col_ts.push_back(e.ts);
+    h->col_entity_off.push_back((uint64_t)h->col_entity.size());
+    h->col_target_off.push_back((uint64_t)h->col_target.size());
+    h->col_event_off.push_back((uint64_t)h->col_event.size());
+    h->col_etype_off.push_back((uint64_t)h->col_etype.size());
+    h->col_ttype_off.push_back((uint64_t)h->col_ttype.size());
+    h->col_prop.push_back(prop);
+    h->col_fallback.push_back(fallback);
+  }
+  fseeko(h->f, 0, SEEK_END);
+  return (int64_t)h->col_ts.size();
+}
+
+const int64_t* el_col_ts(void* vh) { return ((Handle*)vh)->col_ts.data(); }
+const char* el_col_entity(void* vh) {
+  return ((Handle*)vh)->col_entity.data();
+}
+const uint64_t* el_col_entity_off(void* vh) {
+  return ((Handle*)vh)->col_entity_off.data();
+}
+const char* el_col_target(void* vh) {
+  return ((Handle*)vh)->col_target.data();
+}
+const uint64_t* el_col_target_off(void* vh) {
+  return ((Handle*)vh)->col_target_off.data();
+}
+const char* el_col_event(void* vh) {
+  return ((Handle*)vh)->col_event.data();
+}
+const uint64_t* el_col_event_off(void* vh) {
+  return ((Handle*)vh)->col_event_off.data();
+}
+const char* el_col_etype(void* vh) {
+  return ((Handle*)vh)->col_etype.data();
+}
+const uint64_t* el_col_etype_off(void* vh) {
+  return ((Handle*)vh)->col_etype_off.data();
+}
+const char* el_col_ttype(void* vh) {
+  return ((Handle*)vh)->col_ttype.data();
+}
+const uint64_t* el_col_ttype_off(void* vh) {
+  return ((Handle*)vh)->col_ttype_off.data();
+}
+const double* el_col_prop(void* vh) {
+  return ((Handle*)vh)->col_prop.data();
+}
+const uint8_t* el_col_fallback(void* vh) {
+  return ((Handle*)vh)->col_fallback.data();
 }
 
 int64_t el_count(void* vh) {

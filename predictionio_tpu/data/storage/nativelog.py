@@ -21,8 +21,7 @@ import subprocess
 import threading
 from typing import Dict, Optional, Tuple
 
-from predictionio_tpu.data.event import (Event, new_event_id,
-                                         parse_event_time, to_millis)
+from predictionio_tpu.data.event import Event, new_event_id, to_millis
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import ABSENT
 
@@ -82,6 +81,32 @@ def _load_lib():
         lib.el_scan_offsets.argtypes = [ctypes.c_void_p]
         lib.el_scan_nfetched.restype = ctypes.c_int64
         lib.el_scan_nfetched.argtypes = [ctypes.c_void_p]
+        lib.el_scan_columnar.restype = ctypes.c_int64
+        lib.el_scan_columnar.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        # string buffers are NOT NUL-terminated: keep them as raw
+        # pointers (c_void_p) and slice with explicit lengths
+        for name, ty in (("el_col_ts", ctypes.POINTER(ctypes.c_int64)),
+                         ("el_col_entity", ctypes.c_void_p),
+                         ("el_col_entity_off",
+                          ctypes.POINTER(ctypes.c_uint64)),
+                         ("el_col_target", ctypes.c_void_p),
+                         ("el_col_target_off",
+                          ctypes.POINTER(ctypes.c_uint64)),
+                         ("el_col_event", ctypes.c_void_p),
+                         ("el_col_event_off",
+                          ctypes.POINTER(ctypes.c_uint64)),
+                         ("el_col_etype", ctypes.c_void_p),
+                         ("el_col_etype_off",
+                          ctypes.POINTER(ctypes.c_uint64)),
+                         ("el_col_ttype", ctypes.c_void_p),
+                         ("el_col_ttype_off",
+                          ctypes.POINTER(ctypes.c_uint64)),
+                         ("el_col_prop", ctypes.POINTER(ctypes.c_double)),
+                         ("el_col_fallback",
+                          ctypes.POINTER(ctypes.c_uint8))):
+            fn = getattr(lib, name)
+            fn.restype = ty
+            fn.argtypes = [ctypes.c_void_p]
         _LIB = lib
         return lib
 
@@ -222,15 +247,10 @@ class NativeLogEvents(base.Events):
             return self.lib.el_delete(h, event_id.encode(),
                                       len(event_id.encode())) == 0
 
-    def _bulk_scan_payloads(self, app_id, channel_id, start_time,
-                            until_time, entity_type, entity_id,
-                            event_names, target_entity_type,
-                            target_entity_id):
-        """Coarse-filtered scan + ONE bulk payload fetch through the FFI
-        (el_scan_fetch); yields raw JSON payload bytes per record."""
-        h = self._handle(app_id, channel_id, create=False)
-        if h is None:
-            return []
+    def _coarse_scan(self, h, start_time, until_time, entity_type,
+                     entity_id, event_names, target_entity_type,
+                     target_entity_id) -> int:
+        """Push the coarse predicates down to C (caller holds _lock)."""
         entity_hash = 0
         if entity_type is not None and entity_id is not None:
             entity_hash = _hash(self.lib, f"{entity_type}\x00{entity_id}")
@@ -246,12 +266,25 @@ class NativeLogEvents(base.Events):
         else:
             arr = None
             n_names = 0
+        return self.lib.el_scan(
+            h,
+            to_millis(start_time) if start_time else _INT64_MIN,
+            to_millis(until_time) if until_time else _INT64_MIN,
+            entity_hash, arr, n_names, target_hash)
+
+    def _bulk_scan_payloads(self, app_id, channel_id, start_time,
+                            until_time, entity_type, entity_id,
+                            event_names, target_entity_type,
+                            target_entity_id):
+        """Coarse-filtered scan + ONE bulk payload fetch through the FFI
+        (el_scan_fetch); yields raw JSON payload bytes per record."""
+        h = self._handle(app_id, channel_id, create=False)
+        if h is None:
+            return []
         with self._lock:
-            self.lib.el_scan(
-                h,
-                to_millis(start_time) if start_time else _INT64_MIN,
-                to_millis(until_time) if until_time else _INT64_MIN,
-                entity_hash, arr, n_names, target_hash)
+            self._coarse_scan(h, start_time, until_time, entity_type,
+                              entity_id, event_names, target_entity_type,
+                              target_entity_id)
             total = self.lib.el_scan_fetch(h)
             if total < 0:
                 raise IOError("bulk scan fetch failed")
@@ -281,68 +314,116 @@ class NativeLogEvents(base.Events):
             events = events[:limit]
         return iter(events)
 
+    @staticmethod
+    def _split(buf: bytes, offs, n):
+        s = buf.decode("utf-8")
+        # offsets are byte offsets; our ids are overwhelmingly ASCII — for
+        # multi-byte content fall back to per-record byte slicing
+        if len(s) == len(buf):
+            return [s[offs[i]:offs[i + 1]] for i in range(n)]
+        return [buf[offs[i]:offs[i + 1]].decode("utf-8") for i in range(n)]
+
     def find_columnar(self, app_id, channel_id=None, property_field=None,
                       start_time=None, until_time=None, entity_type=None,
                       entity_id=None, event_names=None,
                       target_entity_type=None, target_entity_id=None,
                       limit=None, reversed_order=False):
-        """Columnar ingest: one C++ bulk fetch, then straight from JSON
-        dicts to flat arrays — no Event/DataMap objects (the HBPEvents
-        scan-to-RDD role)."""
+        """Columnar ingest, C-side extraction: event times come from the
+        record headers, string fields and the numeric property from the
+        native scanner (el_scan_columnar) — zero JSON parsing on the fast
+        path. Records the scanner can't handle exactly (escapes, exotic
+        types) are flagged and re-parsed here, so correctness never
+        depends on the fast path (the HBPEvents scan-to-RDD role)."""
         import numpy as np
 
-        payloads = self._bulk_scan_payloads(
-            app_id, channel_id, start_time, until_time, entity_type,
-            entity_id, event_names, target_entity_type, target_entity_id)
-        ents, tgts, names, ts, props = [], [], [], [], []
-        want_names = set(event_names) if event_names is not None else None
-        for raw in payloads:
-            d = json.loads(raw.decode("utf-8"))
-            # residual exact filters on the raw dict
-            if want_names is not None and d["event"] not in want_names:
-                continue
-            if entity_type is not None and d["entityType"] != entity_type:
-                continue
-            if entity_id is not None and d["entityId"] != entity_id:
-                continue
-            tgt_type = d.get("targetEntityType")
-            if target_entity_type is not None:
-                if target_entity_type is ABSENT:
-                    if tgt_type is not None:
-                        continue
-                elif tgt_type != target_entity_type:
+        h = self._handle(app_id, channel_id, create=False)
+        empty = {"entity_id": np.array([], dtype=str),
+                 "target_entity_id": np.array([], dtype=str),
+                 "event": np.array([], dtype=str),
+                 "t": np.array([], dtype=np.int64)}
+        if property_field is not None:
+            empty["prop"] = np.array([], dtype=np.float32)
+        if h is None:
+            return empty
+        with self._lock:
+            self._coarse_scan(h, start_time, until_time, entity_type,
+                              entity_id, event_names, target_entity_type,
+                              target_entity_id)
+            n = self.lib.el_scan_columnar(
+                h, (property_field or "").encode("utf-8"))
+            if n < 0:
+                raise IOError("columnar scan failed")
+            if n == 0:
+                return empty
+            ts = np.ctypeslib.as_array(self.lib.el_col_ts(h), (n,)).copy()
+            prop = np.ctypeslib.as_array(
+                self.lib.el_col_prop(h), (n,)).astype(np.float32)
+            flags = np.ctypeslib.as_array(
+                self.lib.el_col_fallback(h), (n,)).copy()
+
+            def col(data_fn, off_fn):
+                offs = off_fn(h)
+                total = offs[n]
+                buf = ctypes.string_at(data_fn(h), total) if total else b""
+                return self._split(buf, offs, n)
+
+            ents = col(self.lib.el_col_entity, self.lib.el_col_entity_off)
+            tgts = col(self.lib.el_col_target, self.lib.el_col_target_off)
+            names = col(self.lib.el_col_event, self.lib.el_col_event_off)
+            etypes = col(self.lib.el_col_etype, self.lib.el_col_etype_off)
+            ttypes = col(self.lib.el_col_ttype, self.lib.el_col_ttype_off)
+
+            # exact fallback for flagged records (escaped strings etc.)
+            for i in np.nonzero(flags)[0]:
+                out = ctypes.POINTER(ctypes.c_uint8)()
+                klen = self.lib.el_scan_key(h, int(i), ctypes.byref(out))
+                if klen < 0:
                     continue
-            tgt_id = d.get("targetEntityId")
-            if target_entity_id is not None:
-                if target_entity_id is ABSENT:
-                    if tgt_id is not None:
-                        continue
-                elif tgt_id != target_entity_id:
+                m = self.lib.el_get(h, ctypes.string_at(out, klen), klen)
+                if m < 0:
                     continue
-            ents.append(d["entityId"])
-            tgts.append(tgt_id or "")
-            names.append(d["event"])
-            ts.append(to_millis(parse_event_time(d["eventTime"])))
-            if property_field is not None:
-                v = (d.get("properties") or {}).get(property_field)
-                props.append(np.nan if not isinstance(v, (int, float))
-                             or isinstance(v, bool) else float(v))
-        t_arr = np.array(ts, dtype=np.int64)
-        order = np.argsort(t_arr, kind="stable")
+                d = json.loads(
+                    ctypes.string_at(self.lib.el_buf(h), m).decode("utf-8"))
+                ents[i] = d.get("entityId", "")
+                tgts[i] = d.get("targetEntityId") or ""
+                names[i] = d["event"]
+                etypes[i] = d.get("entityType", "")
+                ttypes[i] = d.get("targetEntityType") or ""
+                if property_field is not None:
+                    v = (d.get("properties") or {}).get(property_field)
+                    prop[i] = (np.nan
+                               if not isinstance(v, (int, float))
+                               or isinstance(v, bool) else float(v))
+
+        ents = np.array(ents, dtype=str)
+        tgts = np.array(tgts, dtype=str)
+        names = np.array(names, dtype=str)
+        etypes = np.array(etypes, dtype=str)
+        ttypes = np.array(ttypes, dtype=str)
+        # residual exact filters, vectorized (hash false-positives +
+        # predicates the coarse pass cannot express; '' == absent)
+        keep = np.ones(n, dtype=bool)
+        if event_names is not None:
+            keep &= np.isin(names, list(event_names))
+        if entity_type is not None:
+            keep &= etypes == entity_type
+        if entity_id is not None:
+            keep &= ents == entity_id
+        if target_entity_type is not None:
+            keep &= ((ttypes == "") if target_entity_type is ABSENT
+                     else (ttypes == target_entity_type))
+        if target_entity_id is not None:
+            keep &= ((tgts == "") if target_entity_id is ABSENT
+                     else (tgts == target_entity_id))
+        order = np.argsort(ts[keep], kind="stable")
         if reversed_order:
             order = order[::-1]
         if limit is not None and limit >= 0:
             order = order[:limit]
-        out = {
-            "entity_id": np.array(ents, dtype=str)[order]
-            if ents else np.array([], dtype=str),
-            "target_entity_id": np.array(tgts, dtype=str)[order]
-            if tgts else np.array([], dtype=str),
-            "event": np.array(names, dtype=str)[order]
-            if names else np.array([], dtype=str),
-            "t": t_arr[order],
-        }
+        out = {"entity_id": ents[keep][order],
+               "target_entity_id": tgts[keep][order],
+               "event": names[keep][order],
+               "t": ts[keep][order]}
         if property_field is not None:
-            out["prop"] = (np.array(props, dtype=np.float32)[order]
-                           if props else np.array([], dtype=np.float32))
+            out["prop"] = prop[keep][order]
         return out

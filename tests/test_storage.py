@@ -171,6 +171,21 @@ class TestEventsFind:
         # no property requested -> no prop column
         assert "prop" not in self.ev.find_columnar(1, entity_type="user")
 
+    def test_find_columnar_escaped_strings(self):
+        """Ids/values the fast extractors can't scan (escapes, unicode,
+        string-typed numbers) must still come back exact — the nativelog
+        C path flags them for Python re-parse."""
+        import numpy as np
+        self.ev.insert(mk("rate", 'u"q\\uote', 6, target_entity_type="item",
+                          target_entity_id="ié中",
+                          properties=DataMap({"rating": 2})), 1)
+        cols = self.ev.find_columnar(1, property_field="rating",
+                                     event_names=["rate"])
+        assert 'u"q\\uote' in list(cols["entity_id"])
+        assert "ié中" in list(cols["target_entity_id"])
+        row = list(cols["entity_id"]).index('u"q\\uote')
+        assert cols["prop"][row] == pytest.approx(2.0)
+
     def test_aggregate_properties_via_store(self):
         self.ev.insert(mk("$unset", "u1", 5,
                           properties=DataMap({"a": None})), 1)
