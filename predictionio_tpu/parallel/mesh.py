@@ -29,8 +29,23 @@ logger = logging.getLogger(__name__)
 _local = threading.local()
 
 
+_compile_cache_set = False
+
+
 def _jax():
     import jax
+    # persistent XLA compilation cache: warmup compiles are paid once per
+    # machine (env vars may be latched before we run — sitecustomize
+    # imports jax at interpreter start — so go through jax.config)
+    global _compile_cache_set
+    if not _compile_cache_set:
+        _compile_cache_set = True
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                   "/tmp/pio_tpu_xla_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except Exception:
+            logger.debug("compilation cache dir not set", exc_info=True)
     return jax
 
 
