@@ -62,9 +62,12 @@ def als_iteration_flops(user_plan, item_plan, rank: int) -> float:
     return total
 
 # persistent XLA compilation cache: warmup compiles are paid once per
-# machine, not per run
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/tmp/pio_tpu_xla_cache")
+# machine, not per run (shared config with the product CLI)
+import sys as _sys  # noqa: E402
+
+_sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from predictionio_tpu.parallel.mesh import \
+    configure_compilation_cache  # noqa: E402
 
 
 def synthetic_ml20m(n_users, n_items, nnz, seed=0):
@@ -106,12 +109,7 @@ def bench_als(full_scale: bool):
     ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
     gen_s = time.perf_counter() - t0
 
-    try:
-        from jax.experimental.compilation_cache import compilation_cache
-        compilation_cache.set_cache_dir(
-            os.environ["JAX_COMPILATION_CACHE_DIR"])
-    except Exception:
-        pass
+    configure_compilation_cache()
 
     mesh = current_mesh()
     from predictionio_tpu.ops.solve import resolve_solver

@@ -29,23 +29,39 @@ logger = logging.getLogger(__name__)
 _local = threading.local()
 
 
+# per-user default (a shared predictable /tmp path would allow cross-user
+# cache poisoning); JAX_COMPILATION_CACHE_DIR overrides
+DEFAULT_COMPILE_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "pio_tpu", "xla")
+_compile_cache_lock = threading.Lock()
 _compile_cache_set = False
+
+
+def configure_compilation_cache() -> None:
+    """Point jax at the persistent compilation cache so warmup compiles
+    are paid once per machine. Called at CLI process init and again
+    lazily from _jax() (env vars may be latched before we run —
+    sitecustomize imports jax at interpreter start — so this goes through
+    jax.config). Safe to call repeatedly/concurrently."""
+    global _compile_cache_set
+    with _compile_cache_lock:
+        if _compile_cache_set:
+            return
+        import jax
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                   DEFAULT_COMPILE_CACHE_DIR)
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            _compile_cache_set = True
+        except Exception:
+            logger.debug("compilation cache dir not set", exc_info=True)
+            _compile_cache_set = True
 
 
 def _jax():
     import jax
-    # persistent XLA compilation cache: warmup compiles are paid once per
-    # machine (env vars may be latched before we run — sitecustomize
-    # imports jax at interpreter start — so go through jax.config)
-    global _compile_cache_set
     if not _compile_cache_set:
-        _compile_cache_set = True
-        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                   "/tmp/pio_tpu_xla_cache")
-        try:
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-        except Exception:
-            logger.debug("compilation cache dir not set", exc_info=True)
+        configure_compilation_cache()
     return jax
 
 
