@@ -54,6 +54,11 @@ class ALSConfig:
     seed: int = 0
     work_budget: int = 1 << 20         # B*K per solve batch
     compute_dtype: str = "float32"     # einsum dtype ('bfloat16' on TPU ok)
+    factor_dtype: str = "float32"      # HBM storage dtype of factor tables
+    # 'bfloat16' halves the per-iteration gather traffic (the dominant HBM
+    # cost once solves are fast); solves still build/solve f32 normal
+    # equations from the gathered rows, so per-iteration quality loss is
+    # bounded by bf16 rounding of the carried factors.
     solver: str = "auto"  # see ops/solve.py spd_solve
     # auto = VMEM-resident CG Pallas kernel on TPU (XLA's batched cholesky
     # runs at ~0.05% MXU there), LAPACK cholesky on CPU.
@@ -293,10 +298,12 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
     else:
         put_factors = mesh.put_replicated
         row_multiple = 1
+    fdt = np.dtype(cfg.factor_dtype) if cfg.factor_dtype != "bfloat16" \
+        else __import__("jax").numpy.bfloat16
     U = put_factors(_init_factors(ratings.n_users, cfg.rank, cfg.seed, 1,
-                                  row_multiple))
+                                  row_multiple).astype(fdt))
     V = put_factors(_init_factors(ratings.n_items, cfg.rank, cfg.seed, 2,
-                                  row_multiple))
+                                  row_multiple).astype(fdt))
     user_batches = _upload_plan(mesh, user_plan)
     item_batches = _upload_plan(mesh, item_plan)
     # hyperparameters ride along as device-resident scalars: no per-call
@@ -317,8 +324,8 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
         gather = __import__("jax").jit(lambda a: jnp.asarray(a),
                                        out_shardings=mesh.replicated())
         U, V = gather(U), gather(V)
-    U_host = host_fetch(U)[:ratings.n_users]
-    V_host = host_fetch(V)[:ratings.n_items]
+    U_host = host_fetch(U)[:ratings.n_users].astype(np.float32, copy=False)
+    V_host = host_fetch(V)[:ratings.n_items].astype(np.float32, copy=False)
     return ALSModel(user_factors=U_host, item_factors=V_host, rank=cfg.rank)
 
 

@@ -187,6 +187,27 @@ class TestDualSolve:
         assert abs(als_rmse(m_cg, r) - als_rmse(m_ch, r)) < 5e-3
 
 
+class TestBF16FactorStorage:
+    def test_bf16_tables_match_f32_quality(self, mesh8):
+        """factor_dtype='bfloat16' halves gather traffic; RMSE must stay
+        within bf16 rounding of the f32-stored run."""
+        from predictionio_tpu.ops.als import ALSConfig, als_rmse, als_train
+        from predictionio_tpu.ops.ratings import RatingsCOO
+
+        rng = np.random.default_rng(9)
+        n_u, n_i, nnz = 60, 40, 700
+        r = RatingsCOO(rng.integers(0, n_u, nnz).astype(np.int32),
+                       rng.integers(0, n_i, nnz).astype(np.int32),
+                       (1 + 4 * rng.random(nnz)).astype(np.float32),
+                       n_u, n_i)
+        kw = dict(rank=8, iterations=5, lam=0.1, seed=2, work_budget=512)
+        m32 = als_train(r, ALSConfig(factor_dtype="float32", **kw), mesh8)
+        m16 = als_train(r, ALSConfig(factor_dtype="bfloat16", **kw), mesh8)
+        assert m16.user_factors.dtype == np.float32  # host copy upcast
+        rmse32, rmse16 = als_rmse(m32, r), als_rmse(m16, r)
+        assert abs(rmse32 - rmse16) < 0.02, (rmse32, rmse16)
+
+
 class TestALSWithSchulz:
     def test_als_factors_match_across_solvers(self, mesh8):
         """als_train(solver='schulz') ~ als_train(solver='cholesky'):
