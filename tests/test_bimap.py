@@ -51,6 +51,44 @@ class TestEntityIdIxMap:
         assert [a.id_of(i) for i in range(3)] == [b.id_of(i) for i in range(3)]
 
 
+class TestVectorizedBuild:
+    def test_build_with_indices_matches_build(self):
+        ids = np.array(["z9", "a1", "m5", "a1", "z9", "b2"], dtype=str)
+        m, ix = EntityIdIxMap.build_with_indices(ids)
+        ref = EntityIdIxMap.build(ids.tolist())
+        assert len(m) == 4
+        assert [m.id_of(i) for i in range(4)] == \
+            [ref.id_of(i) for i in range(4)]
+        np.testing.assert_array_equal(ix, ref.to_indices(ids.tolist()))
+        assert ix.dtype == np.int32
+
+    def test_build_with_indices_object_dtype(self):
+        m, ix = EntityIdIxMap.build_with_indices(
+            np.array(["x", "y", "x"], dtype=object))
+        assert len(m) == 2 and list(ix) == [1, 0, 1] or list(ix) == [0, 1, 0]
+        # sorted order: x < y
+        assert m.id_of(0) == "x" and list(ix) == [0, 1, 0]
+
+    def test_to_indices_array_sorted_and_unknowns(self):
+        m = EntityIdIxMap.build(["u1", "u3", "u2"])
+        got = m.to_indices_array(np.array(["u2", "zz", "u1", "aa"]))
+        np.testing.assert_array_equal(
+            got, m.to_indices(["u2", "zz", "u1", "aa"]))
+        assert got[1] == -1 and got[3] == -1
+
+    def test_to_indices_array_unsorted_map_fallback(self):
+        from predictionio_tpu.data.bimap import BiMap
+        m = EntityIdIxMap(BiMap({"zz": 0, "aa": 1}))  # NOT sorted order
+        got = m.to_indices_array(np.array(["aa", "zz", "nn"]))
+        np.testing.assert_array_equal(got, [1, 0, -1])
+
+    def test_to_indices_array_empty(self):
+        m = EntityIdIxMap.build(["u1"])
+        assert m.to_indices_array(np.array([], dtype=str)).size == 0
+        m0, ix0 = EntityIdIxMap.build_with_indices(np.array([], dtype=str))
+        assert len(m0) == 0 and ix0.size == 0
+
+
 class TestEntityMap:
     def test_access_by_id_and_index(self):
         em = EntityMap({"u1": 10, "u2": 20})
