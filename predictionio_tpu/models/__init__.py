@@ -5,7 +5,7 @@ BASELINE.json, rebuilt TPU-native (reference: examples/scala-parallel-*).
 resolves against (the reflection analog of WorkflowUtils.getEngine).
 """
 
-from typing import Dict, Type
+from typing import Dict
 
 
 def _registry() -> Dict[str, type]:
