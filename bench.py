@@ -499,7 +499,83 @@ def main():
     print(json.dumps(out))
 
 
+def solver_ablation():
+    """Reproduce the solver ablation table (docs/benchmarks.md): time one
+    full ML-20M iteration per solver configuration on the current
+    backend. Run: python bench.py --ablation"""
+    import jax
+    from predictionio_tpu.ops import als as A
+    from predictionio_tpu.ops.als import ALSConfig
+    from predictionio_tpu.ops.ratings import (RatingsCOO, plan_for_items,
+                                              plan_for_users)
+    from predictionio_tpu.parallel.mesh import current_mesh
+
+    full = jax.default_backend() not in ("cpu",)
+    if full:
+        n_users, n_items, nnz, rank = 138_493, 26_744, 20_000_000, 200
+        configs = [
+            ("cholesky primal", dict(solver="cholesky",
+                                     dual_solve="never")),
+            ("cg_pallas primal", dict(solver="cg_pallas",
+                                      dual_solve="never")),
+            ("cg_pallas + dual", dict(solver="cg_pallas",
+                                      dual_solve="auto")),
+            ("cg_pallas + dual + bf16 tables",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  factor_dtype="bfloat16")),
+        ]
+    else:
+        n_users, n_items, nnz, rank = 2_000, 500, 60_000, 32
+        configs = [
+            ("cholesky primal", dict(solver="cholesky",
+                                     dual_solve="never")),
+            ("cg + dual", dict(solver="cg", dual_solve="auto")),
+        ]
+    ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
+    ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
+    mesh = current_mesh()
+    user_plan = plan_for_users(ratings, work_budget=1 << 20)
+    item_plan = plan_for_items(ratings, work_budget=1 << 20)
+    user_batches = A._upload_plan(mesh, user_plan)
+    item_batches = A._upload_plan(mesh, item_plan)
+    lam = mesh.put_replicated(np.float32(0.05))
+    alpha = mesh.put_replicated(np.float32(1.0))
+    for name, kw in configs:
+        cfg = ALSConfig(rank=rank, iterations=1, lam=0.05, seed=1,
+                        compute_dtype=("bfloat16" if full else "float32"),
+                        work_budget=(1 << 20), **kw)
+        fdt = cfg.factor_dtype
+        import jax.numpy as jnp
+        dt = jnp.bfloat16 if fdt == "bfloat16" else np.float32
+        U = mesh.put_replicated(
+            A._init_factors(n_users, rank, 1, 1).astype(dt))
+        V = mesh.put_replicated(
+            A._init_factors(n_items, rank, 1, 2).astype(dt))
+        try:
+            # warmup (compile)
+            U = A._run_side(user_batches, U, V, cfg, None, lam, alpha)
+            V = A._run_side(item_batches, V, U, cfg, None, lam, alpha)
+            float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])
+            t0 = time.perf_counter()
+            for _ in range(2):
+                U = A._run_side(user_batches, U, V, cfg, None, lam, alpha)
+                V = A._run_side(item_batches, V, U, cfg, None, lam, alpha)
+            float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])
+            dt_s = (time.perf_counter() - t0) / 2
+            print(f"{name:34s}: {dt_s * 1000:9.1f} ms/iteration "
+                  f"({nnz / dt_s / 1e6:8.2f} M ratings/s)", flush=True)
+        except Exception as e:
+            print(f"{name:34s}: FAILED {type(e).__name__}: {e}",
+                  flush=True)
+
+
 if __name__ == "__main__":
+    if "--ablation" in sys.argv:
+        if device_alive() is None:
+            print("device unreachable")
+            raise SystemExit(1)
+        solver_ablation()
+        raise SystemExit(0)
     try:
         main()
     except Exception as e:  # emit a parseable line even on env failure
