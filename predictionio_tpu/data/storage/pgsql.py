@@ -35,6 +35,7 @@ from predictionio_tpu.data.storage.base import (ABSENT, AccessKey, App,
                                                 EvaluationInstance, Model)
 from predictionio_tpu.data.storage.pgwire import (UNIQUE_VIOLATION,
                                                   PGConnection, PGError,
+                                                  PGProtocolError,
                                                   connect_from_env)
 
 
@@ -51,23 +52,38 @@ def _unhex_bytea(v: str) -> bytes:
 class StorageClient:
     def __init__(self, config, conn: Optional[PGConnection] = None):
         self.config = config
-        if conn is not None:
-            self.conn = conn
-        else:
-            self.conn = connect_from_env(
-                config.get("URL"),
-                host=config.get("HOST"),
-                port=_maybe_int(config.get("PORT")),
-                user=config.get("USERNAME"),
-                password=config.get("PASSWORD"),
-                dbname=config.get("DBNAME"))
+        self._explicit_conn = conn is not None
+        self.conn = conn if conn is not None else self._connect()
         self._objects = {}
 
+    def _connect(self) -> PGConnection:
+        config = self.config
+        return connect_from_env(
+            config.get("URL"),
+            host=config.get("HOST"),
+            port=_maybe_int(config.get("PORT")),
+            user=config.get("USERNAME"),
+            password=config.get("PASSWORD"),
+            dbname=config.get("DBNAME"))
+
     def execute(self, sql, params=()):
-        return self.conn.execute(sql, params)
+        """One transparent reconnect on transport failure (a dropped
+        server connection must not permanently poison the backend; server
+        errors — PGError — propagate untouched)."""
+        try:
+            return self.conn.execute(sql, params)
+        except (OSError, PGProtocolError):
+            if self._explicit_conn:
+                raise
+            try:
+                self.conn.close()
+            except Exception:
+                pass
+            self.conn = self._connect()
+            return self.conn.execute(sql, params)
 
     def query(self, sql, params=()):
-        return self.conn.execute(sql, params).rows
+        return self.execute(sql, params).rows
 
     def get_data_object(self, kind: str, namespace: str):
         key = f"{namespace}/{kind}"

@@ -278,6 +278,55 @@ class TestWireProtocol:
         assert b"42" in bind
 
 
+class TestReconnect:
+    def test_transport_failure_triggers_one_reconnect(self):
+        """A dropped server connection must not poison the DAO client:
+        StorageClient.execute reconnects once and retries."""
+        from predictionio_tpu.data.storage.pgsql import StorageClient
+        from predictionio_tpu.data.storage.registry import \
+            StorageClientConfig
+
+        def handler_die_after_auth(w):
+            w.read_startup()
+            w.auth_ok_and_ready()
+            # read the first extended-query round, then drop the socket
+            t, _ = w.read_message()
+            assert t == b"P"
+            w.conn.close()
+
+        def handler_serve(w):
+            w.read_startup()
+            w.auth_ok_and_ready()
+            serve_extended_query(w, [("7",)])
+            w.read_message()  # Terminate
+
+        srv1 = FakePGServer(handler_die_after_auth)
+        srv1.start()
+        # same port for the reconnect: serve a second listener after the
+        # first dies
+        conn = PGConnection(port=srv1.port, user="u", password="",
+                            dbname="db")
+        srv2 = FakePGServer(handler_serve)
+        # rebind on a fresh port; point the client config there
+        srv2.start()
+        cfg = StorageClientConfig("PG", "pgsql",
+                                  {"URL": f"postgresql://u@127.0.0.1:"
+                                          f"{srv2.port}/db"})
+        # build a client around the first (dying) connection but with a
+        # config that reconnects to the live server
+        client = StorageClient.__new__(StorageClient)
+        client.config = cfg
+        client._explicit_conn = False
+        client.conn = conn
+        client._objects = {}
+        res = client.execute("SELECT x FROM t")
+        assert res.rows == [("7",)]
+        client.close()
+        srv1.join(5)
+        srv2.join(5)
+        assert srv1.error is None and srv2.error is None
+
+
 # -- real-server spec (env-gated) -------------------------------------------
 
 PG_URL = os.environ.get("PIO_TEST_PG_URL")
