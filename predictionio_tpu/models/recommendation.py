@@ -21,6 +21,8 @@ from predictionio_tpu.core import (DataSource, Engine, EngineFactory,
                                    P2LAlgorithm, Params, Preparator,
                                    SanityCheck)
 from predictionio_tpu.data.bimap import EntityIdIxMap
+from predictionio_tpu.core.persistence import (PersistentModel,
+                                               PersistentModelLoader)
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.models.common import (ItemScoreResult, RatingsData,
                                             top_scores_to_result)
@@ -374,15 +376,63 @@ class ALSAlgorithm(P2LAlgorithm):
         return list(out.items())
 
 
+class ShardedALSModelCheckpoint(PersistentModel, PersistentModelLoader):
+    """Persistence mode 2 for the mesh model: factor tables checkpoint
+    through orbax/tensorstore (each host writes its shards; restore
+    re-shards on read) instead of being gathered into a pickle — the
+    TPU-native replacement for the reference's 'persist the model RDD'
+    pattern (controller/PersistentModel.scala:64; SURVEY §5
+    checkpoint/resume). Only a manifest naming this loader is stored in
+    MODELDATA."""
+
+    def __init__(self, model: Optional[RecommendationModel] = None):
+        self.model = model
+
+    def save(self, instance_id: str, params) -> bool:
+        import os
+        from predictionio_tpu.utils.checkpoint import (checkpoint_dir,
+                                                       save_sharded)
+        d = checkpoint_dir(instance_id)
+        ok = save_sharded(
+            os.path.join(d, "factors"),
+            {"user_factors": self.model.als.user_factors,
+             "item_factors": self.model.als.item_factors})
+        np.savez(os.path.join(d, "vocab.npz"),
+                 users=np.asarray(self.model.user_ix._ids, dtype=str),
+                 items=np.asarray(self.model.item_ix._ids, dtype=str))
+        return ok
+
+    def load(self, instance_id: str, params) -> "RecommendationModel":
+        import os
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.utils.checkpoint import (checkpoint_dir,
+                                                       restore_sharded)
+        d = checkpoint_dir(instance_id)
+        arrays = restore_sharded(os.path.join(d, "factors"))
+        with np.load(os.path.join(d, "vocab.npz")) as z:
+            user_ix = EntityIdIxMap(BiMap(
+                {str(u): i for i, u in enumerate(z["users"])}))
+            item_ix = EntityIdIxMap(BiMap(
+                {str(it): i for i, it in enumerate(z["items"])}))
+        uf = np.asarray(arrays["user_factors"], dtype=np.float32)
+        vf = np.asarray(arrays["item_factors"], dtype=np.float32)
+        als = ALSModel(user_factors=uf, item_factors=vf,
+                       rank=uf.shape[1])
+        return RecommendationModel(als, user_ix, item_ix)
+
+
 class MeshALSAlgorithm(ALSAlgorithm):
     """P-placement variant: factor tables are trained AND SERVED
     model-sharded across the mesh — nothing is ever replicated to one
     device, so catalogs larger than a single chip's HBM serve directly
     (reference: controller/PAlgorithm.scala:44-125 distributed-model
     lookup; enable with algorithm name 'als-mesh' in engine.json).
-    Persistence follows the PAlgorithm default: sharded models retrain on
-    deploy (core/base.py make_persistent_model)."""
+    Persistence: sharded checkpoint + manifest (ShardedALSModelCheckpoint)
+    instead of the PAlgorithm retrain-on-deploy default."""
     placement = "mesh"
+
+    def make_persistent_model(self, model: RecommendationModel):
+        return ShardedALSModelCheckpoint(model)
 
     def train(self, pd: PreparedData) -> RecommendationModel:
         p = self.params

@@ -102,6 +102,42 @@ class TestShardedServe:
             res = algo.predict(model, R.Query(user="u3", num=3))
             assert len(res.item_scores) == 3
             assert all(s.item.startswith("i") for s in res.item_scores)
-            # sharded model defaults to retrain-on-deploy persistence
-            from predictionio_tpu.core.persistence import RETRAIN
-            assert algo.make_persistent_model(model) is RETRAIN
+            # sharded model persists via the sharded-checkpoint manifest
+            from predictionio_tpu.core.persistence import PersistentModel
+            assert isinstance(algo.make_persistent_model(model),
+                              PersistentModel)
+
+    def test_sharded_checkpoint_round_trip(self, tmp_path, monkeypatch):
+        """ShardedALSModelCheckpoint: save -> manifest -> load restores a
+        model that predicts identically, without retraining."""
+        import numpy as np
+
+        from predictionio_tpu.core.persistence import (
+            PersistentModelManifest, load_persistent_model)
+        from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap
+        from predictionio_tpu.models import recommendation as R
+        from predictionio_tpu.ops.als import ALSModel
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        rng = np.random.default_rng(2)
+        als = ALSModel(rng.standard_normal((12, 4)).astype(np.float32),
+                       rng.standard_normal((9, 4)).astype(np.float32), 4)
+        model = R.RecommendationModel(
+            als,
+            EntityIdIxMap(BiMap({f"u{i}": i for i in range(12)})),
+            EntityIdIxMap(BiMap({f"i{i}": i for i in range(9)})))
+        ckpt = R.ShardedALSModelCheckpoint(model)
+        assert ckpt.save("inst42", None)
+        manifest = PersistentModelManifest(type(ckpt).loader_name())
+        restored = load_persistent_model(manifest, "inst42", None)
+        np.testing.assert_allclose(restored.als.user_factors,
+                                   als.user_factors, rtol=1e-6)
+        np.testing.assert_allclose(restored.als.item_factors,
+                                   als.item_factors, rtol=1e-6)
+        assert restored.user_ix["u7"] == 7
+        assert restored.item_ix.id_of(3) == "i3"
+        algo = R.MeshALSAlgorithm(R.ALSAlgorithmParams(rank=4))
+        a = algo.predict(model, R.Query(user="u1", num=3))
+        b = algo.predict(restored, R.Query(user="u1", num=3))
+        assert [s.item for s in a.item_scores] == \
+            [s.item for s in b.item_scores]
