@@ -10,6 +10,20 @@ and applies the exact residual filters.
 
 Configure with PIO_STORAGE_SOURCES_<S>_TYPE=nativelog and _PATH=<dir>;
 one log file per (app, channel) namespace, like HBase's table-per-channel.
+
+PIO_STORAGE_SOURCES_<S>_PARTITIONS=N (default 1) hash-partitions each
+(app, channel) namespace into N shard files by entity key — the analog of
+HBase's md5(entity)-prefixed rowkeys spreading one table across regions
+(reference: data/src/main/scala/io/prediction/data/storage/hbase/
+HBEventsUtil.scala:81-129). Entity-scoped reads route to exactly one
+shard; full scans fan out across shards in parallel threads (the C
+library holds one mutex per handle and ctypes releases the GIL, so
+shard scans overlap on real cores). A pre-partitioning (unpartitioned)
+legacy log file is transparently included in reads, so partitioning an
+existing store loses nothing; the shard count itself is recorded in a
+PARTITIONS marker file and a mismatched configuration is refused
+(hash % P routing against files written under a different P would
+silently miss records).
 """
 
 from __future__ import annotations
@@ -19,7 +33,8 @@ import json
 import os
 import subprocess
 import threading
-from typing import Dict, Optional, Tuple
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event, new_event_id, to_millis
 from predictionio_tpu.data.storage import base
@@ -125,6 +140,7 @@ class StorageClient:
         self.path = (config.get("PATH") or config.get("HOSTS")
                      or os.path.join(os.path.expanduser("~/.pio_store"),
                                      "eventlog"))
+        self.partitions = max(1, int(config.get("PARTITIONS") or 1))
         os.makedirs(self.path, exist_ok=True)
         self.lib = _load_lib()
         self._objects = {}
@@ -135,7 +151,8 @@ class StorageClient:
                 f"nativelog backend only stores events, not {kind}")
         if namespace not in self._objects:
             self._objects[namespace] = NativeLogEvents(
-                self.lib, os.path.join(self.path, namespace))
+                self.lib, os.path.join(self.path, namespace),
+                partitions=self.partitions)
         return self._objects[namespace]
 
     def close(self):
@@ -144,52 +161,159 @@ class StorageClient:
         self._objects.clear()
 
 
+_LEGACY = -1  # partition index of a pre-partitioning single log file
+
+
 class NativeLogEvents(base.Events):
-    def __init__(self, lib, root: str):
+    def __init__(self, lib, root: str, partitions: int = 1):
         self.lib = lib
         self.root = root
+        self.partitions = max(1, partitions)
         os.makedirs(root, exist_ok=True)
-        self._handles: Dict[Tuple[int, Optional[int]], int] = {}
+        # The shard layout is a property of the data on disk: record it in
+        # a marker file and refuse a mismatched configuration (hash % P
+        # routing against files written under a different P would silently
+        # miss records). Unmarked (pre-partitioning) stores may be
+        # upgraded to any P — the legacy file stays in every read path.
+        marker = os.path.join(root, "PARTITIONS")
+        if os.path.exists(marker):
+            with open(marker) as f:
+                disk = int(f.read().strip() or 1)
+            if disk != self.partitions:
+                raise ValueError(
+                    f"event log at {root} was written with "
+                    f"PARTITIONS={disk} but is configured with "
+                    f"{self.partitions}; set "
+                    f"PIO_STORAGE_SOURCES_<S>_PARTITIONS={disk} or "
+                    f"re-shard via pio export/import")
+        elif self.partitions > 1:
+            with open(marker, "w") as f:
+                f.write(str(self.partitions))
+        # key = (app_id, channel_id, partition); one C handle + one Python
+        # lock per partition file — scans on different partitions overlap
+        # (the C mutex is per handle; ctypes drops the GIL during calls).
+        # Lock discipline: self._lock (handle-map mutation) may be held
+        # while acquiring a per-handle lock, never the reverse; every C
+        # call happens under the handle's lock, and close/remove take that
+        # lock before el_close, so a handle is never freed mid-call. Ops
+        # re-check the map after acquiring the lock (`_handles.get(key) is
+        # h`) to catch a close/remove that won the race.
+        self._handles: Dict[Tuple[int, Optional[int], int], int] = {}
+        self._hlocks: Dict[Tuple[int, Optional[int], int],
+                           threading.RLock] = {}
         self._lock = threading.RLock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._closed = False
 
-    def _handle(self, app_id: int, channel_id: Optional[int],
-                create: bool = True) -> Optional[int]:
-        key = (app_id, channel_id)
+    def _path_of(self, app_id: int, channel_id: Optional[int],
+                 part: int) -> str:
+        stem = f"events_{app_id}_{channel_id or 0}"
+        if part == _LEGACY or self.partitions == 1:
+            return os.path.join(self.root, f"{stem}.log")
+        return os.path.join(self.root, f"{stem}_p{part}.log")
+
+    def _handle_of(self, app_id: int, channel_id: Optional[int], part: int,
+                   create: bool = True):
+        key = (app_id, channel_id, part)
         with self._lock:
             if key not in self._handles:
-                path = os.path.join(
-                    self.root,
-                    f"events_{app_id}_{channel_id or 0}.log")
+                path = self._path_of(app_id, channel_id, part)
                 if not create and not os.path.exists(path):
-                    return None
+                    return None, None
                 h = self.lib.el_open(path.encode())
                 if not h:
                     raise IOError(f"cannot open event log {path}")
                 self._handles[key] = h
-            return self._handles[key]
+                self._hlocks[key] = threading.RLock()
+            return self._handles[key], self._hlocks[key]
+
+    def _write_part(self, event: Event) -> int:
+        if self.partitions == 1:
+            return 0
+        return _hash(self.lib, self._entity_key(event)) % self.partitions
+
+    def _read_handles(self, app_id, channel_id, entity_type=None,
+                      entity_id=None) -> List[tuple]:
+        """(key, handle, lock) triples a read must consult. A fully-
+        specified entity routes to its hash shard (HBase rowkey-prefix
+        locality); otherwise every shard. A legacy unpartitioned file, if
+        present, is always included so raising PARTITIONS is lossless."""
+        if self.partitions == 1:
+            parts = [0]
+        elif entity_type is not None and entity_id is not None:
+            parts = [_hash(self.lib, f"{entity_type}\x00{entity_id}")
+                     % self.partitions, _LEGACY]
+        else:
+            parts = list(range(self.partitions)) + [_LEGACY]
+        out = []
+        for p in parts:
+            h, lk = self._handle_of(app_id, channel_id, p, create=False)
+            if h is not None:
+                out.append(((app_id, channel_id, p), h, lk))
+        return out
+
+    def _stale(self, key, h) -> bool:
+        """True when a concurrent close()/remove() freed this handle
+        between our map lookup and lock acquisition (caller holds the
+        handle lock, so a non-stale handle cannot be freed under us)."""
+        return self._handles.get(key) is not h
+
+    def _parallel(self, fns):
+        """Run one scan callable per partition, in parallel when >1.
+        Degrades to serial execution when close() races the pool away —
+        the per-callable stale-handle checks then return empty results,
+        matching the other op paths' behavior on a closed store."""
+        if len(fns) <= 1:
+            return [f() for f in fns]
+        with self._lock:
+            if self._pool is None and not self._closed:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=min(16, os.cpu_count() or 4),
+                    thread_name_prefix="nativelog-scan")
+            pool = self._pool
+        if pool is None:
+            return [f() for f in fns]
+        try:
+            return list(pool.map(lambda f: f(), fns))
+        except RuntimeError:           # pool shut down between grab and map
+            return [f() for f in fns]
 
     def close(self):
         with self._lock:
-            for h in self._handles.values():
-                self.lib.el_close(h)
+            self._closed = True
+            pool, self._pool = self._pool, None
+            items = [(k, h, self._hlocks[k])
+                     for k, h in self._handles.items()]
             self._handles.clear()
+            self._hlocks.clear()
+        if pool is not None:
+            pool.shutdown(wait=True)   # drain in-flight shard scans
+        for _, h, lk in items:
+            with lk:                   # in-flight C calls finish first
+                self.lib.el_close(h)
 
     # -- Events interface ---------------------------------------------------
     def init(self, app_id, channel_id=None) -> bool:
-        self._handle(app_id, channel_id)
+        for p in range(self.partitions):
+            self._handle_of(app_id, channel_id, p)
         return True
 
     def remove(self, app_id, channel_id=None) -> bool:
-        key = (app_id, channel_id)
+        removed = False
+        parts = list(range(self.partitions)) + [_LEGACY]
         with self._lock:
-            if key in self._handles:
-                self.lib.el_close(self._handles.pop(key))
-            path = os.path.join(
-                self.root, f"events_{app_id}_{channel_id or 0}.log")
-            if os.path.exists(path):
-                os.remove(path)
-                return True
-            return False
+            for p in parts:
+                key = (app_id, channel_id, p)
+                if key in self._handles:
+                    h = self._handles.pop(key)
+                    lk = self._hlocks.pop(key)
+                    with lk:           # in-flight C calls finish first
+                        self.lib.el_close(h)
+                path = self._path_of(app_id, channel_id, p)
+                if os.path.exists(path):
+                    os.remove(path)
+                    removed = True
+        return removed
 
     @staticmethod
     def _entity_key(e: Event) -> str:
@@ -202,28 +326,38 @@ class NativeLogEvents(base.Events):
         return f"{e.target_entity_type}\x00{e.target_entity_id}"
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
-        h = self._handle(app_id, channel_id)
+        part = self._write_part(event)
+        hkey = (app_id, channel_id, part)
         eid = event.event_id or new_event_id()
         payload = json.dumps(
             event.with_id(eid).to_dict(), separators=(",", ":")
         ).encode("utf-8")
         key = eid.encode("utf-8")
         target = self._target_key(event)
-        rc = self.lib.el_append(
-            h, key, len(key), payload, len(payload),
-            to_millis(event.event_time),
-            _hash(self.lib, self._entity_key(event)),
-            _hash(self.lib, event.event),
-            _hash(self.lib, target) if target else 0)
-        if rc != 0:
-            raise IOError("append failed")
-        return eid
+        while True:
+            h, lk = self._handle_of(app_id, channel_id, part)
+            with lk:
+                if self._stale(hkey, h):
+                    continue           # lost a race with remove(): reopen
+                rc = self.lib.el_append(
+                    h, key, len(key), payload, len(payload),
+                    to_millis(event.event_time),
+                    _hash(self.lib, self._entity_key(event)),
+                    _hash(self.lib, event.event),
+                    _hash(self.lib, target) if target else 0)
+            if rc != 0:
+                raise IOError("append failed")
+            return eid
 
     def insert_batch(self, events, app_id, channel_id=None):
-        with self._lock:
-            eids = [self.insert(e, app_id, channel_id) for e in events]
-            self.lib.el_flush(self._handle(app_id, channel_id))
-            return eids
+        eids = [self.insert(e, app_id, channel_id) for e in events]
+        for p in range(self.partitions):
+            h, lk = self._handle_of(app_id, channel_id, p, create=False)
+            if h is not None:
+                with lk:
+                    if not self._stale((app_id, channel_id, p), h):
+                        self.lib.el_flush(h)
+        return eids
 
     def _decode(self, h, eid_bytes: bytes) -> Optional[Event]:
         n = self.lib.el_get(h, eid_bytes, len(eid_bytes))
@@ -233,24 +367,33 @@ class NativeLogEvents(base.Events):
         return Event.from_dict(json.loads(buf.decode("utf-8")))
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
-        h = self._handle(app_id, channel_id, create=False)
-        if h is None:
-            return None
-        with self._lock:
-            return self._decode(h, event_id.encode("utf-8"))
+        # event ids carry no partition information: probe each shard
+        # (P is small; the id index makes each probe O(1))
+        for hkey, h, lk in self._read_handles(app_id, channel_id):
+            with lk:
+                if self._stale(hkey, h):
+                    continue
+                e = self._decode(h, event_id.encode("utf-8"))
+            if e is not None:
+                return e
+        return None
 
     def delete(self, event_id, app_id, channel_id=None) -> bool:
-        h = self._handle(app_id, channel_id, create=False)
-        if h is None:
-            return False
-        with self._lock:
-            return self.lib.el_delete(h, event_id.encode(),
-                                      len(event_id.encode())) == 0
+        key = event_id.encode()
+        for hkey, h, lk in self._read_handles(app_id, channel_id):
+            with lk:
+                if self._stale(hkey, h):
+                    continue
+                if self.lib.el_delete(h, key, len(key)) == 0:
+                    return True
+        return False
 
     def _coarse_scan(self, h, start_time, until_time, entity_type,
                      entity_id, event_names, target_entity_type,
                      target_entity_id) -> int:
-        """Push the coarse predicates down to C (caller holds _lock)."""
+        """Push the coarse predicates down to C (caller holds the
+        handle's per-handle lock — NOT self._lock; scan state is
+        per-handle and concurrent scans on other handles may run)."""
         entity_hash = 0
         if entity_type is not None and entity_id is not None:
             entity_hash = _hash(self.lib, f"{entity_type}\x00{entity_id}")
@@ -277,21 +420,31 @@ class NativeLogEvents(base.Events):
                             event_names, target_entity_type,
                             target_entity_id):
         """Coarse-filtered scan + ONE bulk payload fetch through the FFI
-        (el_scan_fetch); yields raw JSON payload bytes per record."""
-        h = self._handle(app_id, channel_id, create=False)
-        if h is None:
-            return []
-        with self._lock:
-            self._coarse_scan(h, start_time, until_time, entity_type,
-                              entity_id, event_names, target_entity_type,
-                              target_entity_id)
-            total = self.lib.el_scan_fetch(h)
-            if total < 0:
-                raise IOError("bulk scan fetch failed")
-            n = self.lib.el_scan_nfetched(h)
-            data = ctypes.string_at(self.lib.el_scan_data(h), total)
-            offs = self.lib.el_scan_offsets(h)
-            return [data[offs[i]:offs[i + 1]] for i in range(n)]
+        per partition (el_scan_fetch), shards scanned in parallel; returns
+        raw JSON payload bytes per record."""
+        def one(hkey, h, lk):
+            with lk:
+                if self._stale(hkey, h):
+                    return []          # store removed mid-read
+                self._coarse_scan(h, start_time, until_time, entity_type,
+                                  entity_id, event_names,
+                                  target_entity_type, target_entity_id)
+                total = self.lib.el_scan_fetch(h)
+                if total < 0:
+                    raise IOError("bulk scan fetch failed")
+                n = self.lib.el_scan_nfetched(h)
+                data = ctypes.string_at(self.lib.el_scan_data(h), total)
+                offs = self.lib.el_scan_offsets(h)
+                return [data[offs[i]:offs[i + 1]] for i in range(n)]
+
+        handles = self._read_handles(app_id, channel_id, entity_type,
+                                     entity_id)
+        payloads = []
+        for chunk in self._parallel(
+                [lambda k=k, h=h, lk=lk: one(k, h, lk)
+                 for k, h, lk in handles]):
+            payloads.extend(chunk)
+        return payloads
 
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
@@ -336,70 +489,96 @@ class NativeLogEvents(base.Events):
         depends on the fast path (the HBPEvents scan-to-RDD role)."""
         import numpy as np
 
-        h = self._handle(app_id, channel_id, create=False)
         empty = {"entity_id": np.array([], dtype=str),
                  "target_entity_id": np.array([], dtype=str),
                  "event": np.array([], dtype=str),
                  "t": np.array([], dtype=np.int64)}
         if property_field is not None:
             empty["prop"] = np.array([], dtype=np.float32)
-        if h is None:
+
+        def one(hkey, h, lk):
+            """Columnar extraction of one shard (own lock: shard scans
+            run concurrently; all scan state is per-handle)."""
+            with lk:
+                if self._stale(hkey, h):
+                    return None        # store removed mid-read
+                self._coarse_scan(h, start_time, until_time, entity_type,
+                                  entity_id, event_names,
+                                  target_entity_type, target_entity_id)
+                n = self.lib.el_scan_columnar(
+                    h, (property_field or "").encode("utf-8"))
+                if n < 0:
+                    raise IOError("columnar scan failed")
+                if n == 0:
+                    return None
+                ts = np.ctypeslib.as_array(
+                    self.lib.el_col_ts(h), (n,)).copy()
+                prop = np.ctypeslib.as_array(
+                    self.lib.el_col_prop(h), (n,)).astype(np.float32)
+                flags = np.ctypeslib.as_array(
+                    self.lib.el_col_fallback(h), (n,)).copy()
+
+                def col(data_fn, off_fn):
+                    offs = off_fn(h)
+                    total = offs[n]
+                    buf = (ctypes.string_at(data_fn(h), total)
+                           if total else b"")
+                    return self._split(buf, offs, n)
+
+                ents = col(self.lib.el_col_entity,
+                           self.lib.el_col_entity_off)
+                tgts = col(self.lib.el_col_target,
+                           self.lib.el_col_target_off)
+                names = col(self.lib.el_col_event,
+                            self.lib.el_col_event_off)
+                etypes = col(self.lib.el_col_etype,
+                             self.lib.el_col_etype_off)
+                ttypes = col(self.lib.el_col_ttype,
+                             self.lib.el_col_ttype_off)
+
+                # exact fallback for flagged records (escaped strings etc.)
+                for i in np.nonzero(flags)[0]:
+                    out = ctypes.POINTER(ctypes.c_uint8)()
+                    klen = self.lib.el_scan_key(h, int(i),
+                                                ctypes.byref(out))
+                    if klen < 0:
+                        continue
+                    m = self.lib.el_get(h, ctypes.string_at(out, klen),
+                                        klen)
+                    if m < 0:
+                        continue
+                    d = json.loads(ctypes.string_at(
+                        self.lib.el_buf(h), m).decode("utf-8"))
+                    ents[i] = d.get("entityId", "")
+                    tgts[i] = d.get("targetEntityId") or ""
+                    names[i] = d["event"]
+                    etypes[i] = d.get("entityType", "")
+                    ttypes[i] = d.get("targetEntityType") or ""
+                    if property_field is not None:
+                        v = (d.get("properties") or {}).get(property_field)
+                        prop[i] = (np.nan
+                                   if not isinstance(v, (int, float))
+                                   or isinstance(v, bool) else float(v))
+                return ents, tgts, names, etypes, ttypes, ts, prop
+
+        handles = self._read_handles(app_id, channel_id, entity_type,
+                                     entity_id)
+        shards = [s for s in self._parallel(
+            [lambda k=k, h=h, lk=lk: one(k, h, lk)
+             for k, h, lk in handles])
+            if s is not None]
+        if not shards:
             return empty
-        with self._lock:
-            self._coarse_scan(h, start_time, until_time, entity_type,
-                              entity_id, event_names, target_entity_type,
-                              target_entity_id)
-            n = self.lib.el_scan_columnar(
-                h, (property_field or "").encode("utf-8"))
-            if n < 0:
-                raise IOError("columnar scan failed")
-            if n == 0:
-                return empty
-            ts = np.ctypeslib.as_array(self.lib.el_col_ts(h), (n,)).copy()
-            prop = np.ctypeslib.as_array(
-                self.lib.el_col_prop(h), (n,)).astype(np.float32)
-            flags = np.ctypeslib.as_array(
-                self.lib.el_col_fallback(h), (n,)).copy()
+        from itertools import chain
 
-            def col(data_fn, off_fn):
-                offs = off_fn(h)
-                total = offs[n]
-                buf = ctypes.string_at(data_fn(h), total) if total else b""
-                return self._split(buf, offs, n)
+        def cat(i):
+            return np.array(list(chain.from_iterable(s[i] for s in shards)),
+                            dtype=str)
 
-            ents = col(self.lib.el_col_entity, self.lib.el_col_entity_off)
-            tgts = col(self.lib.el_col_target, self.lib.el_col_target_off)
-            names = col(self.lib.el_col_event, self.lib.el_col_event_off)
-            etypes = col(self.lib.el_col_etype, self.lib.el_col_etype_off)
-            ttypes = col(self.lib.el_col_ttype, self.lib.el_col_ttype_off)
-
-            # exact fallback for flagged records (escaped strings etc.)
-            for i in np.nonzero(flags)[0]:
-                out = ctypes.POINTER(ctypes.c_uint8)()
-                klen = self.lib.el_scan_key(h, int(i), ctypes.byref(out))
-                if klen < 0:
-                    continue
-                m = self.lib.el_get(h, ctypes.string_at(out, klen), klen)
-                if m < 0:
-                    continue
-                d = json.loads(
-                    ctypes.string_at(self.lib.el_buf(h), m).decode("utf-8"))
-                ents[i] = d.get("entityId", "")
-                tgts[i] = d.get("targetEntityId") or ""
-                names[i] = d["event"]
-                etypes[i] = d.get("entityType", "")
-                ttypes[i] = d.get("targetEntityType") or ""
-                if property_field is not None:
-                    v = (d.get("properties") or {}).get(property_field)
-                    prop[i] = (np.nan
-                               if not isinstance(v, (int, float))
-                               or isinstance(v, bool) else float(v))
-
-        ents = np.array(ents, dtype=str)
-        tgts = np.array(tgts, dtype=str)
-        names = np.array(names, dtype=str)
-        etypes = np.array(etypes, dtype=str)
-        ttypes = np.array(ttypes, dtype=str)
+        ents, tgts, names, etypes, ttypes = (cat(i) for i in range(5))
+        ts = np.concatenate([s[5] for s in shards])
+        prop = np.concatenate([s[6] for s in shards])
+        n = len(ts)
         # residual exact filters, vectorized (hash false-positives +
         # predicates the coarse pass cannot express; '' == absent)
         keep = np.ones(n, dtype=bool)

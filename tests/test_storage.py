@@ -44,16 +44,20 @@ class _CompositeClient:
         self.meta_client.close()
 
 
-@pytest.fixture(params=["memory", "sqlite", "nativelog"])
+@pytest.fixture(params=["memory", "sqlite", "nativelog", "nativelog-p4"])
 def client(request, tmp_path):
     if request.param == "memory":
         c = MemClient(StorageClientConfig("TEST", "memory", {}))
-    elif request.param == "nativelog":
+    elif request.param.startswith("nativelog"):
         from predictionio_tpu.data.storage.nativelog import \
             StorageClient as NativeClient
+        cfg = {"PATH": str(tmp_path / "log")}
+        if request.param == "nativelog-p4":
+            # hash-partitioned shards + parallel scans must satisfy the
+            # exact same spec as every other backend
+            cfg["PARTITIONS"] = "4"
         c = _CompositeClient(
-            NativeClient(StorageClientConfig(
-                "TEST", "nativelog", {"PATH": str(tmp_path / "log")})),
+            NativeClient(StorageClientConfig("TEST", "nativelog", cfg)),
             MemClient(StorageClientConfig("TEST", "memory", {})))
     else:
         c = SQLClient(StorageClientConfig(
@@ -375,3 +379,99 @@ class TestRegistry:
             assert models.get("m").models == b"x"
         finally:
             registry.clear_cache()
+
+
+class TestNativeLogPartitions:
+    """Partition-specific behavior beyond the shared spec: shard layout,
+    legacy-file migration, entity-scoped routing (the HBase region-model
+    role — reference: data/src/main/scala/io/prediction/data/storage/
+    hbase/HBEventsUtil.scala:81-129 rowkey sharding)."""
+
+    def _client(self, tmp_path, partitions):
+        from predictionio_tpu.data.storage.nativelog import \
+            StorageClient as NativeClient
+        cfg = {"PATH": str(tmp_path / "plog"),
+               "PARTITIONS": str(partitions)}
+        return NativeClient(StorageClientConfig("TEST", "nativelog", cfg))
+
+    def test_writes_spread_over_shard_files(self, tmp_path):
+        c = self._client(tmp_path, 4)
+        ev = c.get_data_object("events", "test")
+        ev.init(1)
+        ev.insert_batch([mk(eid=f"u{i}", sec=i % 50) for i in range(200)], 1)
+        files = [f for f in __import__("os").listdir(tmp_path / "plog" / "test")
+                 if f.startswith("events_1_0_p")]
+        assert len(files) == 4
+        import os as _os
+        nonempty = [f for f in files if _os.path.getsize(
+            tmp_path / "plog" / "test" / f) > 0]
+        assert len(nonempty) >= 3  # 200 entities hash into >= 3 of 4 shards
+        assert len(list(ev.find(1))) == 200
+        c.close()
+
+    def test_entity_scoped_read_and_id_probe(self, tmp_path):
+        c = self._client(tmp_path, 4)
+        ev = c.get_data_object("events", "test")
+        ev.init(1)
+        ids = ev.insert_batch(
+            [mk(eid=f"u{i}", sec=i + 1) for i in range(20)], 1)
+        got = list(ev.find(1, entity_type="user", entity_id="u7"))
+        assert [e.entity_id for e in got] == ["u7"]
+        assert ev.get(ids[3], 1).entity_id == "u3"
+        assert ev.delete(ids[3], 1)
+        assert ev.get(ids[3], 1) is None
+        assert len(list(ev.find(1))) == 19
+        c.close()
+
+    def test_columnar_merge_is_time_ordered(self, tmp_path):
+        import numpy as np
+        c = self._client(tmp_path, 3)
+        ev = c.get_data_object("events", "test")
+        ev.init(1)
+        ev.insert_batch(
+            [mk(eid=f"u{i}", sec=(i * 7) % 40,
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(i)}))
+             for i in range(60)], 1)
+        cols = ev.find_columnar(1, property_field="rating")
+        assert len(cols["entity_id"]) == 60
+        assert np.all(np.diff(cols["t"]) >= 0)
+        # per-row alignment survives the shard merge + sort
+        for e, p in zip(cols["entity_id"], cols["prop"]):
+            assert p == float(e[1:])
+        c.close()
+
+    def test_partition_count_change_is_refused(self, tmp_path):
+        # hash % P routing against files written under a different P would
+        # silently miss records — the marker file makes it fail fast
+        c = self._client(tmp_path, 4)
+        ev = c.get_data_object("events", "test")
+        ev.init(1)
+        ev.insert(mk(), 1)
+        c.close()
+        c2 = self._client(tmp_path, 2)
+        with pytest.raises(ValueError, match="PARTITIONS=4"):
+            c2.get_data_object("events", "test")
+        c2.close()
+
+    def test_legacy_file_migration(self, tmp_path):
+        # events written unpartitioned remain visible after PARTITIONS=4
+        c1 = self._client(tmp_path, 1)
+        ev1 = c1.get_data_object("events", "test")
+        ev1.init(1)
+        old = ev1.insert_batch(
+            [mk(eid=f"old{i}", sec=i + 1) for i in range(5)], 1)
+        c1.close()
+        c4 = self._client(tmp_path, 4)
+        ev4 = c4.get_data_object("events", "test")
+        ev4.init(1)
+        ev4.insert_batch([mk(eid=f"new{i}", sec=i + 10) for i in range(5)], 1)
+        assert len(list(ev4.find(1))) == 10
+        assert ev4.get(old[0], 1).entity_id == "old0"
+        got = list(ev4.find(1, entity_type="user", entity_id="old2"))
+        assert [e.entity_id for e in got] == ["old2"]
+        cols = ev4.find_columnar(1)
+        assert len(cols["entity_id"]) == 10
+        assert ev4.remove(1)  # removes shard files AND the legacy file
+        assert list(ev4.find(1)) == []
+        c4.close()
