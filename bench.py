@@ -203,7 +203,11 @@ def bench_product_path(full_scale: bool):
     core/src/main/scala/io/prediction/controller/Engine.scala:621-708).
 
     Store population is setup, not measurement: rows go straight into the
-    backing table the way an operator's bulk import would have left them.
+    backing store the way an operator's bulk import would have left them.
+
+    PIO_BENCH_PRODUCT_BACKEND selects the event store: `nativelog`
+    (default — the scalable C++ store, hash-partitioned with parallel
+    shard scans) or `sqlite` (the embedded operator default).
     """
     import tempfile
 
@@ -216,6 +220,7 @@ def bench_product_path(full_scale: bool):
     else:
         n_users, n_items, nnz, rank, iters = 2_000, 500, 60_000, 16, 2
 
+    backend = os.environ.get("PIO_BENCH_PRODUCT_BACKEND", "nativelog")
     base = tempfile.mkdtemp(prefix="pio_bench_store_")
     saved = {k: os.environ.get(k) for k in list(os.environ)
              if k.startswith("PIO_STORAGE")}
@@ -225,11 +230,14 @@ def bench_product_path(full_scale: bool):
         "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "bench_meta",
         "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "bench_event",
-        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": backend.upper(),
         "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "bench_model",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
         "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
         "PIO_STORAGE_SOURCES_SQLITE_URL": os.path.join(base, "pio.db"),
+        "PIO_STORAGE_SOURCES_NATIVELOG_TYPE": "nativelog",
+        "PIO_STORAGE_SOURCES_NATIVELOG_PATH": os.path.join(base, "evlog"),
+        "PIO_STORAGE_SOURCES_NATIVELOG_PARTITIONS": "8",
         "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
         "PIO_STORAGE_SOURCES_LOCALFS_HOSTS": os.path.join(base, "models"),
     })
@@ -242,16 +250,49 @@ def bench_product_path(full_scale: bool):
 
         ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
         t0 = time.perf_counter()
-        rows = [(f"e{j}", app_id, 0, "rate", "user", f"u{int(u)}", "item",
-                 f"i{int(it)}", '{"rating": %.1f}' % v, 1000 + j, "[]",
-                 None, 1000 + j)
-                for j, (u, it, v) in enumerate(zip(ui, ii, vv))]
-        with ev.c.lock:
-            ev.c._conn.executemany(
-                f"INSERT INTO {ev.t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                rows)
-            ev.c._conn.commit()
-        del rows
+        if backend == "nativelog":
+            # bulk import straight through the C appender (the analog of
+            # the sqlite executemany below): pre-resolved shard handles,
+            # hand-built compact payloads identical to Events.insert's
+            lib = ev.lib
+            P = ev.partitions
+            handles = [ev._handle_of(app_id, None, p)[0] for p in range(P)]
+            name_hash = lib.el_hash(b"rate", 4)
+            for j, (u, it, v) in enumerate(zip(ui, ii, vv)):
+                ent = b"user\x00u%d" % u
+                tgt = b"item\x00i%d" % it
+                eid = b"e%d" % j
+                ts = 1000 + j
+                # eventTime matches the header ts exactly, as
+                # Events.insert would have written it
+                sec, ms = divmod(ts, 1000)
+                mi, sec = divmod(sec, 60)
+                hh, mi = divmod(mi, 60)
+                payload = (b'{"eventId":"%s","event":"rate","entityType":'
+                           b'"user","entityId":"u%d","targetEntityType":'
+                           b'"item","targetEntityId":"i%d","properties":'
+                           b'{"rating":%.1f},"eventTime":'
+                           b'"1970-01-01T%02d:%02d:%02d.%03dZ"}'
+                           % (eid, u, it, v, hh, mi, sec, ms))
+                part = lib.el_hash(ent, len(ent)) % P
+                if lib.el_append(handles[part], eid, len(eid), payload,
+                                 len(payload), ts,
+                                 lib.el_hash(ent, len(ent)), name_hash,
+                                 lib.el_hash(tgt, len(tgt))) != 0:
+                    raise IOError("bench populate: append failed")
+            for h in handles:
+                lib.el_flush(h)
+        else:
+            rows = [(f"e{j}", app_id, 0, "rate", "user", f"u{int(u)}",
+                     "item", f"i{int(it)}", '{"rating": %.1f}' % v,
+                     1000 + j, "[]", None, 1000 + j)
+                    for j, (u, it, v) in enumerate(zip(ui, ii, vv))]
+            with ev.c.lock:
+                ev.c._conn.executemany(
+                    f"INSERT INTO {ev.t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                    rows)
+                ev.c._conn.commit()
+            del rows
         populate_s = time.perf_counter() - t0
 
         ds = R.RecommendationDataSource(
@@ -271,16 +312,35 @@ def bench_product_path(full_scale: bool):
         algo.train(pd)
         train_s = time.perf_counter() - t0
 
+        # warm re-train: same shapes, compiled programs now cached — the
+        # total cost of an operator retrain (plan build + upload + iters).
+        # The per-phase split comes from the train's own telemetry (hard-
+        # synced in ops/als.py): `s_per_iter` is the steady-state sweep
+        # cost, directly comparable to the kernel bench's s/iteration,
+        # without differencing two noisy tunnel-bound totals.
+        t0 = time.perf_counter()
+        algo.train(pd)
+        train_warm_s = time.perf_counter() - t0
+        tel = getattr(algo, "last_train_telemetry", {})
+
         e2e = read_s + prepare_s + train_s
-        return {
+        out = {
+            "product_backend": backend,
             "product_nnz": int(pd.ratings_coo.nnz),
             "product_read_s": round(read_s, 3),
             "product_prepare_s": round(prepare_s, 3),
             "product_train_s": round(train_s, 3),
+            "product_train_warm_s": round(train_warm_s, 3),
             "product_e2e_s": round(e2e, 3),
             "product_events_per_sec_read": round(nnz / read_s, 1),
             "product_setup_populate_s": round(populate_s, 3),
         }
+        for k, v in tel.items():
+            out[f"product_train_{k}"] = round(v, 4)
+        if tel.get("s_per_iter"):
+            out["product_ratings_per_sec_steady"] = round(
+                pd.ratings_coo.nnz / tel["s_per_iter"], 1)
+        return out
     finally:
         registry.clear_cache()
         for k in list(os.environ):

@@ -85,6 +85,45 @@ bool read_exact(FILE* f, void* buf, size_t n) {
   return fread(buf, 1, n, f) == n;
 }
 
+// Sequential bulk reader: scan results come (almost always) in increasing
+// file order, so instead of one fseeko+fread syscall pair per record the
+// bulk paths stream the file through a large window and serve records by
+// memcpy. Out-of-order offsets (a key overwritten by a later append keeps
+// its old position in the scan order) fall back to a direct seek+read.
+class SeqReader {
+ public:
+  SeqReader(FILE* f, size_t window = 8u << 20) : f_(f), window_(window) {}
+
+  // copy [off, off+len) into out; returns false on IO error
+  bool read(uint64_t off, uint8_t* out, size_t len) {
+    if (off >= base_ && off + len <= base_ + buf_.size()) {
+      memcpy(out, buf_.data() + (off - base_), len);
+      return true;
+    }
+    if (off >= base_ + buf_.size() || buf_.empty()) {
+      // advance the window to start at off
+      size_t want = len > window_ ? len : window_;
+      buf_.resize(want);
+      if (fseeko(f_, (off_t)off, SEEK_SET) != 0) return false;
+      size_t got = fread(buf_.data(), 1, want, f_);
+      buf_.resize(got);
+      base_ = off;
+      if (got < len) return false;
+      memcpy(out, buf_.data(), len);
+      return true;
+    }
+    // behind the window: direct read, window untouched
+    if (fseeko(f_, (off_t)off, SEEK_SET) != 0) return false;
+    return fread(out, 1, len, f_) == len;
+  }
+
+ private:
+  FILE* f_;
+  size_t window_;
+  uint64_t base_ = 0;
+  std::vector<uint8_t> buf_;
+};
+
 }  // namespace
 
 extern "C" {
@@ -252,15 +291,16 @@ int64_t el_scan_fetch(void* vh) {
   }
   h->bulk_data.reserve(total);
   h->bulk_offsets.push_back(0);
+  fflush(h->f);  // SeqReader reads through the same FILE*: no stale tail
+  SeqReader rd(h->f);
   for (const std::string* k : h->scan_keys) {
     auto it = h->index.find(*k);
     if (it == h->index.end() || it->second.deleted) continue;
     const IndexEntry& e = it->second;
     size_t pos = h->bulk_data.size();
     h->bulk_data.resize(pos + e.datalen);
-    fseeko(h->f, (off_t)(e.offset + sizeof(RecordHeader) + k->size()),
-           SEEK_SET);
-    if (!read_exact(h->f, h->bulk_data.data() + pos, e.datalen)) {
+    if (!rd.read(e.offset + sizeof(RecordHeader) + k->size(),
+                 h->bulk_data.data() + pos, e.datalen)) {
       fseeko(h->f, 0, SEEK_END);
       return -1;
     }
@@ -377,14 +417,15 @@ int64_t el_scan_columnar(void* vh, const char* prop_name) {
   h->col_prop.clear();
   h->col_fallback.clear();
   std::vector<uint8_t> buf;
+  fflush(h->f);  // SeqReader reads through the same FILE*: no stale tail
+  SeqReader rd(h->f);
   for (const std::string* k : h->scan_keys) {
     auto it = h->index.find(*k);
     if (it == h->index.end() || it->second.deleted) continue;
     const IndexEntry& e = it->second;
     buf.resize(e.datalen);
-    fseeko(h->f, (off_t)(e.offset + sizeof(RecordHeader) + k->size()),
-           SEEK_SET);
-    if (!read_exact(h->f, buf.data(), e.datalen)) {
+    if (!rd.read(e.offset + sizeof(RecordHeader) + k->size(), buf.data(),
+                 e.datalen)) {
       fseeko(h->f, 0, SEEK_END);
       return -1;
     }
@@ -451,41 +492,77 @@ int64_t el_scan_columnar(void* vh, const char* prop_name) {
 }
 
 const int64_t* el_col_ts(void* vh) { return ((Handle*)vh)->col_ts.data(); }
-const char* el_col_entity(void* vh) {
-  return ((Handle*)vh)->col_entity.data();
-}
-const uint64_t* el_col_entity_off(void* vh) {
-  return ((Handle*)vh)->col_entity_off.data();
-}
-const char* el_col_target(void* vh) {
-  return ((Handle*)vh)->col_target.data();
-}
-const uint64_t* el_col_target_off(void* vh) {
-  return ((Handle*)vh)->col_target_off.data();
-}
-const char* el_col_event(void* vh) {
-  return ((Handle*)vh)->col_event.data();
-}
-const uint64_t* el_col_event_off(void* vh) {
-  return ((Handle*)vh)->col_event_off.data();
-}
-const char* el_col_etype(void* vh) {
-  return ((Handle*)vh)->col_etype.data();
-}
-const uint64_t* el_col_etype_off(void* vh) {
-  return ((Handle*)vh)->col_etype_off.data();
-}
-const char* el_col_ttype(void* vh) {
-  return ((Handle*)vh)->col_ttype.data();
-}
-const uint64_t* el_col_ttype_off(void* vh) {
-  return ((Handle*)vh)->col_ttype_off.data();
-}
 const double* el_col_prop(void* vh) {
   return ((Handle*)vh)->col_prop.data();
 }
 const uint8_t* el_col_fallback(void* vh) {
   return ((Handle*)vh)->col_fallback.data();
+}
+
+namespace {
+// string-column accessors by id: 0 entity, 1 target, 2 event, 3 etype,
+// 4 ttype (el_scan_columnar state)
+const std::string* col_buf_of(Handle* h, int32_t c) {
+  switch (c) {
+    case 0: return &h->col_entity;
+    case 1: return &h->col_target;
+    case 2: return &h->col_event;
+    case 3: return &h->col_etype;
+    case 4: return &h->col_ttype;
+  }
+  return nullptr;
+}
+const std::vector<uint64_t>* col_off_of(Handle* h, int32_t c) {
+  switch (c) {
+    case 0: return &h->col_entity_off;
+    case 1: return &h->col_target_off;
+    case 2: return &h->col_event_off;
+    case 3: return &h->col_etype_off;
+    case 4: return &h->col_ttype_off;
+  }
+  return nullptr;
+}
+}  // namespace
+
+// Longest value (bytes) in string column c of the current columnar scan,
+// and whether any byte is non-ASCII (sets *non_ascii to 1 if so).
+int64_t el_col_maxlen(void* vh, int32_t c, uint8_t* non_ascii) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  const std::string* buf = col_buf_of(h, c);
+  const std::vector<uint64_t>* off = col_off_of(h, c);
+  if (!buf || !off) return -1;
+  int64_t m = 0;
+  for (size_t i = 0; i + 1 < off->size(); i++) {
+    int64_t len = (int64_t)((*off)[i + 1] - (*off)[i]);
+    if (len > m) m = len;
+  }
+  uint8_t na = 0;
+  for (unsigned char ch : *buf) {
+    if (ch >= 128) { na = 1; break; }
+  }
+  if (non_ascii) *non_ascii = na;
+  return m;
+}
+
+// Fill a caller-allocated row-major [n, maxlen] byte matrix (zero-padded
+// rows) with string column c — the padded layout numpy can view as a
+// fixed-width bytes array with zero per-record Python work. Returns the
+// row count, or -1 on bad args.
+int64_t el_col_fill(void* vh, int32_t c, uint8_t* out, int64_t maxlen) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  const std::string* buf = col_buf_of(h, c);
+  const std::vector<uint64_t>* off = col_off_of(h, c);
+  if (!buf || !off || maxlen <= 0) return -1;
+  size_t n = off->size() - 1;
+  memset(out, 0, (size_t)maxlen * n);
+  for (size_t i = 0; i < n; i++) {
+    size_t len = (size_t)((*off)[i + 1] - (*off)[i]);
+    if ((int64_t)len > maxlen) return -1;
+    memcpy(out + (size_t)maxlen * i, buf->data() + (*off)[i], len);
+  }
+  return (int64_t)n;
 }
 
 int64_t el_count(void* vh) {

@@ -290,7 +290,12 @@ class ALSAlgorithm(P2LAlgorithm):
                         seed=p.seed if p.seed is not None else 0,
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype())
-        model = als_train(pd.ratings_coo, cfg)
+        # per-phase timing of the train that just ran (plan/upload/iters/
+        # fetch) — consumed by bench.py's product-path mode; the hard
+        # syncs it adds are negligible next to a real train
+        self.last_train_telemetry = {}
+        model = als_train(pd.ratings_coo, cfg,
+                          telemetry=self.last_train_telemetry)
         item_properties = None
         if pd.items is not None:
             item_properties = [pd.items.get(pd.item_ix.id_of(ix))

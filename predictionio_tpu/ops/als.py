@@ -267,12 +267,22 @@ def _run_side(device_groups, factors, counter_factors, cfg: ALSConfig,
 
 
 def als_train(ratings: RatingsCOO, cfg: ALSConfig,
-              mesh: Optional[MeshContext] = None) -> ALSModel:
+              mesh: Optional[MeshContext] = None,
+              telemetry: Optional[dict] = None) -> ALSModel:
     """Train explicit/implicit ALS. Factor tables carry one extra dummy row
     (index n) used as the scatter target for padding; it is dropped in the
-    returned model."""
+    returned model.
+
+    `telemetry`, when a dict, receives per-phase wall times (plan_s,
+    upload_s, iters_s, s_per_iter, fetch_s). The iteration timing is
+    closed by a hard one-element host fetch (a dispatch-queue timer would
+    lie on asynchronous backends), which costs one extra tiny transfer —
+    only paid when telemetry is requested."""
+    import time as _time
+
     import jax
     mesh = mesh or current_mesh()
+    t0 = _time.perf_counter()
     if cfg.solver == "auto":
         import dataclasses
         from predictionio_tpu.ops.solve import resolve_solver
@@ -291,6 +301,9 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
         user_plan.padding_overhead,
         len(item_plan.batches), item_plan.kernel_shapes,
         item_plan.padding_overhead)
+    if telemetry is not None:
+        telemetry["plan_s"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
 
     if cfg.factor_sharding == "model":
         put_factors = mesh.put_model_sharded
@@ -311,11 +324,29 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
     # recompile the sweep program
     lam_dev = mesh.put_replicated(np.float32(cfg.lam))
     alpha_dev = mesh.put_replicated(np.float32(cfg.alpha))
+    if telemetry is not None:
+        # hard sync: uploads must have landed before iteration timing
+        # (one element of the factor table AND of the last-enqueued batch
+        # group — per-device transfers complete in order, so the latter
+        # fences the bulk of the plan upload)
+        float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])
+        if item_batches:
+            float(np.asarray(jax.device_get(
+                item_batches[-1][2][:1, :1, :1])).ravel()[0])
+        telemetry["upload_s"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
     for it in range(cfg.iterations):
         gram_v = _gram(V[:ratings.n_items]) if cfg.implicit_prefs else None
         U = _run_side(user_batches, U, V, cfg, gram_v, lam_dev, alpha_dev)
         gram_u = _gram(U[:ratings.n_users]) if cfg.implicit_prefs else None
         V = _run_side(item_batches, V, U, cfg, gram_u, lam_dev, alpha_dev)
+    if telemetry is not None:
+        # hard sync again: the loop above only enqueues device work
+        float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])
+        telemetry["iters_s"] = _time.perf_counter() - t0
+        telemetry["s_per_iter"] = (telemetry["iters_s"]
+                                   / max(cfg.iterations, 1))
+        t0 = _time.perf_counter()
     from predictionio_tpu.parallel.mesh import host_fetch
     if cfg.factor_sharding == "model":
         # gather the model-sharded tables through a replicating jit (a
@@ -326,6 +357,8 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
         U, V = gather(U), gather(V)
     U_host = host_fetch(U)[:ratings.n_users].astype(np.float32, copy=False)
     V_host = host_fetch(V)[:ratings.n_items].astype(np.float32, copy=False)
+    if telemetry is not None:
+        telemetry["fetch_s"] = _time.perf_counter() - t0
     return ALSModel(user_factors=U_host, item_factors=V_host, rank=cfg.rank)
 
 
