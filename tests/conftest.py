@@ -55,3 +55,11 @@ def tmp_env(tmp_path, monkeypatch):
     registry.clear_cache()
     yield tmp_path
     registry.clear_cache()
+
+
+def pytest_configure(config):
+    # advisory marker: no pytest-timeout plugin in this environment; the
+    # subprocess-based distributed tests enforce their own deadlines via
+    # communicate(timeout=...)
+    config.addinivalue_line(
+        "markers", "timeout(seconds): advisory wall-clock bound")

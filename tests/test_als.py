@@ -289,3 +289,25 @@ class TestMeshEquivalence:
         m8 = als_train(r, cfg, mesh8)
         np.testing.assert_allclose(m1.user_factors, m8.user_factors,
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_train_telemetry_phases():
+    """als_train(telemetry=) reports every phase with sane values and
+    does not perturb the result (bench.py's product-path split)."""
+    rng = np.random.default_rng(3)
+    n_u, n_i, nnz = 300, 80, 4000
+    ui = rng.integers(0, n_u, nnz)
+    ii = rng.integers(0, n_i, nnz)
+    vv = rng.uniform(1, 5, nnz).astype(np.float32)
+    r = RatingsCOO(ui, ii, vv, n_u, n_i)
+    cfg = ALSConfig(rank=8, iterations=3, lam=0.05, seed=1)
+    tel = {}
+    m1 = als_train(r, cfg, telemetry=tel)
+    m2 = als_train(r, cfg)
+    assert set(tel) == {"plan_s", "upload_s", "iters_s", "s_per_iter",
+                        "fetch_s"}
+    assert all(v >= 0 for v in tel.values())
+    assert tel["s_per_iter"] * cfg.iterations == pytest.approx(
+        tel["iters_s"])
+    np.testing.assert_allclose(m1.user_factors, m2.user_factors,
+                               rtol=1e-5)
