@@ -1,0 +1,267 @@
+"""Remote event-store backend: the Events DAO over a running event
+server's REST API.
+
+The reference deploys one central event store that every app, trainer,
+and serving process points at (HBase behind the event server; reference:
+data/src/main/scala/io/prediction/data/api/EventServer.scala route table,
+and LEvents consumers). The embedded backends here (sqlite/nativelog/
+pgsql) require filesystem or database access to that store; this client
+completes the topology for processes that only have NETWORK access —
+a trainer on another host reads and writes events through the event
+server itself (`/events.json` CRUD, `/batch/events.json`), with the
+exact `Events` interface the rest of the framework consumes.
+
+Configure:
+    PIO_STORAGE_SOURCES_<S>_TYPE=eventserver
+    PIO_STORAGE_SOURCES_<S>_URL=http://host:7070
+    PIO_STORAGE_SOURCES_<S>_ACCESS_KEY=<key>      (scopes the app)
+    PIO_STORAGE_SOURCES_<S>_CHANNELS=5=mych,7=other   (optional: the
+        REST API addresses channels by NAME; this maps the numeric
+        channel ids the Events interface speaks to those names)
+
+Scope notes (enforced, not silent): an access key is bound to ONE app,
+so calls for a different app_id raise; `init` is a no-op (namespaces are
+managed by the server's admin surface); `remove` deletes events one by
+one through the API (there is no bulk-drop route, as in the reference's
+event API).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import http.client
+import json
+import threading
+import urllib.parse
+from typing import Dict, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import ABSENT
+
+MAX_BATCH = 50  # the server's batch cap (EventServer MAX_BATCH_SIZE)
+
+
+class RemoteError(IOError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"event server returned {status}: {message}")
+        self.status = status
+
+
+class StorageClient:
+    def __init__(self, config):
+        self.config = config
+        url = config.get("URL") or config.get("HOSTS") \
+            or "http://127.0.0.1:7070"
+        self.access_key = config.get("ACCESS_KEY") or ""
+        channels = config.get("CHANNELS") or ""
+        channel_map: Dict[int, str] = {}
+        for pair in channels.split(","):
+            if "=" in pair:
+                cid, name = pair.split("=", 1)
+                channel_map[int(cid.strip())] = name.strip()
+        self._events = RemoteEvents(url, self.access_key, channel_map)
+
+    def get_data_object(self, kind: str, namespace: str):
+        if kind != "events":
+            raise ValueError(
+                f"eventserver backend only stores events, not {kind}")
+        return self._events
+
+    def close(self):
+        self._events.close()
+
+
+class RemoteEvents(base.Events):
+    """Events DAO speaking the event-server REST protocol. One keep-alive
+    connection per thread (the server is a threaded HTTP server; keep-
+    alive removes per-call TCP setup from the bulk paths)."""
+
+    def __init__(self, url: str, access_key: str,
+                 channel_map: Optional[Dict[int, str]] = None):
+        if "://" not in url:
+            # conventional HOSTS form: bare "host" or "host:port"
+            url = "http://" + url
+        p = urllib.parse.urlparse(url)
+        if p.scheme != "http":
+            raise ValueError(f"unsupported event server scheme {p.scheme!r}")
+        self.host = p.hostname or "127.0.0.1"
+        self.port = p.port or 7070
+        self.access_key = access_key
+        self.channel_map = channel_map or {}
+        self._app_id: Optional[int] = None   # learned lazily, then pinned
+        self._local = threading.local()
+
+    # -- transport ----------------------------------------------------------
+    def _conn(self) -> http.client.HTTPConnection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(self.host, self.port, timeout=60)
+            self._local.conn = c
+        return c
+
+    def _request(self, method: str, path: str,
+                 params: Optional[dict] = None, body=None):
+        qs = dict(params or {})
+        qs["accessKey"] = self.access_key
+        full = path + "?" + urllib.parse.urlencode(qs)
+        payload = (json.dumps(body).encode("utf-8")
+                   if body is not None else None)
+        headers = {"Content-Type": "application/json"} if payload else {}
+        for attempt in (0, 1):   # one transparent reconnect, like pgsql
+            c = self._conn()
+            try:
+                c.request(method, full, body=payload, headers=headers)
+                resp = c.getresponse()
+                data = resp.read()
+                break
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._local.conn = None
+                c.close()
+                if attempt:
+                    raise
+        try:
+            decoded = json.loads(data.decode("utf-8")) if data else None
+        except ValueError:
+            decoded = None
+        return resp.status, decoded
+
+    def close(self):
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            c.close()
+            self._local.conn = None
+
+    # -- scope checks -------------------------------------------------------
+    def _params(self, app_id: int, channel_id: Optional[int]) -> dict:
+        """Every operation funnels through here: the first app_id seen is
+        pinned, later mismatches raise. (The server scopes everything by
+        the access key and ignores the client-side app_id entirely, so
+        without the pin a wrong app_id would silently return another
+        app's events under the wrong label.)"""
+        if self._app_id is None:
+            self._app_id = app_id
+        elif app_id != self._app_id:
+            raise ValueError(
+                f"this eventserver client's access key is bound to app "
+                f"{self._app_id}; got app_id={app_id}. Configure one "
+                f"source per app.")
+        if channel_id is None:
+            return {}
+        name = self.channel_map.get(channel_id)
+        if name is None:
+            raise ValueError(
+                f"channel_id {channel_id} has no name mapping; set "
+                f"PIO_STORAGE_SOURCES_<S>_CHANNELS={channel_id}=<name>")
+        return {"channel": name}
+
+    # -- Events interface ---------------------------------------------------
+    def init(self, app_id, channel_id=None) -> bool:
+        # namespaces are provisioned by the server's admin surface
+        # (pio app new / channel new); nothing to do from here
+        self._params(app_id, channel_id)
+        return True
+
+    def remove(self, app_id, channel_id=None) -> bool:
+        # no bulk-drop route in the event API: delete what find returns.
+        # An already-empty namespace is a successful remove, as in every
+        # embedded backend.
+        for e in list(self.find(app_id, channel_id, limit=-1)):
+            self.delete(e.event_id, app_id, channel_id)
+        return True
+
+    @staticmethod
+    def _with_id(event: Event) -> Event:
+        """Assign the eventId CLIENT-side before sending: the transparent
+        reconnect below may re-send a request the server already
+        processed, and a re-send carrying the same id overwrites by key
+        instead of inserting a duplicate (the same idempotency the pgsql
+        backend gets from INSERT ... ON CONFLICT)."""
+        from predictionio_tpu.data.event import new_event_id
+        if event.event_id:
+            return event
+        return event.with_id(new_event_id())
+
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        params = self._params(app_id, channel_id)
+        event = self._with_id(event)
+        status, body = self._request("POST", "/events.json", params,
+                                     event.to_dict())
+        if status != 201:
+            raise RemoteError(status, (body or {}).get("message", ""))
+        return body["eventId"]
+
+    def insert_batch(self, events: Sequence[Event], app_id,
+                     channel_id=None) -> List[str]:
+        params = self._params(app_id, channel_id)
+        ids: List[str] = []
+        evs = [self._with_id(e) for e in events]
+        for lo in range(0, len(evs), MAX_BATCH):
+            status, body = self._request(
+                "POST", "/batch/events.json", params,
+                [e.to_dict() for e in evs[lo:lo + MAX_BATCH]])
+            if status != 200:
+                raise RemoteError(status, (body or {}).get("message", ""))
+            for item in body:
+                if item.get("status") != 201:
+                    raise RemoteError(item.get("status", 400),
+                                      item.get("message", ""))
+                ids.append(item["eventId"])
+        return ids
+
+    def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
+        params = self._params(app_id, channel_id)
+        status, body = self._request(
+            "GET", f"/events/{urllib.parse.quote(event_id)}.json", params)
+        if status == 404:
+            return None
+        if status != 200:
+            raise RemoteError(status, (body or {}).get("message", ""))
+        return Event.from_dict(body)
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        params = self._params(app_id, channel_id)
+        status, body = self._request(
+            "DELETE", f"/events/{urllib.parse.quote(event_id)}.json",
+            params)
+        if status == 404:        # server answers 404 for an unknown id
+            return False
+        if status != 200:
+            raise RemoteError(status, (body or {}).get("message", ""))
+        return True
+
+    @staticmethod
+    def _iso(t: dt.datetime) -> str:
+        return t.astimezone(dt.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None, limit=None,
+             reversed_order=False):
+        params = self._params(app_id, channel_id)
+        if start_time is not None:
+            params["startTime"] = self._iso(start_time)
+        if until_time is not None:
+            params["untilTime"] = self._iso(until_time)
+        if entity_type is not None:
+            params["entityType"] = entity_type
+        if entity_id is not None:
+            params["entityId"] = entity_id
+        if event_names:
+            params["event"] = ",".join(event_names)
+        if target_entity_type is not None:
+            params["targetEntityType"] = (
+                "" if target_entity_type is ABSENT else target_entity_type)
+        if target_entity_id is not None:
+            params["targetEntityId"] = (
+                "" if target_entity_id is ABSENT else target_entity_id)
+        params["limit"] = -1 if limit is None else limit
+        if reversed_order:
+            params["reversed"] = "true"
+        status, body = self._request("GET", "/events.json", params)
+        if status == 404:
+            return iter(())
+        if status != 200:
+            raise RemoteError(status, (body or {}).get("message", ""))
+        return iter([Event.from_dict(d) for d in body])

@@ -100,6 +100,9 @@ def _backend_module(type_: str):
         "nativelog": "predictionio_tpu.data.storage.nativelog",  # C++ log
         "remotefs": "predictionio_tpu.data.storage.remotefs",  # URI blobs
         "hdfs": "predictionio_tpu.data.storage.remotefs",  # HDFS role
+        # Events DAO over a remote event server's REST API (network-only
+        # access to the central store)
+        "eventserver": "predictionio_tpu.data.storage.eventserver_client",
     }
     if type_ not in modules:
         raise StorageError(f"Unknown storage source type: {type_}. "

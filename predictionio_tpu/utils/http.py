@@ -109,8 +109,13 @@ class HttpServer:
 
             def _handle(self):
                 parsed = urllib.parse.urlsplit(self.path)
+                # keep_blank_values: `targetEntityType=` (empty string)
+                # is meaningful — the event API maps it to "target
+                # absent" — and must not be silently dropped
                 params = {k: v[0] for k, v in
-                          urllib.parse.parse_qs(parsed.query).items()}
+                          urllib.parse.parse_qs(
+                              parsed.query,
+                              keep_blank_values=True).items()}
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 req = Request(method=self.command, path=parsed.path,

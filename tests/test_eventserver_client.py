@@ -1,0 +1,165 @@
+"""Remote Events DAO (eventserver backend): the storage spec run over a
+real in-process event server via HTTP — network-only access to the
+central store (the reference's every-process-points-at-one-event-server
+topology)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import AccessKey, App, Channel, Storage
+from predictionio_tpu.data.api.event_server import (EventServer,
+                                                    EventServerConfig)
+from predictionio_tpu.data.storage.base import ABSENT
+from predictionio_tpu.data.storage.eventserver_client import (RemoteEvents,
+                                                              StorageClient)
+from predictionio_tpu.data.storage.registry import StorageClientConfig
+
+UTC = dt.timezone.utc
+
+
+def t(sec):
+    return dt.datetime(2026, 1, 1, 0, 0, sec, tzinfo=UTC)
+
+
+def mk(event="rate", eid="u1", sec=1, **kw):
+    return Event(event=event, entity_type="user", entity_id=eid,
+                 event_time=t(sec), **kw)
+
+
+@pytest.fixture
+def remote(tmp_env):
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "remoteapp"))
+    Storage.get_events().init(app_id)
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("remotekey", app_id, []))
+    chan_id = Storage.get_meta_data_channels().insert(
+        Channel(0, "side", app_id))
+    Storage.get_events().init(app_id, chan_id)
+    s = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+    s.start()
+    client = StorageClient(StorageClientConfig(
+        "REMOTE", "eventserver",
+        {"URL": f"http://127.0.0.1:{s.config.port}",
+         "ACCESS_KEY": "remotekey",
+         "CHANNELS": f"{chan_id}=side"}))
+    ev = client.get_data_object("events", "ignored")
+    yield ev, app_id, chan_id
+    client.close()
+    s.stop()
+
+
+class TestRemoteEvents:
+    def test_insert_get_delete(self, remote):
+        ev, app_id, _ = remote
+        eid = ev.insert(mk(properties=DataMap({"rating": 5})), app_id)
+        got = ev.get(eid, app_id)
+        assert got.event == "rate"
+        assert got.properties.get("rating", int) == 5
+        assert ev.delete(eid, app_id)
+        assert ev.get(eid, app_id) is None
+        assert not ev.delete(eid, app_id)
+
+    def test_batch_chunks_past_server_cap(self, remote):
+        ev, app_id, _ = remote
+        # 120 > the server's 50-event batch cap: the client chunks
+        ids = ev.insert_batch(
+            [mk(eid=f"u{i}", sec=i % 50) for i in range(120)], app_id)
+        assert len(set(ids)) == 120
+        assert len(list(ev.find(app_id))) == 120
+
+    def test_find_filters(self, remote):
+        ev, app_id, _ = remote
+        ev.insert_batch([
+            mk("rate", "u1", 1, target_entity_type="item",
+               target_entity_id="i1"),
+            mk("buy", "u1", 2, target_entity_type="item",
+               target_entity_id="i2"),
+            mk("rate", "u2", 3, target_entity_type="item",
+               target_entity_id="i1"),
+            mk("$set", "u1", 4, properties=DataMap({"a": 1})),
+        ], app_id)
+        assert len(list(ev.find(app_id, event_names=["rate"]))) == 2
+        assert len(list(ev.find(app_id, entity_id="u1"))) == 3
+        assert len(list(ev.find(app_id, start_time=t(2),
+                                until_time=t(4)))) == 2
+        assert len(list(ev.find(app_id, target_entity_id="i1"))) == 2
+        assert len(list(ev.find(app_id, target_entity_type=ABSENT))) == 1
+        got = list(ev.find(app_id, entity_type="user", entity_id="u1",
+                           reversed_order=True))
+        assert [e.event_time for e in got] == [t(4), t(2), t(1)]
+        assert len(list(ev.find(app_id, limit=2))) == 2
+
+    def test_channel_isolation_by_name_mapping(self, remote):
+        ev, app_id, chan_id = remote
+        eid = ev.insert(mk(), app_id, chan_id)
+        assert ev.get(eid, app_id) is None
+        assert ev.get(eid, app_id, chan_id).event_id == eid
+        assert list(ev.find(app_id)) == []
+        assert len(list(ev.find(app_id, chan_id))) == 1
+        with pytest.raises(ValueError, match="no name mapping"):
+            ev.insert(mk(), app_id, 999)
+
+    def test_columnar_default_over_rest(self, remote):
+        """The base-class streaming find_columnar works through the
+        remote DAO, feeding the same template ingest path."""
+        ev, app_id, _ = remote
+        ev.insert_batch(
+            [mk("rate", f"u{i}", i % 50, target_entity_type="item",
+                target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(i)}))
+             for i in range(20)], app_id)
+        cols = ev.find_columnar(app_id, property_field="rating",
+                                event_names=["rate"])
+        assert len(cols["entity_id"]) == 20
+        assert np.all(np.diff(cols["t"]) >= 0)
+        for e, p in zip(cols["entity_id"], cols["prop"]):
+            assert p == float(e[1:])
+
+    def test_app_scope_enforced(self, remote):
+        ev, app_id, _ = remote
+        ev.insert(mk(), app_id)
+        with pytest.raises(ValueError, match="bound to app"):
+            ev.insert(mk(), app_id + 1)
+        # reads pin and enforce too (the server ignores client app_id —
+        # without the pin a wrong id would mislabel another app's events)
+        with pytest.raises(ValueError, match="bound to app"):
+            list(ev.find(app_id + 1))
+
+    def test_inserts_carry_client_side_ids(self, remote):
+        """Ids are assigned before the POST so a transport-level re-send
+        cannot duplicate events (the id makes the write idempotent)."""
+        ev, app_id, _ = remote
+        e = mk()
+        eid = ev.insert(e, app_id)
+        assert eid  # server echoed the client-assigned id
+        # re-sending the identical carried-id event overwrites, not dupes
+        ev.insert(e.with_id(eid), app_id)
+        assert len(list(ev.find(app_id))) == 1
+
+    def test_remove_via_api(self, remote):
+        ev, app_id, _ = remote
+        assert ev.remove(app_id)     # empty namespace: still success
+        ev.insert_batch([mk(eid=f"u{i}", sec=i) for i in range(5)], app_id)
+        assert ev.remove(app_id)
+        assert list(ev.find(app_id)) == []
+
+    def test_bare_hosts_form(self, remote):
+        ev, app_id, _ = remote
+        bare = RemoteEvents(f"{ev.host}:{ev.port}", "remotekey")
+        assert bare.get("missing", app_id) is None
+        bare.close()
+        with pytest.raises(ValueError, match="scheme"):
+            RemoteEvents("ftp://x", "k")
+
+    def test_auth_failure_surfaces(self, remote):
+        ev, app_id, _ = remote
+        bad = RemoteEvents(f"http://{ev.host}:{ev.port}", "WRONGKEY")
+        from predictionio_tpu.data.storage.eventserver_client import \
+            RemoteError
+        with pytest.raises(RemoteError, match="401"):
+            bad.insert(mk(), app_id)
+        bad.close()

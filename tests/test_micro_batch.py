@@ -134,3 +134,33 @@ class TestMicroBatchedServer:
         # predict time is a component of total serving time
         assert stats["avgPredictSec"] <= stats["avgServingSec"]
         assert stats["microBatch"] == 16
+
+
+class TestBatchingWindow:
+    def test_staggered_arrivals_join_one_batch(self):
+        """Requests trickling in over a few ms (HTTP threads parse under
+        the GIL, so concurrent clients never enqueue at one instant) must
+        coalesce within the max_wait window instead of dispatching as
+        tiny batches — the bug this pins: the old drain loop broke out
+        the moment the queue was empty, so the window never applied."""
+        import time
+        batches = []
+
+        def handler(queries):
+            batches.append(len(queries))
+            time.sleep(0.05)         # a slow "device call"
+            return list(queries)
+
+        b = MicroBatcher(handler, max_batch=16, max_wait_ms=40)
+
+        def submit_staggered(i):
+            time.sleep(0.002 * i)    # arrivals spread over ~30 ms
+            return b.submit(i)
+
+        with ThreadPoolExecutor(16) as ex:
+            results = list(ex.map(submit_staggered, range(16)))
+        b.stop()
+        assert sorted(results) == list(range(16))
+        # the window (40 ms) covers the 30 ms arrival spread: everything
+        # after the first dispatch coalesces into very few batches
+        assert len(batches) <= 4, batches
