@@ -63,12 +63,17 @@ class ALSConfig:
     # auto = VMEM-resident CG Pallas kernel on TPU (XLA's batched cholesky
     # runs at ~0.05% MXU there), LAPACK cholesky on CPU.
     dual_solve: str = "auto"  # 'auto' | 'never'
-    # Woodbury/dual formulation for explicit ALS buckets whose padded
-    # segment length K < rank: solve the K x K system
-    # (M M^T + reg I_K) z = y and map back x = M^T z — exact algebra,
-    # K^2*rank Gram + K^3-class solve instead of K*rank^2 + rank^3. Under
-    # a power-law count distribution most entities live in small-K
-    # buckets, so this removes most of the solve work.
+    # Woodbury/dual formulation for ALS buckets whose padded segment
+    # length K < rank — exact algebra replacing the rank-dim solve with a
+    # K-dim one. Explicit: solve (M M^T + reg I_K) z = y, x = M^T z.
+    # Implicit: A = (G + reg I) + V_S^T D V_S with D = diag(alpha*|r|);
+    # eigendecompose the base B = G + reg I ONCE per half-sweep (G is
+    # shared by every entity) and apply Sherman-Morrison-Woodbury
+    # through the eigenbasis: A^-1 b = B^-1 b - B^-1 V^T D^1/2
+    # (I_K + D^1/2 V B^-1 V^T D^1/2)^-1 D^1/2 V B^-1 b — the D^1/2 form
+    # stays exact when D has zeros (padding, zero-confidence rows).
+    # Under a power-law count distribution most entities live in small-K
+    # buckets, so this removes most of the solve work on both paths.
     factor_sharding: str = "replicated"  # 'replicated' | 'model'
     # 'model' shards factor-table rows over the mesh model axis (tables too
     # large for one device's HBM); GSPMD inserts the all-gathers the
@@ -104,6 +109,26 @@ class ALSModel:
 # Device kernels
 # ---------------------------------------------------------------------------
 
+def _dual_system_solve(M, y, K: int, solver: str):
+    """Solve the K-dim dual/Woodbury system: the shared policy for both
+    explicit and implicit dual branches. K+8 iterations (CG's exact-
+    arithmetic finite termination is <= K; the margin absorbs f32
+    roundoff — capping below K would silently under-solve the larger
+    power-of-two buckets); tiny systems skip the Pallas kernel, whose
+    per-tile overhead dominates below 32."""
+    from predictionio_tpu.ops.solve import spd_solve
+    method = "cg" if (K < 32 and solver == "cg_pallas") else solver
+    return spd_solve(M, y, method=method, iters=K + 8)
+
+
+def _scatter_rows(factors_out, rows, x):
+    """Scatter solved rows; padding rows (-1) land on the dummy tail."""
+    import jax.numpy as jnp
+    safe = jnp.where(rows < 0, factors_out.shape[0] - 1, rows)
+    return factors_out.at[safe].set(x.astype(factors_out.dtype),
+                                    mode="drop")
+
+
 def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                  lam, alpha, *, nratings_reg: bool, implicit: bool,
                  rank: int, compute_dtype: str, solver: str,
@@ -135,29 +160,60 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                         preferred_element_type=jnp.float32)
         Ad = Ad + reg[:, None, None] * jnp.eye(K, dtype=jnp.float32)
         y = (val * mask)
-        # CG reaches exact K-dim solutions in <= K+margin iterations; tiny
-        # systems skip the Pallas kernel (per-tile overhead dominates)
-        method = solver
-        if K < 32 and solver == "cg_pallas":
-            method = "cg"
-        z = spd_solve(Ad, y, method=method, iters=min(48, K + 8))
+        z = _dual_system_solve(Ad, y, K, solver)
         x = jnp.einsum("bkr,bk->br", Vm, z.astype(cd),
                        preferred_element_type=jnp.float32)
-        safe_rows = jnp.where(rows < 0, factors_out.shape[0] - 1, rows)
-        return factors_out.at[safe_rows].set(x.astype(factors_out.dtype),
-                                             mode="drop")
+        return _scatter_rows(factors_out, rows, x)
 
     if implicit:
+        G, gram_w, gram_q = gram if isinstance(gram, tuple) \
+            else (gram, None, None)
         absval = jnp.abs(val)
         conf_minus_1 = (alpha * absval) * mask       # c - 1, zero on padding
-        A = gram + jnp.einsum("bk,bkr,bks->brs", conf_minus_1.astype(cd),
-                              Vc, Vc,
-                              preferred_element_type=jnp.float32)
         # preference p = 1(r>0): negative signals add confidence to A only
         pos = (val > 0).astype(val.dtype) * mask
         b = jnp.einsum("bk,bkr->br",
                        ((1.0 + alpha * absval) * pos).astype(cd), Vc,
                        preferred_element_type=jnp.float32)
+        if gram_w is not None and dual_solve == "auto" and K < rank:
+            # implicit dual: B = G + reg I = Q (w + reg) Q^T (eig shared
+            # across the whole half-sweep); Woodbury for the K-rank
+            # confidence update, all R-dim work as eigenbasis einsums.
+            # G is PSD, so clamp eigh's roundoff-negative tail: a small
+            # reg (constant lambda_scaling grid points) must never meet
+            # a negative w and flip the sign of 1/denom.
+            denom = (jnp.maximum(gram_w, 0.0)[None, :]
+                     + reg[:, None])                          # [B, R]
+            Vq = jnp.einsum("bkr,rs->bks", Vc,
+                            gram_q.astype(cd),
+                            preferred_element_type=jnp.float32)  # V~ Q
+            bq = jnp.einsum("br,rs->bs", b.astype(cd),
+                            gram_q.astype(cd),
+                            preferred_element_type=jnp.float32)
+            bq_d = bq / denom
+            u = jnp.einsum("bs,rs->br", bq_d.astype(cd),
+                           gram_q.astype(cd),
+                           preferred_element_type=jnp.float32)  # B^-1 b
+            W = jnp.einsum("bks,bs,bls->bkl", Vq.astype(cd),
+                           (1.0 / denom).astype(cd), Vq.astype(cd),
+                           preferred_element_type=jnp.float32)
+            dhalf = jnp.sqrt(conf_minus_1)                     # [B, K]
+            M = (jnp.eye(K, dtype=jnp.float32)
+                 + dhalf[:, :, None] * W * dhalf[:, None, :])
+            t = jnp.einsum("bks,bs->bk", Vq.astype(cd),
+                           bq_d.astype(cd),
+                           preferred_element_type=jnp.float32)  # V B^-1 b
+            z = _dual_system_solve(M, dhalf * t, K, solver)
+            s = jnp.einsum("bks,bk->bs", Vq.astype(cd),
+                           (dhalf * z).astype(cd),
+                           preferred_element_type=jnp.float32)
+            x = u - jnp.einsum("bs,rs->br", (s / denom).astype(cd),
+                               gram_q.astype(cd),
+                               preferred_element_type=jnp.float32)
+            return _scatter_rows(factors_out, rows, x)
+        A = G + jnp.einsum("bk,bkr,bks->brs", conf_minus_1.astype(cd),
+                           Vc, Vc,
+                           preferred_element_type=jnp.float32)
     else:
         A = jnp.einsum("bk,bkr,bks->brs", mask.astype(cd), Vc, Vc,
                        preferred_element_type=jnp.float32)
@@ -165,10 +221,7 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                        preferred_element_type=jnp.float32)
     A = A + reg[:, None, None] * eye
     x = spd_solve(A, b, method=solver, compute_dtype=compute_dtype)
-    # padding rows (rows == -1) scatter to a dummy tail row
-    safe_rows = jnp.where(rows < 0, factors_out.shape[0] - 1, rows)
-    return factors_out.at[safe_rows].set(x.astype(factors_out.dtype),
-                                         mode="drop")
+    return _scatter_rows(factors_out, rows, x)
 
 
 @functools.partial(
@@ -207,6 +260,18 @@ def _gram(factors):
     import jax.numpy as jnp
     return jnp.einsum("ir,is->rs", factors, factors,
                       preferred_element_type=jnp.float32)
+
+
+@functools.partial(__import__("jax").jit)
+def _gram_eig(factors):
+    """Gram + its eigendecomposition — computed ONCE per implicit
+    half-sweep and shared by every entity's Woodbury solve (the base
+    B = G + reg*I diagonalizes as Q diag(w + reg) Q^T for any reg)."""
+    import jax.numpy as jnp
+    G = jnp.einsum("ir,is->rs", factors, factors,
+                   preferred_element_type=jnp.float32)
+    w, q = jnp.linalg.eigh(G)
+    return G, w, q
 
 
 # ---------------------------------------------------------------------------
@@ -335,10 +400,13 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
                 item_batches[-1][2][:1, :1, :1])).ravel()[0])
         telemetry["upload_s"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
+    gram_of = _gram_eig if cfg.dual_solve == "auto" else _gram
     for it in range(cfg.iterations):
-        gram_v = _gram(V[:ratings.n_items]) if cfg.implicit_prefs else None
+        gram_v = gram_of(V[:ratings.n_items]) if cfg.implicit_prefs \
+            else None
         U = _run_side(user_batches, U, V, cfg, gram_v, lam_dev, alpha_dev)
-        gram_u = _gram(U[:ratings.n_users]) if cfg.implicit_prefs else None
+        gram_u = gram_of(U[:ratings.n_users]) if cfg.implicit_prefs \
+            else None
         V = _run_side(item_batches, V, U, cfg, gram_u, lam_dev, alpha_dev)
     if telemetry is not None:
         # hard sync again: the loop above only enqueues device work

@@ -311,3 +311,54 @@ def test_train_telemetry_phases():
         tel["iters_s"])
     np.testing.assert_allclose(m1.user_factors, m2.user_factors,
                                rtol=1e-5)
+
+
+def test_implicit_dual_solve_matches_primal():
+    """The implicit Woodbury route (eigendecomposed base + D^1/2-form
+    SMW, K < rank buckets) is exact algebra: factors must match the
+    primal normal-equation path through multiple alternations, including
+    negative (dislike) signals whose confidence enters without
+    preference."""
+    rng = np.random.default_rng(7)
+    n_u, n_i, nnz = 400, 120, 6000
+    ui = rng.integers(0, n_u, nnz)
+    ii = rng.integers(0, n_i, nnz)
+    vv = rng.integers(1, 6, nnz).astype(np.float32)
+    vv[rng.random(nnz) < 0.1] *= -1
+    r = RatingsCOO(ui, ii, vv, n_u, n_i)
+    kw = dict(rank=16, iterations=5, lam=0.05, seed=1,
+              implicit_prefs=True, alpha=0.8)
+    m_primal = als_train(r, ALSConfig(dual_solve="never", **kw))
+    m_dual = als_train(r, ALSConfig(dual_solve="auto", **kw))
+    scale = np.abs(m_primal.user_factors).max()
+    assert np.abs(m_primal.user_factors
+                  - m_dual.user_factors).max() < 1e-3 * scale
+    assert np.abs(m_primal.item_factors
+                  - m_dual.item_factors).max() < 1e-3 * scale
+
+
+@pytest.mark.parametrize("implicit,alpha", [(False, 1.0), (True, 20.0)])
+def test_dual_solve_large_k_buckets(implicit, alpha):
+    """Dual routes for buckets with K in the 32-128 range (power-of-two
+    padding below rank) must stay exact — the K-dim CG runs K+margin
+    iterations, not a fixed cap, and large Hu-Koren alpha makes the
+    Woodbury system genuinely ill-conditioned."""
+    rng = np.random.default_rng(11)
+    n_u, n_i, rank = 60, 500, 150
+    # each user rates 30-120 items -> K buckets 32/64/128, all < rank
+    ui, ii, vv = [], [], []
+    for u in range(n_u):
+        k = int(rng.integers(30, 120))
+        for i in rng.choice(n_i, size=k, replace=False):
+            ui.append(u)
+            ii.append(int(i))
+            vv.append(float(rng.integers(1, 6)))
+    r = RatingsCOO(np.array(ui), np.array(ii),
+                   np.array(vv, dtype=np.float32), n_u, n_i)
+    kw = dict(rank=rank, iterations=2, lam=0.05, seed=1,
+              implicit_prefs=implicit, alpha=alpha)
+    m_primal = als_train(r, ALSConfig(dual_solve="never", **kw))
+    m_dual = als_train(r, ALSConfig(dual_solve="auto", **kw))
+    scale = np.abs(m_primal.user_factors).max()
+    assert np.abs(m_primal.user_factors
+                  - m_dual.user_factors).max() < 2e-3 * scale
