@@ -583,6 +583,12 @@ def solver_ablation():
             ("cg_pallas + dual + bf16 tables",
              dict(solver="cg_pallas", dual_solve="auto",
                   factor_dtype="bfloat16")),
+            ("implicit cg_pallas primal",
+             dict(solver="cg_pallas", dual_solve="never",
+                  implicit_prefs=True)),
+            ("implicit cg_pallas + dual (eig-SMW)",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  implicit_prefs=True)),
         ]
     else:
         n_users, n_items, nnz, rank = 2_000, 500, 60_000, 32
@@ -590,6 +596,8 @@ def solver_ablation():
             ("cholesky primal", dict(solver="cholesky",
                                      dual_solve="never")),
             ("cg + dual", dict(solver="cg", dual_solve="auto")),
+            ("implicit cg + dual", dict(solver="cg", dual_solve="auto",
+                                        implicit_prefs=True)),
         ]
     ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
     ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
@@ -611,15 +619,27 @@ def solver_ablation():
             A._init_factors(n_users, rank, 1, 1).astype(dt))
         V = mesh.put_replicated(
             A._init_factors(n_items, rank, 1, 2).astype(dt))
+        imp = cfg.implicit_prefs
+        gram_of = ((A._gram_eig if cfg.dual_solve == "auto" else A._gram)
+                   if imp else None)
+
+        def run_iter(U, V):
+            # the conditional keeps the explicit timed path free of even
+            # the factor-slice dispatch the gram computation needs
+            U = A._run_side(user_batches, U, V, cfg,
+                            gram_of(V[:n_items]) if imp else None,
+                            lam, alpha)
+            V = A._run_side(item_batches, V, U, cfg,
+                            gram_of(U[:n_users]) if imp else None,
+                            lam, alpha)
+            return U, V
         try:
             # warmup (compile)
-            U = A._run_side(user_batches, U, V, cfg, None, lam, alpha)
-            V = A._run_side(item_batches, V, U, cfg, None, lam, alpha)
+            U, V = run_iter(U, V)
             float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])
             t0 = time.perf_counter()
             for _ in range(2):
-                U = A._run_side(user_batches, U, V, cfg, None, lam, alpha)
-                V = A._run_side(item_batches, V, U, cfg, None, lam, alpha)
+                U, V = run_iter(U, V)
             float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])
             dt_s = (time.perf_counter() - t0) / 2
             print(f"{name:34s}: {dt_s * 1000:9.1f} ms/iteration "

@@ -191,7 +191,9 @@ class ECommAlgorithm(P2LAlgorithm):
                         seed=p.seed if p.seed is not None else 0,
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype())
-        model = als_train(coo, cfg)
+        self.last_train_telemetry = {}
+        model = als_train(coo, cfg,
+                          telemetry=self.last_train_telemetry)
         item_categories = []
         for ix in range(len(item_ix)):
             item = td.items.get(item_ix.id_of(ix))

@@ -449,7 +449,9 @@ class MeshALSAlgorithm(ALSAlgorithm):
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype(),
                         factor_sharding="model")
-        model = als_train(pd.ratings_coo, cfg)
+        self.last_train_telemetry = {}
+        model = als_train(pd.ratings_coo, cfg,
+                          telemetry=self.last_train_telemetry)
         item_properties = None
         if pd.items is not None:
             item_properties = [pd.items.get(pd.item_ix.id_of(ix))

@@ -175,7 +175,9 @@ class RecommendedUserALSAlgorithm(P2LAlgorithm):
                         seed=p.seed if p.seed is not None else 0,
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype())
-        model = als_train(coo, cfg)
+        self.last_train_telemetry = {}
+        model = als_train(coo, cfg,
+                          telemetry=self.last_train_telemetry)
         return RecommendedUserModel(
             followed_factors_normalized=normalize_rows(model.item_factors),
             followed_ix=followed_ix)

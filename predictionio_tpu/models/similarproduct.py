@@ -283,7 +283,9 @@ class ALSAlgorithm(P2LAlgorithm):
                         seed=p.seed if p.seed is not None else 0,
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype())
-        model = als_train(coo, cfg)
+        self.last_train_telemetry = {}
+        model = als_train(coo, cfg,
+                          telemetry=self.last_train_telemetry)
         return SimilarProductModel(
             item_factors_normalized=normalize_rows(model.item_factors),
             **ItemMetadataModel.metadata_kwargs(td.items, item_ix))

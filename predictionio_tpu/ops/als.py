@@ -62,6 +62,12 @@ class ALSConfig:
     solver: str = "auto"  # see ops/solve.py spd_solve
     # auto = VMEM-resident CG Pallas kernel on TPU (XLA's batched cholesky
     # runs at ~0.05% MXU there), LAPACK cholesky on CPU.
+    solver_iters: Optional[int] = None  # primal CG iteration budget
+    # None = the solver default (48). The primal rank-dim CG can stall in
+    # ill-conditioned implicit configs (large alpha * |r| confidences);
+    # K<rank buckets are unaffected (the dual route solves a better-
+    # conditioned K-dim system exactly), but large-count entities ride
+    # the primal solver — raise this (or set solver='cholesky') there.
     dual_solve: str = "auto"  # 'auto' | 'never'
     # Woodbury/dual formulation for ALS buckets whose padded segment
     # length K < rank — exact algebra replacing the rank-dim solve with a
@@ -132,7 +138,8 @@ def _scatter_rows(factors_out, rows, x):
 def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                  lam, alpha, *, nratings_reg: bool, implicit: bool,
                  rank: int, compute_dtype: str, solver: str,
-                 dual_solve: str = "auto"):
+                 dual_solve: str = "auto",
+                 solver_iters: Optional[int] = None):
     """Solve one [B, K] batch of normal equations and scatter results into
     factors_out. Traced inside `_solve_sweep`'s scan body — gather ->
     einsum -> solve -> scatter fuse into one XLA program. Explicit batches
@@ -220,18 +227,20 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
         b = jnp.einsum("bk,bkr->br", (val * mask).astype(cd), Vc,
                        preferred_element_type=jnp.float32)
     A = A + reg[:, None, None] * eye
-    x = spd_solve(A, b, method=solver, compute_dtype=compute_dtype)
+    x = spd_solve(A, b, method=solver, iters=solver_iters,
+                  compute_dtype=compute_dtype)
     return _scatter_rows(factors_out, rows, x)
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
-                     "solver", "dual_solve"),
+                     "solver", "dual_solve", "solver_iters"),
     donate_argnums=(0,))
 def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
                  nratings_reg: bool, implicit: bool, rank: int,
-                 compute_dtype: str, solver: str, dual_solve: str = "auto"):
+                 compute_dtype: str, solver: str, dual_solve: str = "auto",
+                 solver_iters: Optional[int] = None):
     """One half-iteration in ONE dispatch: `groups` is a tuple of stacked
     same-shape batch groups (rows [N,B], idx/val/mask [N,B,K]); each group
     is consumed by a `lax.scan` over its leading dim, carrying the donated
@@ -247,7 +256,8 @@ def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
                          lam, alpha, nratings_reg=nratings_reg,
                          implicit=implicit, rank=rank,
                          compute_dtype=compute_dtype, solver=solver,
-                         dual_solve=dual_solve)
+                         dual_solve=dual_solve,
+                         solver_iters=solver_iters)
         return f, None
 
     for group in groups:
@@ -328,7 +338,7 @@ def _run_side(device_groups, factors, counter_factors, cfg: ALSConfig,
         nratings_reg=(cfg.lambda_scaling == "nratings"),
         implicit=cfg.implicit_prefs, rank=cfg.rank,
         compute_dtype=cfg.compute_dtype, solver=cfg.solver,
-        dual_solve=cfg.dual_solve)
+        dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters)
 
 
 def als_train(ratings: RatingsCOO, cfg: ALSConfig,

@@ -355,10 +355,17 @@ def test_dual_solve_large_k_buckets(implicit, alpha):
             vv.append(float(rng.integers(1, 6)))
     r = RatingsCOO(np.array(ui), np.array(ii),
                    np.array(vv, dtype=np.float32), n_u, n_i)
+    # Baseline: primal + exact cholesky. The dual route runs CG on its
+    # K-dim systems (solver='cg'; iters=K+8 — under the old min(48, K+8)
+    # cap the K=64/128 buckets under-solve and this fails). Notably the
+    # PRIMAL R-dim CG does NOT converge at alpha=20 (rel err ~0.24 vs
+    # cholesky) while the dual does (~1e-3): the dual route is also a
+    # numerical robustness improvement in the ill-conditioned regime.
     kw = dict(rank=rank, iterations=2, lam=0.05, seed=1,
               implicit_prefs=implicit, alpha=alpha)
-    m_primal = als_train(r, ALSConfig(dual_solve="never", **kw))
-    m_dual = als_train(r, ALSConfig(dual_solve="auto", **kw))
-    scale = np.abs(m_primal.user_factors).max()
-    assert np.abs(m_primal.user_factors
+    m_exact = als_train(r, ALSConfig(dual_solve="never",
+                                     solver="cholesky", **kw))
+    m_dual = als_train(r, ALSConfig(dual_solve="auto", solver="cg", **kw))
+    scale = np.abs(m_exact.user_factors).max()
+    assert np.abs(m_exact.user_factors
                   - m_dual.user_factors).max() < 2e-3 * scale

@@ -1,0 +1,100 @@
+"""Friend-recommendation example engines: keyword-similarity scoring
+matches the sparse-dot-product definition, SimRank holds its fixed-point
+invariants, and both run through the full DASE engine path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from examples.friend_recommendation import (FriendDataSource,
+                                            FriendDataSourceParams,
+                                            FriendQuery, HASH_DIM,
+                                            KeywordSimilarityAlgorithm,
+                                            SimRankAlgorithm, SimRankParams,
+                                            engine_params, keyword_engine,
+                                            simrank_engine)
+
+
+@pytest.fixture
+def data_files(tmp_path):
+    # keywords chosen < HASH_DIM and distinct mod HASH_DIM: the hashed
+    # dot product equals the exact sparse dot product
+    (tmp_path / "item.txt").write_text(
+        "i0 1 10;20;30\n"
+        "i1 1 40;50\n")
+    (tmp_path / "user_keyword.txt").write_text(
+        "u0 10:2;20:0.5\n"     # overlaps i0 on kw 10 (w=2) and 20 (w=0.5)
+        "u1 40:1\n"            # overlaps i1 on kw 40 only
+        "u2 99:3\n")           # overlaps nothing
+    (tmp_path / "user_action.txt").write_text(
+        "u0 u1 1\n"
+        "u1 u0 1\n"
+        "u1 u2 2\n")
+    return FriendDataSourceParams(
+        item_file=str(tmp_path / "item.txt"),
+        user_keyword_file=str(tmp_path / "user_keyword.txt"),
+        user_action_file=str(tmp_path / "user_action.txt"))
+
+
+class TestKeywordSimilarity:
+    def test_exact_sparse_dot(self, data_files):
+        trained = keyword_engine().train(engine_params(data_files))
+        algo, model = trained.algorithms[0], trained.models[0]
+        # u0 . i0 = 2*1 + 0.5*1 = 2.5 (item keyword weights are 1.0)
+        p = algo.predict(model, FriendQuery(user="u0", item="i0"))
+        assert p.confidence == pytest.approx(2.5)
+        assert p.acceptance          # 2.5 * 1.0 >= 1.0
+        p = algo.predict(model, FriendQuery(user="u1", item="i1"))
+        assert p.confidence == pytest.approx(1.0)
+        p = algo.predict(model, FriendQuery(user="u2", item="i0"))
+        assert p.confidence == 0.0 and not p.acceptance
+
+    def test_unseen_entities(self, data_files):
+        trained = keyword_engine().train(engine_params(data_files))
+        algo, model = trained.algorithms[0], trained.models[0]
+        p = algo.predict(model, FriendQuery(user="nope", item="i0"))
+        assert p.confidence == 0.0
+
+    def test_score_all_items_matches_pairs(self, data_files):
+        trained = keyword_engine().train(engine_params(data_files))
+        algo, model = trained.algorithms[0], trained.models[0]
+        row = algo.score_all_items(model, "u0")
+        assert row.shape == (2,)
+        assert row[model.item_ids["i0"]] == pytest.approx(2.5)
+        assert row[model.item_ids["i1"]] == pytest.approx(0.0)
+
+
+class TestSimRank:
+    def test_fixed_point_invariants(self, data_files):
+        trained = simrank_engine().train(engine_params(
+            data_files, SimRankParams(num_iterations=8, decay=0.8)))
+        algo, model = trained.algorithms[0], trained.models[0]
+        S = model.scores
+        n = S.shape[0]
+        assert np.allclose(np.diag(S), 1.0)          # self-similarity = 1
+        assert (S >= -1e-6).all()
+        off = S[~np.eye(n, dtype=bool)]
+        assert (off <= 0.8 + 1e-6).all()             # bounded by decay
+        p = algo.predict(model, FriendQuery(user="u0", item="u1"))
+        assert 0.0 <= p.confidence <= 0.8
+
+    def test_symmetric_graph_symmetric_scores(self, tmp_path):
+        (tmp_path / "item.txt").write_text("i0 1 10\n")
+        (tmp_path / "user_keyword.txt").write_text(
+            "u0 10:1\nu1 10:1\nu2 10:1\n")
+        # u2 (only) points at both u0 and u1: each has the single
+        # in-neighbor u2, so s(u0, u1) = decay * s(u2, u2) = decay
+        # (SimRank flows through IN-neighbors, Jeh & Widom definition)
+        (tmp_path / "user_action.txt").write_text(
+            "u2 u0 1\nu2 u1 1\n")
+        dsp = FriendDataSourceParams(
+            item_file=str(tmp_path / "item.txt"),
+            user_keyword_file=str(tmp_path / "user_keyword.txt"),
+            user_action_file=str(tmp_path / "user_action.txt"))
+        trained = simrank_engine().train(engine_params(
+            dsp, SimRankParams(num_iterations=10, decay=0.6)))
+        model = trained.models[0]
+        a, b = model.user_ids["u0"], model.user_ids["u1"]
+        assert model.scores[a, b] == pytest.approx(0.6, abs=1e-5)
+        assert np.allclose(model.scores, model.scores.T, atol=1e-6)
