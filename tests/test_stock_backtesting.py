@@ -1,0 +1,137 @@
+"""Stock backtesting example: indicator math, batched OLS vs numpy
+lstsq, and portfolio accounting on hand-checkable scenarios."""
+
+import numpy as np
+import pytest
+
+from examples.stock_backtesting import (BacktestingParams, EMAReturn,
+                                        PriceFrame, RSI,
+                                        RegressionStrategy,
+                                        RegressionStrategyParams,
+                                        ShiftReturn, _batched_ols,
+                                        backtest, synthetic_prices)
+
+
+class TestIndicators:
+    def test_shift_return(self):
+        lp = np.log(np.array([[1.0], [2.0], [4.0], [8.0]], np.float32))
+        out = ShiftReturn(2).compute(lp)
+        assert out[0, 0] == 0.0 and out[1, 0] == 0.0
+        assert out[2, 0] == pytest.approx(np.log(4.0), rel=1e-5)
+        assert out[3, 0] == pytest.approx(np.log(4.0), rel=1e-5)
+
+    def test_rsi_bounds_and_direction(self):
+        rng = np.random.default_rng(0)
+        lp = np.cumsum(rng.standard_normal((200, 3)) * 0.01, axis=0)
+        out = RSI(14).compute(lp.astype(np.float32))
+        assert (out >= 0).all() and (out <= 1).all()
+        up = np.cumsum(np.full((100, 1), 0.01, np.float32), axis=0)
+        assert RSI(14).compute(up)[-1, 0] > 0.99   # all gains -> RSI ~ 1
+
+    def test_ema_converges_to_constant_return(self):
+        lp = np.cumsum(np.full((300, 1), 0.02, np.float32), axis=0)
+        out = EMAReturn(10).compute(lp)
+        assert out[-1, 0] == pytest.approx(0.02, rel=1e-3)
+
+
+class TestBatchedOLS:
+    def test_matches_numpy_lstsq_per_ticker(self):
+        rng = np.random.default_rng(1)
+        N, W, F = 5, 80, 4
+        X = rng.standard_normal((N, W, F)).astype(np.float32)
+        true = rng.standard_normal((N, F)).astype(np.float32)
+        y = np.einsum("nwf,nf->nw", X, true) \
+            + 0.01 * rng.standard_normal((N, W)).astype(np.float32)
+        coefs = _batched_ols(X, y)
+        for n in range(N):
+            ref, *_ = np.linalg.lstsq(X[n], y[n], rcond=None)
+            np.testing.assert_allclose(coefs[n], ref, atol=2e-3)
+
+
+class TestStrategy:
+    def test_recovers_planted_signal(self):
+        """If next-day return IS a linear function of an indicator, the
+        strategy must recover it and rank tickers correctly."""
+        rng = np.random.default_rng(2)
+        T, N = 300, 4
+        sig = rng.standard_normal((T, N)).astype(np.float32) * 0.01
+        rets = np.zeros((T, N), np.float32)
+        rets[1:] = 2.0 * sig[:-1]       # tomorrow's ret = 2 * today's sig
+        prices = 100 * np.exp(np.cumsum(rets, axis=0))
+        frame = PriceFrame(("SPY", "A", "B", "C"), prices)
+
+        class SigIndicator:
+            min_window = 1
+
+            def compute(self, lp):
+                return sig
+
+        strat = RegressionStrategy(RegressionStrategyParams(
+            indicators=(("sig", SigIndicator()),), training_window=200))
+        model = strat.train(frame, 250)
+        # planted coefficient ~2, bias ~0, for every ticker
+        np.testing.assert_allclose(model.coefs[:, 0], 2.0, atol=0.05)
+        p = strat.predict(model, frame, 260)
+        order = sorted(p, key=p.get)
+        expect = sorted(range(N), key=lambda n: sig[260, n])
+        assert [frame.tickers[i] for i in expect] == order
+
+
+class TestBacktest:
+    def test_portfolio_accounting_rising_market(self):
+        """Deterministic rising prices: an always-enter strategy must
+        track the asset's growth exactly (NAV = shares * price)."""
+        T = 60
+        prices = np.stack([np.full(T, 100.0, np.float32),
+                           100 * 1.01 ** np.arange(T, dtype=np.float32)],
+                          axis=1)
+        frame = PriceFrame(("SPY", "UP"), prices)
+
+        class AlwaysUp(RegressionStrategy):
+            def train(self, frame, end_t):
+                return None
+
+            def predict(self, model, frame, t):
+                return {"SPY": -1.0, "UP": 1.0}
+
+        res = backtest(frame, AlwaysUp(),
+                       BacktestingParams(enter_threshold=0.5,
+                                         max_positions=1),
+                       start_t=10, end_t=50)
+        # entered at t=10 with all cash, held to the end
+        expected = prices[49, 1] / prices[10, 1] - 1.0
+        assert res.ret == pytest.approx(expected, rel=1e-5)
+        assert res.max_drawdown == pytest.approx(0.0, abs=1e-6)
+        assert all(d.position_count == 1 for d in res.daily)
+
+    def test_exit_returns_to_cash(self):
+        T = 40
+        prices = np.stack([np.full(T, 100.0, np.float32),
+                           np.full(T, 50.0, np.float32)], axis=1)
+        frame = PriceFrame(("SPY", "X"), prices)
+
+        class EnterThenExit(RegressionStrategy):
+            def train(self, frame, end_t):
+                return None
+
+            def predict(self, model, frame, t):
+                return {"SPY": -1.0, "X": 1.0 if t < 20 else -1.0}
+
+        res = backtest(frame, EnterThenExit(),
+                       BacktestingParams(enter_threshold=0.5),
+                       start_t=10, end_t=30)
+        assert res.daily[-1].position_count == 0
+        assert res.ret == pytest.approx(0.0, abs=1e-6)  # flat prices
+
+    def test_end_to_end_runs(self):
+        frame = synthetic_prices(n_days=300, n_tickers=6, seed=1)
+        res = backtest(frame, RegressionStrategy(),
+                       BacktestingParams(), start_t=250, end_t=290)
+        assert res.days == 40
+        assert np.isfinite(res.sharpe) and np.isfinite(res.vol)
+        assert 0.0 <= res.max_drawdown < 1.0
+
+    def test_empty_training_window_raises(self):
+        frame = synthetic_prices(n_days=100, n_tickers=4, seed=0)
+        with pytest.raises(ValueError, match="warmup"):
+            RegressionStrategy().train(frame, 20)
