@@ -10,7 +10,7 @@ parallel.mesh.init_distributed).
 Verbs: version, status, build, train, eval, deploy, undeploy, eventserver,
 dashboard, adminserver, app {new,list,show,delete,data-delete,channel-new,
 channel-delete}, accesskey {new,list,delete}, template {list,get}, export,
-import, run.
+import, trim, run.
 """
 
 from __future__ import annotations
@@ -311,6 +311,28 @@ def cmd_import(args) -> int:
     return 0
 
 
+def cmd_trim(args) -> int:
+    """Copy a time window of events into a fresh app (the trim-app
+    workflow: keep only a recent window under a new app id)."""
+    from predictionio_tpu.data.event import parse_event_time
+    from predictionio_tpu.tools.export_import import trim_events
+    try:
+        n = trim_events(
+            args.src_appid, args.dst_appid,
+            start_time=(parse_event_time(args.start)
+                        if args.start else None),
+            until_time=(parse_event_time(args.until)
+                        if args.until else None),
+            src_channel_id=args.src_channelid,
+            dst_channel_id=args.dst_channelid)
+    except ValueError as e:
+        _print(f"Error: {e}")
+        return 1
+    _print(f"Trimmed {n} events from app {args.src_appid} into app "
+           f"{args.dst_appid}.")
+    return 0
+
+
 def cmd_run(args) -> int:
     """(Console run — execute a main class/module in the pio environment)"""
     import runpy
@@ -466,6 +488,15 @@ def build_parser() -> argparse.ArgumentParser:
     im.add_argument("--input", required=True)
     im.add_argument("--channelid", type=int)
     im.set_defaults(func=cmd_import)
+
+    tr = sub.add_parser("trim")
+    tr.add_argument("--src-appid", type=int, required=True)
+    tr.add_argument("--dst-appid", type=int, required=True)
+    tr.add_argument("--start", help="ISO8601; keep events at/after this")
+    tr.add_argument("--until", help="ISO8601; keep events before this")
+    tr.add_argument("--src-channelid", type=int)
+    tr.add_argument("--dst-channelid", type=int)
+    tr.set_defaults(func=cmd_trim)
 
     r = sub.add_parser("run")
     r.add_argument("main_py")

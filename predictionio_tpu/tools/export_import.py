@@ -26,26 +26,70 @@ def export_events(app_id: int, output: str,
     return n
 
 
-def import_events(app_id: int, input_path: str,
-                  channel_id: Optional[int] = None,
-                  batch_size: int = 10000, validate: bool = True) -> int:
+def _insert_batched(event_iter, app_id: int,
+                    channel_id: Optional[int], batch_size: int) -> int:
+    """Chunked insert_batch over an event iterator; returns the count."""
     events = Storage.get_events()
     batch = []
     n = 0
-    with open(input_path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            e = Event.from_json(line)
-            if validate:
-                EventValidation.validate(e)
-            batch.append(e)
-            if len(batch) >= batch_size:
-                events.insert_batch(batch, app_id, channel_id)
-                n += len(batch)
-                batch = []
+    for e in event_iter:
+        batch.append(e)
+        if len(batch) >= batch_size:
+            events.insert_batch(batch, app_id, channel_id)
+            n += len(batch)
+            batch = []
     if batch:
         events.insert_batch(batch, app_id, channel_id)
         n += len(batch)
     return n
+
+
+def import_events(app_id: int, input_path: str,
+                  channel_id: Optional[int] = None,
+                  batch_size: int = 10000, validate: bool = True) -> int:
+    def parsed():
+        with open(input_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                e = Event.from_json(line)
+                if validate:
+                    EventValidation.validate(e)
+                yield e
+
+    return _insert_batched(parsed(), app_id, channel_id, batch_size)
+
+
+def trim_events(src_app_id: int, dst_app_id: int,
+                start_time=None, until_time=None,
+                src_channel_id: Optional[int] = None,
+                dst_channel_id: Optional[int] = None,
+                batch_size: int = 10000) -> int:
+    """Copy the [start_time, until_time) window of a source app's events
+    into an EMPTY destination app — the trim workflow (keep only a recent
+    window under a fresh app id). Both apps must be registered; the
+    destination must be empty in EVERY channel, as the reference requires
+    (reference: examples/experimental/scala-parallel-trim-app/src/main/
+    scala/DataSource.scala:44-47)."""
+    apps = Storage.get_meta_data_apps()
+    for label, aid in (("source", src_app_id), ("destination", dst_app_id)):
+        if apps.get(aid) is None:
+            raise ValueError(f"{label} app {aid} does not exist; create "
+                             f"it with `pio app new` first")
+    events = Storage.get_events()
+    dst_channels = [None] + [
+        c.id for c in Storage.get_meta_data_channels()
+        .get_by_app_id(dst_app_id)]
+    for ch in dst_channels:
+        if next(iter(events.find(app_id=dst_app_id, channel_id=ch,
+                                 limit=1)), None):
+            where = "default channel" if ch is None else f"channel {ch}"
+            raise ValueError(
+                f"destination app {dst_app_id} is not empty ({where}); "
+                f"trim writes only into a fresh app")
+    events.init(dst_app_id, dst_channel_id)
+    return _insert_batched(
+        events.find(app_id=src_app_id, channel_id=src_channel_id,
+                    start_time=start_time, until_time=until_time),
+        dst_app_id, dst_channel_id, batch_size)

@@ -98,6 +98,46 @@ class TestExportImport:
         assert e0.properties.get("rating", float) == 3.0
 
 
+class TestTrim:
+    def test_trim_window_into_fresh_app(self, tmp_env, capsys):
+        """pio trim copies only the [start, until) window and refuses a
+        non-empty destination (the reference trim-app contract)."""
+        import datetime as dt
+        UTC = dt.timezone.utc
+        src = ac.app_new("trimsrc")
+        ev = Storage.get_events()
+        for i in range(10):
+            ev.insert(Event(event="rate", entity_type="user",
+                            entity_id=f"u{i}",
+                            event_time=dt.datetime(2026, 1, 1, 0, 0, i,
+                                                   tzinfo=UTC)),
+                      src.app.id)
+        dst = ac.app_new("trimdst")
+        assert cli_main(["trim", "--src-appid", str(src.app.id),
+                         "--dst-appid", str(dst.app.id),
+                         "--start", "2026-01-01T00:00:03.000Z",
+                         "--until", "2026-01-01T00:00:07.000Z"]) == 0
+        assert "Trimmed 4 events" in capsys.readouterr().out
+        got = sorted(e.entity_id for e in ev.find(dst.app.id))
+        assert got == ["u3", "u4", "u5", "u6"]
+        # destination now non-empty: a second trim refuses
+        assert cli_main(["trim", "--src-appid", str(src.app.id),
+                         "--dst-appid", str(dst.app.id)]) == 1
+        assert "not empty" in capsys.readouterr().out
+        # unregistered apps fail fast
+        assert cli_main(["trim", "--src-appid", str(src.app.id),
+                         "--dst-appid", "99"]) == 1
+        assert "does not exist" in capsys.readouterr().out
+        # dirt hiding in a NON-default channel still counts as non-empty
+        dst2 = ac.app_new("trimdst2")
+        ch = ac.channel_new("trimdst2", "side")
+        ev.insert(Event(event="buy", entity_type="user", entity_id="x"),
+                  dst2.app.id, ch.id)
+        assert cli_main(["trim", "--src-appid", str(src.app.id),
+                         "--dst-appid", str(dst2.app.id)]) == 1
+        assert "not empty" in capsys.readouterr().out
+
+
 class TestCLI:
     def test_version_status_build(self, tmp_env, tmp_path, capsys):
         assert cli_main(["version"]) == 0
