@@ -24,6 +24,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstdio>
+#include <unistd.h>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -139,14 +140,30 @@ void* el_open(const char* path) {
     delete h;
     return nullptr;
   }
-  // build index by scanning
+  // build index by scanning; a record extending past EOF is a torn tail
+  // (crash mid-append) — drop it by truncating to the last clean record
+  // boundary, otherwise its stale index entry would read the bytes of
+  // whatever is appended next (fseeko past EOF "succeeds", so the
+  // extent check against the real size is required)
+  fseeko(h->f, 0, SEEK_END);
+  uint64_t fsize = (uint64_t)ftello(h->f);
   fseeko(h->f, 0, SEEK_SET);
   RecordHeader rh;
   std::vector<char> key;
+  uint64_t clean_end = 0;
+  bool torn = false;
   while (true) {
     uint64_t off = (uint64_t)ftello(h->f);
-    if (!read_exact(h->f, &rh, sizeof(rh))) break;
+    clean_end = off;
+    if (off >= fsize) break;                    // clean EOF
+    if (off + sizeof(rh) > fsize) { torn = true; break; }
+    if (!read_exact(h->f, &rh, sizeof(rh))) break;  // mid-file IO error
+    if (off + sizeof(rh) + rh.keylen + rh.datalen > fsize) {
+      torn = true;
+      break;
+    }
     key.resize(rh.keylen);
+    // extent-checked above: a short read here is a real IO error
     if (rh.keylen && !read_exact(h->f, key.data(), rh.keylen)) break;
     if (fseeko(h->f, rh.datalen, SEEK_CUR) != 0) break;
     std::string k(key.data(), rh.keylen);
@@ -158,6 +175,25 @@ void* el_open(const char* path) {
       h->index[k] = IndexEntry{off, rh.datalen, rh.ts, rh.entity_hash,
                                rh.name_hash, rh.target_hash, false};
       if (!existed) h->order.push_back(k);
+    }
+  }
+  if (clean_end < fsize) {
+    if (!torn) {
+      // mid-file read error (flaky disk/NFS), NOT a torn tail: the
+      // bytes past clean_end may be perfectly valid records —
+      // truncating would destroy them, and appending would corrupt
+      // the index. Fail closed; a retry on a healthy mount recovers.
+      fclose(h->f);
+      delete h;
+      return nullptr;
+    }
+    fflush(h->f);
+    if (ftruncate(fileno(h->f), (off_t)clean_end) != 0) {
+      // cannot repair the tear (read-only fs?): appends would
+      // interleave with the torn bytes, so fail closed
+      fclose(h->f);
+      delete h;
+      return nullptr;
     }
   }
   fseeko(h->f, 0, SEEK_END);
