@@ -1,0 +1,147 @@
+"""Typed params extraction matrix — the JsonExtractorSuite analog
+(reference: core/src/test/scala/io/prediction/workflow/
+JsonExtractorSuite.scala: the Scala/Java extraction matrix becomes a
+dataclass-annotation validation matrix). Wrong engine.json types must
+fail AT THE BOUNDARY with the field named, not deep inside a kernel."""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import pytest
+
+from predictionio_tpu.core.params import (Params, params_from_dict,
+                                          params_from_json)
+
+
+@dataclass(frozen=True)
+class P(Params):
+    name: str
+    rank: int = 10
+    lam: float = 0.01
+    verbose: bool = False
+    events: Tuple[str, ...] = ("rate",)
+    blacklist: Optional[Tuple[str, ...]] = None
+    channel: Optional[str] = None
+    extras: Optional[Dict[str, int]] = None
+
+
+class TestHappyPath:
+    def test_required_and_defaults(self):
+        p = params_from_dict(P, {"name": "x"})
+        assert p == P(name="x")
+
+    def test_all_fields(self):
+        p = params_from_dict(P, {
+            "name": "x", "rank": 20, "lam": 0.5, "verbose": True,
+            "events": ["rate", "buy"], "blacklist": ["i1"],
+            "channel": "ch", "extras": {"a": 1}})
+        assert p.rank == 20 and p.events == ("rate", "buy")
+        assert p.blacklist == ("i1",)
+
+    def test_json_arrays_become_tuples(self):
+        # JSON has no tuples; engine.json arrays land as tuples so frozen
+        # params stay hashable
+        p = params_from_dict(P, {"name": "x", "events": ["a", "b"]})
+        assert isinstance(p.events, tuple)
+        hash(p)   # must not raise
+
+    def test_int_widens_to_float_and_integral_float_narrows(self):
+        p = params_from_dict(P, {"name": "x", "lam": 1})
+        assert p.lam == 1.0 and isinstance(p.lam, float)
+        p = params_from_dict(P, {"name": "x", "rank": 10.0})
+        assert p.rank == 10 and isinstance(p.rank, int)
+
+    def test_optional_accepts_null(self):
+        p = params_from_dict(P, {"name": "x", "blacklist": None,
+                                 "channel": None})
+        assert p.blacklist is None and p.channel is None
+
+    def test_from_json(self):
+        p = params_from_json(P, '{"name": "x", "rank": 3}')
+        assert p.rank == 3
+
+
+class TestRejections:
+    def test_unknown_field(self):
+        with pytest.raises(ValueError, match="Unknown parameter"):
+            params_from_dict(P, {"name": "x", "nope": 1})
+
+    def test_missing_required(self):
+        with pytest.raises(ValueError, match="Missing required"):
+            params_from_dict(P, {"rank": 3})
+
+    def test_string_for_int_names_the_field(self):
+        with pytest.raises(ValueError, match=r"P\.rank.*expected an int"):
+            params_from_dict(P, {"name": "x", "rank": "10"})
+
+    def test_non_integral_float_for_int(self):
+        with pytest.raises(ValueError, match=r"P\.rank"):
+            params_from_dict(P, {"name": "x", "rank": 10.5})
+
+    def test_bool_is_not_an_int(self):
+        with pytest.raises(ValueError, match=r"P\.rank"):
+            params_from_dict(P, {"name": "x", "rank": True})
+
+    def test_int_is_not_a_bool(self):
+        with pytest.raises(ValueError, match=r"P\.verbose"):
+            params_from_dict(P, {"name": "x", "verbose": 1})
+
+    def test_number_for_str(self):
+        with pytest.raises(ValueError, match=r"P\.name.*expected a str"):
+            params_from_dict(P, {"name": 5})
+
+    def test_scalar_for_tuple(self):
+        with pytest.raises(ValueError, match=r"P\.events.*array"):
+            params_from_dict(P, {"name": "x", "events": "rate"})
+
+    def test_bad_tuple_element_names_the_index(self):
+        with pytest.raises(ValueError, match=r"P\.events\[1\]"):
+            params_from_dict(P, {"name": "x", "events": ["rate", 3]})
+
+    def test_null_for_non_optional(self):
+        with pytest.raises(ValueError, match=r"P\.rank"):
+            params_from_dict(P, {"name": "x", "rank": None})
+
+
+class TestTemplateParams:
+    def test_engine_json_shapes_still_extract(self):
+        """The real template params accept their documented engine.json
+        blocks (arrays for tuple fields, null for optionals)."""
+        from predictionio_tpu.models import recommendation as R
+        p = params_from_dict(R.DataSourceParams, {
+            "app_name": "MyApp", "event_names": ["rate", "buy"],
+            "channel_name": None, "buy_rating": 4})
+        assert p.event_names == ("rate", "buy")
+        assert p.buy_rating == 4.0
+        with pytest.raises(ValueError, match="event_names"):
+            params_from_dict(R.DataSourceParams,
+                             {"app_name": "a", "event_names": "rate"})
+
+
+class TestModernAnnotations:
+    def test_pep604_union_is_validated(self):
+        @dataclass(frozen=True)
+        class Q(Params):
+            eval_k: "int | None" = None
+
+        assert params_from_dict(Q, {"eval_k": 5}).eval_k == 5
+        assert params_from_dict(Q, {"eval_k": None}).eval_k is None
+        with pytest.raises(ValueError, match=r"Q\.eval_k"):
+            params_from_dict(Q, {"eval_k": "5"})
+
+    def test_unresolvable_annotation_warns_not_crashes(self, caplog):
+        @dataclass(frozen=True)
+        class Bad(Params):
+            x: "NoSuchType" = None  # noqa: F821
+
+        import logging
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.core.params"):
+            p = params_from_dict(Bad, {"x": 1})
+        assert p.x == 1
+        assert "without type validation" in caplog.text
+
+    def test_union_error_message_not_duplicated(self):
+        with pytest.raises(ValueError) as ei:
+            params_from_dict(P, {"name": "x", "blacklist": 5})
+        assert str(ei.value).count("P.blacklist") == 1
