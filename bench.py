@@ -510,7 +510,8 @@ def device_alive(timeout_s: float = 240.0):
     """Watchdog: the tunneled chip can hang indefinitely (observed: even
     an 8-float device_put blocks forever when the tunnel is down). Probe
     backend init + one device round trip in a daemon thread; on timeout
-    the caller emits an error line instead of hanging the driver."""
+    the caller falls back to a CPU smoke run instead of hanging the
+    driver."""
     import threading
     result = []
 
@@ -527,15 +528,36 @@ def device_alive(timeout_s: float = 240.0):
     return result[0] if result else None
 
 
+def _emit_error(msg: str, code: int = 1):
+    """The harness contract is ONE parseable JSON line even on failure;
+    flush before os._exit (which skips buffer flushing) so a piped
+    driver actually receives it."""
+    print(json.dumps({
+        "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
+        "value": 0, "unit": "ratings/s/chip", "vs_baseline": 0,
+        "error": msg}), flush=True)
+    os._exit(code)
+
+
 def main():
-    backend = device_alive()
+    simulate_dead = (os.environ.get("PIO_BENCH_SIMULATE_DEAD_DEVICE")
+                     and not os.environ.get("PIO_BENCH_CPU_FALLBACK"))
+    backend = None if simulate_dead else device_alive()
     if backend is None:
-        print(json.dumps({
-            "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
-            "value": 0, "unit": "ratings/s/chip", "vs_baseline": 0,
-            "error": "device unreachable: backend init / device round trip "
-                     "did not complete within 240s (tunnel down?)"}))
-        os._exit(1)
+        if os.environ.get("PIO_BENCH_CPU_FALLBACK"):
+            # CPU fallback also dead: nothing left to measure
+            _emit_error("device unreachable even in CPU fallback")
+        # the hung axon backend is latched into this process; re-exec
+        # with a CPU-forced environment so the run still produces an
+        # honest (clearly labeled) smoke measurement instead of a zero
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="", PIO_BENCH_CPU_FALLBACK="1")
+        rc = subprocess.call(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env)
+        sys.stdout.flush()
+        os._exit(rc if 0 <= rc < 128 else 1)   # signal deaths -> plain 1
     full_scale = backend not in ("cpu",)
     als_stats, model = bench_als(full_scale)
     rest_stats = bench_rest_latency(model)
@@ -556,6 +578,9 @@ def main():
         **{k: round(v, 3) for k, v in rest_stats.items()},
         **product_stats,
     }
+    if os.environ.get("PIO_BENCH_CPU_FALLBACK"):
+        out["note"] = ("TPU tunnel unreachable; CPU smoke-mode fallback "
+                       "(full_scale=false, NOT a chip measurement)")
     print(json.dumps(out))
 
 
@@ -659,8 +684,4 @@ if __name__ == "__main__":
     try:
         main()
     except Exception as e:  # emit a parseable line even on env failure
-        print(json.dumps({
-            "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
-            "value": 0, "unit": "ratings/s/chip", "vs_baseline": 0,
-            "error": f"{type(e).__name__}: {e}"}))
-        raise SystemExit(1)
+        _emit_error(f"{type(e).__name__}: {e}")
