@@ -556,8 +556,12 @@ def main():
         rc = subprocess.call(
             [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
             env=env)
+        if not 0 <= rc < 128:
+            # child died by signal (segfault/OOM): its own error handler
+            # never ran, so the contractual JSON line must come from here
+            _emit_error(f"CPU fallback child died with rc={rc}")
         sys.stdout.flush()
-        os._exit(rc if 0 <= rc < 128 else 1)   # signal deaths -> plain 1
+        os._exit(rc)
     full_scale = backend not in ("cpu",)
     als_stats, model = bench_als(full_scale)
     rest_stats = bench_rest_latency(model)
