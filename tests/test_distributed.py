@@ -64,6 +64,35 @@ print(f"OK proc {pid}")
 """)
 
 
+SERVE_PROG = textwrap.dedent("""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+from predictionio_tpu.parallel.mesh import init_distributed, make_mesh
+import numpy as np
+init_distributed()
+pid = jax.process_index()
+assert jax.device_count() == 8, jax.device_count()
+mesh = make_mesh(model_parallelism=2)
+from predictionio_tpu.ops.als import ALSModel, recommend_products_sharded
+rng = np.random.default_rng(5)
+model = ALSModel(rng.standard_normal((30, 6)).astype(np.float32),
+                 rng.standard_normal((20, 6)).astype(np.float32), 6)
+ref = np.load(os.environ["PIO_TEST_REF_NPZ"])
+# every process runs the SPMD query; factor tables stay model-sharded
+for qi, user_ix in enumerate((0, 7, 29)):
+    scores, idx = recommend_products_sharded(model, user_ix, k=5,
+                                             mesh=mesh)
+    np.testing.assert_allclose(np.asarray(scores), ref[f"s{qi}"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), ref[f"i{qi}"])
+print(f"OK proc {pid}")
+""")
+
+
 def _run_two_procs(prog, extra_env, port):
     procs = []
     for pid in range(2):
@@ -113,3 +142,29 @@ def test_two_process_als_matches_single_process(tmp_path, mesh8):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     _run_two_procs(ALS_PROG % {"repo": repo},
                    {"PIO_TEST_REF_NPZ": ref_path}, 19879)
+
+
+@pytest.mark.timeout(300)
+def test_two_process_sharded_serving_matches_host(tmp_path):
+    """The P-model serve path (factor tables model-sharded, two-phase
+    sharded top-k) answers identically when the mesh spans 2 real
+    processes — the serve analog of the reference's distributed-model
+    RDD.lookup (controller/PAlgorithm.scala:44-125)."""
+    import numpy as np
+
+    # host-side ground truth: plain dense scoring
+    rng = np.random.default_rng(5)
+    U = rng.standard_normal((30, 6)).astype(np.float32)
+    V = rng.standard_normal((20, 6)).astype(np.float32)
+    ref = {}
+    for qi, user_ix in enumerate((0, 7, 29)):
+        scores = V @ U[user_ix]
+        order = np.argsort(-scores, kind="stable")[:5]
+        ref[f"s{qi}"] = scores[order].astype(np.float32)
+        ref[f"i{qi}"] = order.astype(np.int32)
+    ref_path = str(tmp_path / "serve_ref.npz")
+    np.savez(ref_path, **ref)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _run_two_procs(SERVE_PROG % {"repo": repo},
+                   {"PIO_TEST_REF_NPZ": ref_path}, 19881)
