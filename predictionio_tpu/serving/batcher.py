@@ -42,10 +42,28 @@ class MicroBatcher:
         self.process_batch = process_batch
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
+        # realized coalescing telemetry (read via /stats.json): whether
+        # concurrent load actually forms full batches is THE datum for
+        # tuning micro_batch_wait_ms on a given link
+        self.n_batches = 0
+        self.n_queries = 0
+        self.max_batch_seen = 0
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def stats(self) -> dict:
+        # the counters are updated together by the dispatch thread just
+        # before each process_batch call; snapshotting queries BEFORE
+        # batches keeps the derived average internally consistent
+        # (avg <= max_batch) even when a batch lands mid-read
+        nq = self.n_queries
+        nb = self.n_batches
+        mx = self.max_batch_seen
+        return {"batches": nb, "batchedQueries": nq,
+                "avgBatchSize": (nq / nb if nb else 0.0),
+                "maxBatchSize": mx}
 
     def submit(self, query) -> Any:
         """Blocking: enqueue and wait for the batched result."""
@@ -84,6 +102,9 @@ class MicroBatcher:
                         batch.append(self._q.get(timeout=remaining))
                     except queue.Empty:
                         break
+            self.n_batches += 1
+            self.n_queries += len(batch)
+            self.max_batch_seen = max(self.max_batch_seen, len(batch))
             try:
                 results = self.process_batch([p.query for p in batch])
                 if len(results) != len(batch):

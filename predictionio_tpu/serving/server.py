@@ -291,14 +291,19 @@ class EngineServer:
         serve/HTTP overhead."""
         with self._lock:
             n = self.request_count
-            return Response(200, {
+            out = {
                 "requestCount": n,
                 "avgServingSec": self.serving_seconds / n if n else 0.0,
                 "lastServingSec": self.last_serving_sec,
                 "avgPredictSec": self.predict_seconds / n if n else 0.0,
                 "microBatch": self.config.micro_batch,
                 "startTime": self.start_time.isoformat(),
-            })
+            }
+            if self.batcher is not None:
+                # realized coalescing (avg/max batch size) — the datum
+                # for tuning micro_batch_wait_ms on a given link
+                out.update(self.batcher.stats())
+            return Response(200, out)
 
     def _profile(self, req: Request) -> Response:
         """jax.profiler trace control — beyond-parity observability

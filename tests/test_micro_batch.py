@@ -94,6 +94,20 @@ class TestMicroBatchedServer:
         yield s
         s.stop()
 
+    def test_server_stats_include_batching(self, server):
+        with ThreadPoolExecutor(4) as ex:
+            list(ex.map(lambda i: urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{server.config.port}/queries.json",
+                    data=json.dumps({"user": "u1", "num": 2}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST"), timeout=30).read(), range(8)))
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.config.port}/stats.json",
+            timeout=10).read())
+        assert stats["batchedQueries"] >= 8
+        assert stats["avgBatchSize"] > 0
+
     def test_concurrent_queries_correct_per_user(self, server):
         def ask(u):
             req = urllib.request.Request(
@@ -164,3 +178,19 @@ class TestBatchingWindow:
         # the window (40 ms) covers the 30 ms arrival spread: everything
         # after the first dispatch coalesces into very few batches
         assert len(batches) <= 4, batches
+
+
+class TestBatcherStats:
+    def test_stats_counts_and_surfaces(self):
+        import time
+        b = MicroBatcher(lambda qs: (time.sleep(0.02), list(qs))[1],
+                         max_batch=8, max_wait_ms=20)
+        with ThreadPoolExecutor(8) as ex:
+            list(ex.map(b.submit, range(12)))
+        s = b.stats()
+        b.stop()
+        assert s["batchedQueries"] == 12
+        assert s["batches"] >= 1
+        assert s["avgBatchSize"] == pytest.approx(12 / s["batches"])
+        assert 1 <= s["maxBatchSize"] <= 8
+
