@@ -35,7 +35,7 @@ import threading
 import urllib.parse
 from typing import Dict, List, Optional, Sequence
 
-from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.event import Event, to_millis
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import ABSENT
 
@@ -235,10 +235,12 @@ class RemoteEvents(base.Events):
         return t.astimezone(dt.timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
 
-    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
-             entity_type=None, entity_id=None, event_names=None,
-             target_entity_type=None, target_entity_id=None, limit=None,
-             reversed_order=False):
+    PAGE_SIZE = 10_000  # unbounded reads paginate (one giant JSON body
+    #                     for a 20M-event store would OOM both sides)
+
+    def _find_params(self, app_id, channel_id, start_time, until_time,
+                     entity_type, entity_id, event_names,
+                     target_entity_type, target_entity_id):
         params = self._params(app_id, channel_id)
         if start_time is not None:
             params["startTime"] = self._iso(start_time)
@@ -256,12 +258,72 @@ class RemoteEvents(base.Events):
         if target_entity_id is not None:
             params["targetEntityId"] = (
                 "" if target_entity_id is ABSENT else target_entity_id)
-        params["limit"] = -1 if limit is None else limit
-        if reversed_order:
-            params["reversed"] = "true"
+        return params
+
+    def _fetch(self, params):
         status, body = self._request("GET", "/events.json", params)
         if status == 404:
-            return iter(())
+            return []
         if status != 200:
             raise RemoteError(status, (body or {}).get("message", ""))
-        return iter([Event.from_dict(d) for d in body])
+        return [Event.from_dict(d) for d in body]
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None, limit=None,
+             reversed_order=False):
+        base = self._find_params(app_id, channel_id, start_time, until_time,
+                                 entity_type, entity_id, event_names,
+                                 target_entity_type, target_entity_id)
+        unbounded = limit is None or limit < 0
+        if reversed_order or (not unbounded and limit <= self.PAGE_SIZE):
+            # reversed reads are entity-scoped (small) per the API
+            # contract; small bounded reads go out as one request
+            params = dict(base, limit=(-1 if unbounded else limit))
+            if reversed_order:
+                params["reversed"] = "true"
+            return iter(self._fetch(params))
+        gen = self._find_paginated(base)
+        if unbounded:
+            return gen
+        import itertools
+        return itertools.islice(gen, limit)   # big bounded reads page too
+
+    def _find_paginated(self, base_params):
+        """Stream an unbounded time-ascending find in PAGE_SIZE chunks.
+        The cursor is the last page's final eventTime; since multiple
+        events can share a millisecond, the next page re-requests from
+        that (inclusive) time and the ids already yielded at the
+        boundary millisecond are skipped. A page made entirely of one
+        millisecond cannot advance the cursor, so the page size doubles
+        until it does."""
+        page = self.PAGE_SIZE
+        cursor: Optional[str] = None
+        cursor_ms: Optional[int] = None
+        boundary_ids: set = set()
+        while True:
+            params = dict(base_params, limit=page)
+            if cursor is not None:
+                params["startTime"] = cursor
+            events = self._fetch(params)
+            fresh = [e for e in events if e.event_id not in boundary_ids]
+            yield from fresh
+            if len(events) < page:
+                return                      # final page
+            last_ms = to_millis(events[-1].event_time)
+            same_ms_ids = {e.event_id for e in events
+                           if to_millis(e.event_time) == last_ms}
+            if len(same_ms_ids) == len(events) and not fresh:
+                # the whole page shares one millisecond and nothing new
+                # was yielded: the cursor cannot advance — widen the page
+                page *= 2
+                continue
+            if cursor_ms == last_ms:
+                # several pages ending inside one millisecond: keep every
+                # id already yielded at it, or re-requests re-yield them
+                boundary_ids |= same_ms_ids
+            else:
+                boundary_ids = same_ms_ids
+                page = self.PAGE_SIZE   # past the dense ms: re-bound
+            cursor = self._iso(events[-1].event_time)
+            cursor_ms = last_ms

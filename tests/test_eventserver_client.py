@@ -163,3 +163,54 @@ class TestRemoteEvents:
         with pytest.raises(RemoteError, match="401"):
             bad.insert(mk(), app_id)
         bad.close()
+
+
+class TestPaginatedFind:
+    def test_unbounded_find_pages_without_dupes(self, remote, monkeypatch):
+        """Unbounded reads stream in pages; events sharing the boundary
+        millisecond must appear exactly once (time-cursor + id dedup)."""
+        ev, app_id, _ = remote
+        monkeypatch.setattr(RemoteEvents, "PAGE_SIZE", 7)
+        # 40 events across 10 distinct seconds -> heavy ties at every
+        # page boundary
+        ev.insert_batch([mk(eid=f"u{i}", sec=i % 10) for i in range(40)],
+                        app_id)
+        got = list(ev.find(app_id))
+        assert len(got) == 40
+        assert len({e.event_id for e in got}) == 40
+        times = [e.event_time for e in got]
+        assert times == sorted(times)
+
+    def test_single_millisecond_store_widens_pages(self, remote,
+                                                   monkeypatch):
+        ev, app_id, _ = remote
+        monkeypatch.setattr(RemoteEvents, "PAGE_SIZE", 4)
+        ev.insert_batch([mk(eid=f"u{i}", sec=5) for i in range(13)],
+                        app_id)
+        got = list(ev.find(app_id))
+        assert len(got) == 13
+        assert len({e.event_id for e in got}) == 13
+
+    def test_bounded_and_reversed(self, remote, monkeypatch):
+        ev, app_id, _ = remote
+        monkeypatch.setattr(RemoteEvents, "PAGE_SIZE", 3)
+        ev.insert_batch([mk(eid=f"u{i}", sec=i) for i in range(9)], app_id)
+        # limit > PAGE_SIZE pages too (one giant bounded request would
+        # keep the OOM path); limit <= PAGE_SIZE stays a single request
+        assert len(list(ev.find(app_id, limit=5))) == 5
+        assert len(list(ev.find(app_id, limit=2))) == 2
+        got = list(ev.find(app_id, entity_type="user", entity_id="u3",
+                           reversed_order=True))
+        assert [e.entity_id for e in got] == ["u3"]
+
+    def test_page_size_rebounds_after_dense_millisecond(self, remote,
+                                                        monkeypatch):
+        ev, app_id, _ = remote
+        monkeypatch.setattr(RemoteEvents, "PAGE_SIZE", 4)
+        # 13 events in one ms (forces widening), then 20 spread out
+        ev.insert_batch([mk(eid=f"d{i}", sec=5) for i in range(13)]
+                        + [mk(eid=f"s{i}", sec=10 + i % 40)
+                           for i in range(20)], app_id)
+        got = list(ev.find(app_id))
+        assert len(got) == 33
+        assert len({e.event_id for e in got}) == 33
