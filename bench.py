@@ -418,6 +418,10 @@ def bench_rest_latency(model, n_queries=200):
                     all_clients.append(c)
             c.post({"user": str(int(uid)), "num": 10})
         jobs = [users[i % len(users)] for i in range(n_total)]
+        # snapshot batcher counters so the coalescing number covers ONLY
+        # the concurrent phase (warmup + the serial loop run hundreds of
+        # single-query batches that would dilute a cumulative average)
+        pre = json.loads(client.get("/stats.json"))
         with ThreadPoolExecutor(n_workers) as ex:
             t0 = time.perf_counter()
             list(ex.map(worker, jobs))
@@ -426,12 +430,20 @@ def bench_rest_latency(model, n_queries=200):
             c.close()
         # server-side latency split: device/score time vs serve+HTTP
         stats = json.loads(client.get("/stats.json"))
+        d_q = (stats.get("batchedQueries", 0)
+               - pre.get("batchedQueries", 0))
+        d_b = stats.get("batches", 0) - pre.get("batches", 0)
         return {"p50_ms": float(np.percentile(lat, 50) * 1000),
                 "p95_ms": float(np.percentile(lat, 95) * 1000),
                 "qps_serial": float(1.0 / lat.mean()),
                 "qps_concurrent16": float(n_total / conc_dt),
                 "server_avg_total_ms": stats["avgServingSec"] * 1000,
-                "server_avg_predict_ms": stats["avgPredictSec"] * 1000}
+                "server_avg_predict_ms": stats["avgPredictSec"] * 1000,
+                # realized coalescing DURING the concurrent phase — the
+                # datum for tuning micro_batch_wait_ms
+                "serve_avg_batch_size": (d_q / d_b if d_b else 0.0),
+                "serve_max_batch_size": float(
+                    stats.get("maxBatchSize", 0))}
     finally:
         client.close()
         server.stop()
