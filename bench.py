@@ -264,16 +264,20 @@ def bench_product_path(full_scale: bool):
                 eid = b"e%d" % j
                 ts = 1000 + j
                 # eventTime matches the header ts exactly, as
-                # Events.insert would have written it
+                # Events.insert would have written it (day component
+                # carried so timestamps stay parseable past 24h of
+                # millis; 31 days covers nnz up to 2.67e9)
                 sec, ms = divmod(ts, 1000)
                 mi, sec = divmod(sec, 60)
                 hh, mi = divmod(mi, 60)
+                dd, hh = divmod(hh, 24)
+                assert dd < 31, "bench populate: ts exceeds January 1970"
                 payload = (b'{"eventId":"%s","event":"rate","entityType":'
                            b'"user","entityId":"u%d","targetEntityType":'
                            b'"item","targetEntityId":"i%d","properties":'
                            b'{"rating":%.1f},"eventTime":'
-                           b'"1970-01-01T%02d:%02d:%02d.%03dZ"}'
-                           % (eid, u, it, v, hh, mi, sec, ms))
+                           b'"1970-01-%02dT%02d:%02d:%02d.%03dZ"}'
+                           % (eid, u, it, v, dd + 1, hh, mi, sec, ms))
                 part = lib.el_hash(ent, len(ent)) % P
                 if lib.el_append(handles[part], eid, len(eid), payload,
                                  len(payload), ts,

@@ -590,7 +590,7 @@ int64_t el_col_fill(void* vh, int32_t c, uint8_t* out, int64_t maxlen) {
   std::lock_guard<std::mutex> lock(h->mu);
   const std::string* buf = col_buf_of(h, c);
   const std::vector<uint64_t>* off = col_off_of(h, c);
-  if (!buf || !off || maxlen <= 0) return -1;
+  if (!buf || !off || off->empty() || maxlen <= 0) return -1;
   size_t n = off->size() - 1;
   memset(out, 0, (size_t)maxlen * n);
   for (size_t i = 0; i < n; i++) {

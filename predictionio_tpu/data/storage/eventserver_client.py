@@ -165,8 +165,11 @@ class RemoteEvents(base.Events):
     def remove(self, app_id, channel_id=None) -> bool:
         # no bulk-drop route in the event API: delete what find returns.
         # An already-empty namespace is a successful remove, as in every
-        # embedded backend.
-        for e in list(self.find(app_id, channel_id, limit=-1)):
+        # embedded backend. Stream the paginated generator — the time
+        # cursor only moves forward, so deleting already-yielded (earlier)
+        # events cannot disturb later pages, and the store never
+        # materializes in memory.
+        for e in self.find(app_id, channel_id, limit=-1):
             self.delete(e.event_id, app_id, channel_id)
         return True
 

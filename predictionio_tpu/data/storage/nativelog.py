@@ -28,6 +28,7 @@ silently miss records).
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import json
 import os
@@ -154,6 +155,7 @@ class StorageClient:
 
 
 _LEGACY = -1  # partition index of a pre-partitioning single log file
+_NULL_CTX = contextlib.nullcontext()  # reentrant and reusable
 
 
 class NativeLogEvents(base.Events):
@@ -194,6 +196,10 @@ class NativeLogEvents(base.Events):
         self._hlocks: Dict[Tuple[int, Optional[int], int],
                            threading.RLock] = {}
         self._lock = threading.RLock()
+        # serializes cross-shard overwrite-by-id inserts (the rare path
+        # where a caller-supplied id is absent from its own shard): two
+        # racers otherwise each delete the other's freshly-appended copy
+        self._overwrite_lock = threading.Lock()
         self._pool: Optional[ThreadPoolExecutor] = None
         self._closed = False
 
@@ -320,38 +326,50 @@ class NativeLogEvents(base.Events):
     def insert(self, event: Event, app_id, channel_id=None) -> str:
         part = self._write_part(event)
         hkey = (app_id, channel_id, part)
+        preexisting_id = bool(event.event_id)
         eid = event.event_id or new_event_id()
         payload = json.dumps(
             event.with_id(eid).to_dict(), separators=(",", ":")
         ).encode("utf-8")
         key = eid.encode("utf-8")
         target = self._target_key(event)
-        while True:
-            h, lk = self._handle_of(app_id, channel_id, part)
-            with lk:
-                if self._stale(hkey, h):
-                    continue           # lost a race with remove(): reopen
-                rc = self.lib.el_append(
-                    h, key, len(key), payload, len(payload),
-                    to_millis(event.event_time),
-                    _hash(self.lib, self._entity_key(event)),
-                    _hash(self.lib, event.event),
-                    _hash(self.lib, target) if target else 0)
-            if rc != 0:
-                raise IOError("append failed")
-            if self.partitions > 1:
-                # supersede any same-id record in a pre-partitioning
-                # legacy file — the unpartitioned store's append-
-                # overwrites-by-key semantics must survive the upgrade
-                # (otherwise a re-insert would surface two records)
-                lh, llk = self._handle_of(app_id, channel_id, _LEGACY,
-                                          create=False)
-                if lh is not None:
-                    with llk:
-                        lkey = (app_id, channel_id, _LEGACY)
-                        if not self._stale(lkey, lh):
-                            self.lib.el_delete(lh, key, len(key))
-            return eid
+        # A caller-supplied id may live in a DIFFERENT file: another shard
+        # (a re-insert that changed the entity re-routes, since shard
+        # routing is by entity hash) or a pre-partitioning legacy file —
+        # so every preexisting-id insert sweeps all other files, keeping
+        # overwrite-by-id a whole-store invariant and self-healing any
+        # duplicates an earlier crash left behind. Fresh generated ids
+        # are new by construction and skip all of this. The overwrite
+        # lock spans append+sweep so racing same-id inserts serialize to
+        # last-writer-wins (each otherwise deletes the other's fresh
+        # copy); appending BEFORE sweeping means an append failure or a
+        # crash leaves the old copy intact (worst crash outcome is a
+        # duplicate repaired on the next overwrite, never loss).
+        sweep = self.partitions > 1 and preexisting_id
+        with self._overwrite_lock if sweep else _NULL_CTX:
+            while True:
+                h, lk = self._handle_of(app_id, channel_id, part)
+                with lk:
+                    if self._stale(hkey, h):
+                        continue       # lost a race with remove(): reopen
+                    rc = self.lib.el_append(
+                        h, key, len(key), payload, len(payload),
+                        to_millis(event.event_time),
+                        _hash(self.lib, self._entity_key(event)),
+                        _hash(self.lib, event.event),
+                        _hash(self.lib, target) if target else 0)
+                if rc != 0:
+                    raise IOError("append failed")
+                break
+            if sweep:
+                for okey, oh, olk in self._read_handles(app_id,
+                                                        channel_id):
+                    if okey[2] == part:
+                        continue
+                    with olk:
+                        if not self._stale(okey, oh):
+                            self.lib.el_delete(oh, key, len(key))
+        return eid
 
     def insert_batch(self, events, app_id, channel_id=None):
         eids = [self.insert(e, app_id, channel_id) for e in events]

@@ -549,6 +549,34 @@ class TestNativeLogPartitions:
         assert list(ev4.find(1)) == []
         c4.close()
 
+    def test_reinsert_with_changed_entity_moves_shards(self, tmp_path):
+        """Re-inserting an existing event_id with a DIFFERENT entity may
+        route to a different shard; the stale copy in the old shard must
+        be superseded, not left as a second live record with the same
+        id (overwrite-by-id holds across the whole partitioned store)."""
+        c = self._client(tmp_path, 8)
+        ev = c.get_data_object("events", "test")
+        ev.init(1)
+        eid = ev.insert(mk(eid="uA", sec=1), 1)
+        # pick a replacement entity that lands in a different shard
+        for k in range(200):
+            cand = f"uB{k}"
+            if (ev._write_part(mk(eid=cand, sec=2))
+                    != ev._write_part(mk(eid="uA", sec=1))):
+                break
+        else:
+            raise AssertionError("no cross-shard entity found")
+        e_new = Event(event="rate", entity_type="user", entity_id=cand,
+                      event_time=t(2), event_id=eid)
+        assert ev.insert(e_new, 1) == eid
+        found = list(ev.find(1))
+        assert len(found) == 1                  # exactly one live record
+        assert found[0].entity_id == cand
+        assert ev.get(eid, 1).entity_id == cand
+        assert ev.delete(eid, 1)
+        assert list(ev.find(1)) == []
+        c.close()
+
     def test_torn_tail_recovery(self, tmp_path):
         """A crash mid-append leaves a torn record at the file tail; on
         reopen every complete record must still be readable (the index
