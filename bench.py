@@ -37,14 +37,34 @@ DEVICE_PEAK_FLOPS = {
 }
 CPU_PEAK_FLOPS = 2e12        # generous host ceiling for smoke mode
 
+# HBM bandwidth per device kind (bytes/s), for the memory-bound roofline
+# estimate the measured s/iteration is compared against. Sources: public
+# TPU spec sheets (v5e 819 GB/s, v4 1228, v5p 2765, v6e 1640).
+DEVICE_HBM_BW = {
+    "TPU v5 lite": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,
+}
+CPU_MEM_BW = 50e9            # nominal host DRAM figure for smoke mode
 
-def device_peak_flops() -> float:
+
+def _device_lookup(table: dict, cpu_default: float,
+                   tpu_default: float) -> float:
     import jax
     kind = jax.devices()[0].device_kind
-    for prefix, peak in DEVICE_PEAK_FLOPS.items():
+    for prefix, v in table.items():
         if kind.startswith(prefix):
-            return peak
-    return CPU_PEAK_FLOPS if jax.default_backend() == "cpu" else 919e12
+            return v
+    return cpu_default if jax.default_backend() == "cpu" else tpu_default
+
+
+def device_peak_flops() -> float:
+    return _device_lookup(DEVICE_PEAK_FLOPS, CPU_PEAK_FLOPS, 919e12)
+
+
+def device_hbm_bw() -> float:
+    return _device_lookup(DEVICE_HBM_BW, CPU_MEM_BW, 819e9)
 
 
 def als_iteration_flops(user_plan, item_plan, rank: int) -> float:
@@ -59,6 +79,29 @@ def als_iteration_flops(user_plan, item_plan, rank: int) -> float:
             total += 2.0 * B * K * rank          # rhs
             total += B * rank ** 3 / 3.0         # Cholesky
             total += 2.0 * 2.0 * B * rank ** 2   # tri solves
+    return total
+
+
+def als_iteration_hbm_bytes(user_plan, item_plan, rank: int,
+                            compute_dtype: str) -> float:
+    """Memory traffic per full ALS iteration, from the actual padded batch
+    shapes — the numerator of the memory-bound roofline the measured
+    s/iteration is compared against. Per batch [B, K]: counterpart factor
+    row gathers B*K*R (the dominant term; random access, so full rows),
+    ratings val+mask+idx reads, one write + one read of the normal
+    matrices (min(K, R)-dim — the dual/Woodbury route solves K x K when
+    K < R; CG re-reads stay in VMEM), rhs write+read, result scatter."""
+    db = 2.0 if compute_dtype == "bfloat16" else 4.0
+    total = 0.0
+    for plan in (user_plan, item_plan):
+        for b in plan.batches:
+            B, K = b.shape
+            S = min(K, rank)
+            total += B * K * rank * db           # factor-row gathers
+            total += B * K * (4.0 + 4.0 + 4.0)   # val + mask + idx (f32/i32)
+            total += 2.0 * B * S * S * db        # normal-matrix write+read
+            total += 2.0 * B * rank * db         # rhs write+read
+            total += B * rank * db               # solved rows scatter
     return total
 
 # persistent XLA compilation cache: warmup compiles are paid once per
@@ -161,6 +204,14 @@ def bench_als(full_scale: bool):
     implied_flops = flops_iter / best
     peak = device_peak_flops()
     mfu = implied_flops / peak
+    # memory-bound roofline from the actual plan: the primary efficiency
+    # metric (mfu undercounts by design — it credits neither CG work nor
+    # padding — so roofline_fraction is what tracks optimization progress;
+    # 1.0 = measured time equals the HBM-traffic lower bound)
+    hbm_bytes = als_iteration_hbm_bytes(user_plan, item_plan, rank,
+                                        cfg.compute_dtype)
+    roofline_s = hbm_bytes / device_hbm_bw()
+    roofline_fraction = roofline_s / best
     timing_valid = (implied_flops <= peak) and (0.6 <= scale_ratio <= 1.67)
     if not timing_valid:
         raise RuntimeError(
@@ -182,6 +233,9 @@ def bench_als(full_scale: bool):
         "ratings_per_sec_per_chip": ratings_per_sec,
         "train_s_per_iteration": best,
         "mfu": round(mfu, 4),
+        "roofline_fraction": round(roofline_fraction, 4),
+        "roofline_s_per_iteration": round(roofline_s, 4),
+        "hbm_gb_per_iteration": round(hbm_bytes / 1e9, 2),
         "counted_flops_per_iteration": flops_iter,
         "scale_check_ratio": round(scale_ratio, 3),
         "padding_overhead": round(user_plan.padding_overhead
@@ -354,9 +408,10 @@ def bench_product_path(full_scale: bool):
         registry.clear_cache()
 
 
-def bench_rest_latency(model, n_queries=200):
+def bench_rest_latency(model, n_queries=200, wait_ms=2.0):
     """p50 of POST /queries.json against the trained model via the real
-    engine server (loopback HTTP)."""
+    engine server (loopback HTTP). `wait_ms` sets the micro-batcher's
+    coalescing window — swept by main() to pick the default from data."""
     import urllib.request
 
     from predictionio_tpu.core import EngineParams, FirstServing
@@ -378,7 +433,7 @@ def bench_rest_latency(model, n_queries=200):
     engine = R.RecommendationEngineFactory.apply()
     server = EngineServer(ServerConfig(ip="127.0.0.1", port=0,
                                        micro_batch=32,
-                                       micro_batch_wait_ms=2.0),
+                                       micro_batch_wait_ms=wait_ms),
                           engine=engine)
     now = dt.datetime.now(dt.timezone.utc)
     server.engine_instance = EngineInstance(
@@ -582,6 +637,17 @@ def main():
     als_stats, model = bench_als(full_scale)
     rest_stats = bench_rest_latency(model)
     rest_stats.update(measure_d2h_floor_ms())
+    # micro-batch coalescing-window sweep: the datum for choosing the
+    # micro_batch_wait_ms default (serial p50 pays the window when idle,
+    # concurrent throughput gains from coalescing — both reported)
+    serve_sweep = {}
+    if not os.environ.get("PIO_BENCH_SKIP_SERVE_SWEEP"):
+        for w in (2.0, 5.0, 10.0):
+            s = bench_rest_latency(model, n_queries=100, wait_ms=w)
+            serve_sweep[f"{w:g}"] = {
+                "p50_ms": round(s["p50_ms"], 3),
+                "qps_concurrent16": round(s["qps_concurrent16"], 1),
+                "avg_batch": round(s["serve_avg_batch_size"], 2)}
     product_stats = {}
     if not os.environ.get("PIO_BENCH_SKIP_PRODUCT"):
         product_stats = bench_product_path(full_scale)
@@ -598,6 +664,8 @@ def main():
         **{k: round(v, 3) for k, v in rest_stats.items()},
         **product_stats,
     }
+    if serve_sweep:
+        out["serve_wait_sweep_ms"] = serve_sweep
     if os.environ.get("PIO_BENCH_CPU_FALLBACK"):
         out["note"] = ("TPU tunnel unreachable; CPU smoke-mode fallback "
                        "(full_scale=false, NOT a chip measurement)")

@@ -196,10 +196,12 @@ class NativeLogEvents(base.Events):
         self._hlocks: Dict[Tuple[int, Optional[int], int],
                            threading.RLock] = {}
         self._lock = threading.RLock()
-        # serializes cross-shard overwrite-by-id inserts (the rare path
-        # where a caller-supplied id is absent from its own shard): two
-        # racers otherwise each delete the other's freshly-appended copy
-        self._overwrite_lock = threading.Lock()
+        # serializes cross-shard overwrite-by-id inserts of the SAME id
+        # (two racers otherwise each delete the other's freshly-appended
+        # copy). Striped by id so concurrent inserts of distinct ids —
+        # the common ingest path when clients assign ids, as RemoteEvents
+        # and pio import do — never contend on a global lock.
+        self._overwrite_locks = [threading.Lock() for _ in range(64)]
         self._pool: Optional[ThreadPoolExecutor] = None
         self._closed = False
 
@@ -346,7 +348,9 @@ class NativeLogEvents(base.Events):
         # crash leaves the old copy intact (worst crash outcome is a
         # duplicate repaired on the next overwrite, never loss).
         sweep = self.partitions > 1 and preexisting_id
-        with self._overwrite_lock if sweep else _NULL_CTX:
+        ctx = (self._overwrite_locks[_hash(self.lib, eid) & 63]
+               if sweep else _NULL_CTX)
+        with ctx:
             while True:
                 h, lk = self._handle_of(app_id, channel_id, part)
                 with lk:
