@@ -1,0 +1,151 @@
+"""Multi-process SPMD query coordination for mesh-sharded models.
+
+The reference's P-serve contract is HTTP-to-distributed-lookup: the
+driver's HTTP route evaluates a query against a cluster-resident model and
+every executor participates (reference: core/src/main/scala/io/prediction/
+workflow/CreateServer.scala:490-641 — the query path over a live
+SparkContext; controller/PAlgorithm.scala:44-125 — the distributed-model
+predict). The TPU-native equivalent: under multi-controller JAX every
+process must enter the SAME XLA program in the SAME order, so the HTTP
+frontend (process 0) broadcasts each query payload to all processes
+before any device work, and worker processes sit in a loop running the
+identical predict pipeline against their shards of the model.
+
+Transport: ``jax.experimental.multihost_utils.broadcast_one_to_all`` over
+a fixed-size byte buffer — the broadcast itself is a device collective,
+so it doubles as the ordering barrier; a host-side lock on the primary
+keeps concurrent HTTP threads from interleaving two queries' collectives.
+
+Contract for engines served this way: ``Serving.supplement`` must be
+deterministic given the query (each process re-derives the supplemented
+query locally — the same closure-determinism the reference requires of
+executor-evaluated serve code), and feedback/plugins should be enabled
+only on the primary.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SHUTDOWN = 0xFFFFFFFF
+
+
+class MeshQueryCoordinator:
+    """Serializes and broadcasts query payloads so every JAX process runs
+    the same SPMD predict program in the same order.
+
+    Primary (process 0) wraps each query's device work in
+    ``serialized(payload)``; workers run ``worker_loop(handler)`` and the
+    handler re-executes the same pipeline. Payloads are JSON objects
+    (a dict for single queries, a list for micro-batched windows).
+    """
+
+    def __init__(self, max_bytes: int = 1 << 16):
+        import jax
+        self.max_bytes = max_bytes
+        self.n_processes = jax.process_count()
+        self.is_primary = jax.process_index() == 0
+        self._lock = threading.Lock()
+        self._down = False
+
+    @property
+    def multi_process(self) -> bool:
+        return self.n_processes > 1
+
+    @classmethod
+    def create_if_distributed(cls) -> Optional["MeshQueryCoordinator"]:
+        """A coordinator when running under a multi-process mesh, else
+        None (single-process serving needs no broadcast)."""
+        try:
+            import jax
+            if jax.process_count() > 1:
+                return cls()
+        except Exception:  # jax not initialized — plain local serving
+            pass
+        return None
+
+    # -- wire format --------------------------------------------------------
+    def _encode(self, obj) -> np.ndarray:
+        data = json.dumps(obj).encode("utf-8")
+        if len(data) > self.max_bytes - 4:
+            raise ValueError(
+                f"query payload {len(data)}B exceeds the mesh broadcast "
+                f"buffer ({self.max_bytes - 4}B); raise max_bytes")
+        buf = np.zeros(self.max_bytes, np.uint8)
+        buf[:4] = np.frombuffer(
+            np.uint32(len(data)).tobytes(), np.uint8)
+        buf[4:4 + len(data)] = np.frombuffer(data, np.uint8)
+        return buf
+
+    @staticmethod
+    def _decode(buf: np.ndarray):
+        n = int(np.frombuffer(buf[:4].tobytes(), np.uint32)[0])
+        if n == _SHUTDOWN:
+            return None
+        return json.loads(buf[4:4 + n].tobytes().decode("utf-8"))
+
+    def _bcast(self, buf: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.broadcast_one_to_all(buf))
+
+    # -- primary side -------------------------------------------------------
+    @contextmanager
+    def serialized(self, payload):
+        """Primary: broadcast `payload` then hold the SPMD slot while the
+        caller runs the device work (collective order across processes
+        equals broadcast order). Worker side: a plain pass-through —
+        ordering is the sequential worker loop."""
+        if not self.multi_process or not self.is_primary:
+            yield
+            return
+        with self._lock:
+            if self._down:
+                raise RuntimeError("mesh coordinator is shut down")
+            self._bcast(self._encode(payload))
+            yield
+
+    def shutdown(self):
+        """Primary: release every worker loop."""
+        if not (self.multi_process and self.is_primary) or self._down:
+            self._down = True
+            return
+        with self._lock:
+            self._down = True
+            buf = np.zeros(self.max_bytes, np.uint8)
+            buf[:4] = np.frombuffer(
+                np.uint32(_SHUTDOWN).tobytes(), np.uint8)
+            try:
+                self._bcast(buf)
+            except Exception as e:  # peers already gone
+                logger.warning("mesh coordinator shutdown bcast: %s", e)
+
+    # -- worker side --------------------------------------------------------
+    def worker_loop(self, handler: Callable[[object], object]):
+        """Non-primary processes: block on the next broadcast, run the
+        same pipeline, repeat until the primary shuts down. `handler`
+        receives the decoded payload (dict = one query, list = one
+        micro-batched window) and must execute the identical device
+        program the primary runs."""
+        assert not self.is_primary, "worker_loop is for process_index > 0"
+        zeros = np.zeros(self.max_bytes, np.uint8)
+        while True:
+            obj = self._decode(self._bcast(zeros))
+            if obj is None:
+                logger.info("mesh worker %d: shutdown",
+                            __import__("jax").process_index())
+                return
+            # a worker-only failure is unrecoverable: the primary is (or
+            # will be) inside this query's cross-process collectives, and
+            # a worker that skips them leaves the mesh permanently
+            # desynced. Propagate so the process exits loudly and the
+            # operator redeploys — the reference's executor-failure
+            # semantics, not silent divergence.
+            handler(obj)

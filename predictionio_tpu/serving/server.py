@@ -60,9 +60,19 @@ class EngineServer:
     def __init__(self, config: ServerConfig,
                  engine: Optional[Engine] = None,
                  engine_params: Optional[EngineParams] = None,
-                 plugin_context: Optional[EngineServerPluginContext] = None):
+                 plugin_context: Optional[EngineServerPluginContext] = None,
+                 mesh_coordinator=None):
         self.config = config
         self._lock = threading.RLock()
+        # multi-process mesh serving: under a >1-process JAX mesh every
+        # process must run each query's SPMD program, so the primary
+        # broadcasts payloads and workers mirror the pipeline
+        # (serving/mesh_serving.py; CreateServer.scala:490-641 role)
+        if mesh_coordinator is None:
+            from predictionio_tpu.serving.mesh_serving import \
+                MeshQueryCoordinator
+            mesh_coordinator = MeshQueryCoordinator.create_if_distributed()
+        self.coordinator = mesh_coordinator
         self.engine = engine
         self.engine_params = engine_params
         self.engine_instance = None
@@ -154,11 +164,12 @@ class EngineServer:
         # decode via the first algorithm's query class (JsonExtractor :499)
         qc = algorithms[0].query_class
         query = qc.from_dict(query_dict) if qc is not None else query_dict
-        supplemented = serving.supplement(query)
-        tp = time.perf_counter()
-        predictions = [algo.predict(model, supplemented)
-                       for algo, model in zip(algorithms, models)]
-        predict_dt = time.perf_counter() - tp
+        with self._spmd_guard(query_dict):
+            supplemented = serving.supplement(query)
+            tp = time.perf_counter()
+            predictions = [algo.predict(model, supplemented)
+                           for algo, model in zip(algorithms, models)]
+            predict_dt = time.perf_counter() - tp
         prediction = serving.serve(query, predictions)
         pred_dict = (prediction.to_dict()
                      if hasattr(prediction, "to_dict") else prediction)
@@ -178,6 +189,42 @@ class EngineServer:
             self.predict_seconds += predict_dt
         return pred_dict
 
+    def _spmd_guard(self, payload):
+        """Broadcast `payload` to mesh workers and hold the SPMD slot for
+        this query's device work; a no-op for single-process serving and
+        on the worker side (whose ordering is its sequential loop)."""
+        if self.coordinator is None:
+            import contextlib
+            return contextlib.nullcontext()
+        return self.coordinator.serialized(payload)
+
+    def serve_mesh_worker(self):
+        """Run this process as a mesh serve worker: mirror the primary's
+        predict pipeline for every broadcast query — the executor side of
+        the reference's distributed-model serve (CreateServer.scala:
+        490-641; PAlgorithm.predictBase on cluster-resident models)."""
+        if self.coordinator is None or self.coordinator.is_primary:
+            raise RuntimeError(
+                "serve_mesh_worker requires a multi-process mesh and "
+                "process_index > 0")
+        # workers mirror only the device work: per-query side effects
+        # (feedback events, output plugins) belong to the primary alone,
+        # else every query's feedback would be posted N times
+        if self.config.feedback:
+            import dataclasses
+            self.config = dataclasses.replace(self.config, feedback=False)
+        self.plugin_context = EngineServerPluginContext()
+
+        def handler(obj):
+            if isinstance(obj, list):
+                self.handle_query_batch(obj)
+            else:
+                self.handle_query(obj)
+
+        logger.info("mesh serve worker ready (process %d)",
+                    __import__("jax").process_index())
+        self.coordinator.worker_loop(handler)
+
     def handle_query_batch(self, query_dicts: List[dict]) -> List[dict]:
         """Batched query path: one Algorithm.batch_predict device call for
         all queries in the window (serving/batcher.py)."""
@@ -191,11 +238,13 @@ class EngineServer:
         qc = algorithms[0].query_class
         queries = [qc.from_dict(d) if qc is not None else d
                    for d in query_dicts]
-        indexed = [(i, serving.supplement(q)) for i, q in enumerate(queries)]
-        tp = time.perf_counter()
-        per_algo = [dict(algo.batch_predict(model, indexed))
-                    for algo, model in zip(algorithms, models)]
-        predict_dt = time.perf_counter() - tp
+        with self._spmd_guard(query_dicts):
+            indexed = [(i, serving.supplement(q))
+                       for i, q in enumerate(queries)]
+            tp = time.perf_counter()
+            per_algo = [dict(algo.batch_predict(model, indexed))
+                        for algo, model in zip(algorithms, models)]
+            predict_dt = time.perf_counter() - tp
         out = []
         for i, (q, d) in enumerate(zip(queries, query_dicts)):
             prediction = serving.serve(q, [pa[i] for pa in per_algo])
@@ -347,6 +396,8 @@ class EngineServer:
     def stop(self):
         if self.batcher is not None:
             self.batcher.stop()
+        if self.coordinator is not None:
+            self.coordinator.shutdown()
         if self.server:
             self.server.stop()
             self.server = None

@@ -106,8 +106,10 @@ def cmd_unregister(args) -> int:
 
 
 def cmd_train(args) -> int:
+    from predictionio_tpu.parallel.mesh import init_distributed
     from predictionio_tpu.workflow import (WorkflowConfig,
                                            create_workflow_main)
+    init_distributed()  # no-op unless PIO_COORDINATOR/... are set
     config = WorkflowConfig(
         batch=args.batch or "",
         engine_variant=args.engine_json,
@@ -137,19 +139,27 @@ def cmd_eval(args) -> int:
 
 
 def cmd_deploy(args) -> int:
+    from predictionio_tpu.parallel.mesh import init_distributed
     from predictionio_tpu.serving import EngineServer, ServerConfig
+    init_distributed()  # no-op unless PIO_COORDINATOR/... are set
+    import jax
+    is_primary = jax.process_index() == 0
     # undeploy a stale server occupying the target port first, as the
-    # reference MasterActor does (CreateServer.scala:288-310)
-    try:
-        stop_ip = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
-        req = urllib.request.Request(
-            f"http://{stop_ip}:{args.port}/stop", method="POST", data=b"")
-        urllib.request.urlopen(req, timeout=3).read()
-        _print(f"Undeployed a stale engine server on port {args.port}.")
-        import time
-        time.sleep(1)
-    except Exception:
-        pass
+    # reference MasterActor does (CreateServer.scala:288-310) — primary
+    # only: mesh workers own no port, and probing from every process
+    # could kill a peer's live server
+    if is_primary:
+        try:
+            stop_ip = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+            req = urllib.request.Request(
+                f"http://{stop_ip}:{args.port}/stop", method="POST",
+                data=b"")
+            urllib.request.urlopen(req, timeout=3).read()
+            _print(f"Undeployed a stale engine server on port {args.port}.")
+            import time
+            time.sleep(1)
+        except Exception:
+            pass
     config = ServerConfig(
         ip=args.ip, port=args.port,
         engine_instance_id=args.engine_instance_id,
@@ -162,6 +172,13 @@ def cmd_deploy(args) -> int:
         accesskey=args.accesskey or "")
     server = EngineServer(config)
     server.load()
+    if server.coordinator is not None and not server.coordinator.is_primary:
+        # non-zero process of a multi-process mesh: no HTTP frontend —
+        # mirror the primary's SPMD predict for every broadcast query
+        # (the executor role; CreateServer.scala:490-641)
+        _print("Mesh serve worker: mirroring the primary's query path.")
+        server.serve_mesh_worker()
+        return 0
     _print(f"Engine is deployed and running. Engine API is live at "
            f"http://{config.ip}:{config.port}.")
     server.start(background=False)

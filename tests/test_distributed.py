@@ -93,6 +93,58 @@ print(f"OK proc {pid}")
 """)
 
 
+HTTP_SERVE_PROG = textwrap.dedent("""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+import numpy as np
+from predictionio_tpu.parallel.mesh import init_distributed, make_mesh, \\
+    use_mesh
+init_distributed()
+pid = jax.process_index()
+assert jax.device_count() == 8, jax.device_count()
+mesh = make_mesh(model_parallelism=2)
+
+from predictionio_tpu.core import FirstServing
+from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap
+from predictionio_tpu.data.storage.base import EngineInstance
+from predictionio_tpu.models import recommendation as R
+from predictionio_tpu.ops.als import ALSModel
+from predictionio_tpu.serving import EngineServer, ServerConfig
+import datetime as dt
+
+rng = np.random.default_rng(5)
+als = ALSModel(rng.standard_normal((30, 6)).astype(np.float32),
+               rng.standard_normal((20, 6)).astype(np.float32), 6)
+model = R.RecommendationModel(
+    als, EntityIdIxMap(BiMap({"u%%d" %% i: i for i in range(30)})),
+    EntityIdIxMap(BiMap({"i%%d" %% i: i for i in range(20)})))
+algo = R.MeshALSAlgorithm(R.ALSAlgorithmParams(rank=6))
+server = EngineServer(ServerConfig(ip="127.0.0.1", port=%(http_port)d))
+now = dt.datetime.now(dt.timezone.utc)
+server.engine_instance = EngineInstance(
+    id="dist", status="COMPLETED", start_time=now, end_time=now,
+    engine_id="dist", engine_version="0", engine_variant="dist",
+    engine_factory="recommendation")
+server.algorithms = [algo]
+server.models = [model]
+server.serving = FirstServing()
+assert server.coordinator is not None and \\
+    server.coordinator.multi_process, "coordinator must be active"
+with use_mesh(mesh):
+    if pid == 0:
+        server.start()
+        while server.server is not None:   # until POST /stop
+            time.sleep(0.2)
+    else:
+        server.serve_mesh_worker()
+print("OK proc %%d" %% pid)
+""")
+
+
 def _run_two_procs(prog, extra_env, port):
     procs = []
     for pid in range(2):
@@ -142,6 +194,87 @@ def test_two_process_als_matches_single_process(tmp_path, mesh8):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     _run_two_procs(ALS_PROG % {"repo": repo},
                    {"PIO_TEST_REF_NPZ": ref_path}, 19879)
+
+
+@pytest.mark.timeout(300)
+def test_two_process_http_serving_matches_host(tmp_path):
+    """The FULL P-serve contract at the HTTP boundary: an engine with a
+    mesh-sharded model deployed through EngineServer over 2 processes x 4
+    devices answers /queries.json identically to host scoring — process 0
+    is the HTTP frontend, process 1 mirrors each query's SPMD program via
+    the mesh coordinator (reference: workflow/CreateServer.scala:490-641
+    query path over the live cluster; controller/PAlgorithm.scala:44-125
+    distributed-model predict)."""
+    import json
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    http_port = 19883
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = HTTP_SERVE_PROG % {"repo": repo, "http_port": http_port}
+
+    # host-side ground truth from the same seeded factors
+    rng = np.random.default_rng(5)
+    U = rng.standard_normal((30, 6)).astype(np.float32)
+    V = rng.standard_normal((20, 6)).astype(np.float32)
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ, PIO_COORDINATOR="127.0.0.1:19885",
+                   PIO_NUM_PROCESSES="2", PIO_PROCESS_ID=str(pid),
+                   PALLAS_AXON_POOL_IPS="")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", prog], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        # wait for the HTTP frontend
+        deadline = time.time() + 120
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/", timeout=2).read()
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise RuntimeError("engine server never came up")
+                if any(p.poll() is not None for p in procs):
+                    outs = [p.communicate()[0].decode() for p in procs]
+                    raise AssertionError(
+                        "a process died during startup:\n"
+                        + "\n---\n".join(o[-2000:] for o in outs))
+                time.sleep(0.5)
+
+        for user_ix in (0, 7, 29):
+            body = json.dumps({"user": f"u{user_ix}", "num": 5}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http_port}/queries.json", body,
+                {"Content-Type": "application/json"})
+            got = json.load(urllib.request.urlopen(req, timeout=60))
+            scores = V @ U[user_ix]
+            order = np.argsort(-scores, kind="stable")[:5]
+            assert [s["item"] for s in got["itemScores"]] == \
+                [f"i{j}" for j in order]
+            np.testing.assert_allclose(
+                [s["score"] for s in got["itemScores"]],
+                scores[order], rtol=1e-5, atol=1e-5)
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_port}/stop", method="POST", data=b"")
+        urllib.request.urlopen(req, timeout=10).read()
+    finally:
+        outputs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outputs.append(out.decode())
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+        assert f"OK proc {i}" in out
 
 
 @pytest.mark.timeout(300)
