@@ -315,6 +315,29 @@ class NativeLogEvents(base.Events):
                     removed = True
         return removed
 
+    def snapshot_files(self, app_id, channel_id=None):
+        """Flush every shard and return ``[(file_name, abs_path)]`` for
+        the namespace's live log files — safe to copy while writes
+        continue: the format is append-only (deletes are appended
+        tombstone records), so any byte-prefix of a flushed file is a
+        valid log whose torn tail, if the copy races an append, is
+        repaired on open. The consistency unit is the shard file; the
+        snapshot as a whole is crash-consistent, not point-in-time."""
+        out = []
+        parts = ([0] if self.partitions == 1
+                 else list(range(self.partitions)) + [_LEGACY])
+        for p in parts:
+            key = (app_id, channel_id, p)
+            h, lk = self._handle_of(app_id, channel_id, p, create=False)
+            if h is not None:
+                with lk:
+                    if not self._stale(key, h):
+                        self.lib.el_flush(h)
+            path = self._path_of(app_id, channel_id, p)
+            if os.path.exists(path):
+                out.append((os.path.basename(path), path))
+        return out
+
     @staticmethod
     def _entity_key(e: Event) -> str:
         return f"{e.entity_type}\x00{e.entity_id}"

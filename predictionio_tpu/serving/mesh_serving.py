@@ -61,13 +61,14 @@ class MeshQueryCoordinator:
         return self.n_processes > 1
 
     @classmethod
-    def create_if_distributed(cls) -> Optional["MeshQueryCoordinator"]:
+    def create_if_distributed(cls, max_bytes: int = 1 << 16
+                              ) -> Optional["MeshQueryCoordinator"]:
         """A coordinator when running under a multi-process mesh, else
         None (single-process serving needs no broadcast)."""
         try:
             import jax
             if jax.process_count() > 1:
-                return cls()
+                return cls(max_bytes=max_bytes)
         except Exception:  # jax not initialized — plain local serving
             pass
         return None
@@ -118,6 +119,8 @@ class MeshQueryCoordinator:
             self._down = True
             return
         with self._lock:
+            if self._down:          # lost the race to another stop()
+                return
             self._down = True
             buf = np.zeros(self.max_bytes, np.uint8)
             buf[:4] = np.frombuffer(
@@ -142,10 +145,18 @@ class MeshQueryCoordinator:
                 logger.info("mesh worker %d: shutdown",
                             __import__("jax").process_index())
                 return
-            # a worker-only failure is unrecoverable: the primary is (or
-            # will be) inside this query's cross-process collectives, and
-            # a worker that skips them leaves the mesh permanently
-            # desynced. Propagate so the process exits loudly and the
-            # operator redeploys — the reference's executor-failure
-            # semantics, not silent divergence.
-            handler(obj)
+            try:
+                handler(obj)
+            except Exception as e:
+                # Under this module's determinism contract the primary
+                # raised the SAME exception at the SAME point (its HTTP
+                # layer catches it, answers 500, and keeps serving), so
+                # both sides skipped the same remaining collectives and
+                # the mesh is still in sync — continue, mirroring the
+                # primary. A worker-ONLY failure (contract violation)
+                # is unrecoverable under either policy: exiting here
+                # would wedge the primary's next broadcast just the
+                # same, so log loudly and let the operator decide.
+                logger.error("mesh worker: query handler raised %s: %s "
+                             "(continuing — the primary answers 500 for "
+                             "the same query)", type(e).__name__, e)

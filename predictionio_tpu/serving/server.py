@@ -54,6 +54,11 @@ class ServerConfig:
     # most micro_batch_wait_ms.
     micro_batch: int = 16
     micro_batch_wait_ms: float = 2.0
+    # multi-process mesh serving: per-query broadcast buffer size; raise
+    # it when large micro-batched windows of filter-heavy queries exceed
+    # the default 64 KiB (every broadcast ships the full buffer, so keep
+    # it as small as the workload allows)
+    mesh_broadcast_bytes: int = 1 << 16
 
 
 class EngineServer:
@@ -71,7 +76,8 @@ class EngineServer:
         if mesh_coordinator is None:
             from predictionio_tpu.serving.mesh_serving import \
                 MeshQueryCoordinator
-            mesh_coordinator = MeshQueryCoordinator.create_if_distributed()
+            mesh_coordinator = MeshQueryCoordinator.create_if_distributed(
+                max_bytes=config.mesh_broadcast_bytes)
         self.coordinator = mesh_coordinator
         self.engine = engine
         self.engine_params = engine_params
@@ -318,6 +324,13 @@ class EngineServer:
 
     def _reload(self, req: Request) -> Response:
         """Hot-swap to the latest COMPLETED instance (:337-358)."""
+        if self.coordinator is not None and self.coordinator.multi_process:
+            # reload is per-process: swapping models on the primary only
+            # would serve mismatched shards (wrong scores or a collective
+            # shape hang). Redeploy the whole mesh instead.
+            return Response(400, {
+                "message": "reload is not supported under a multi-process "
+                           "mesh; redeploy all processes"})
         cfg = self.config
         if cfg.engine_instance_id is None and self.engine_instance:
             cfg.engine_id = self.engine_instance.engine_id

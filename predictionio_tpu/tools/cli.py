@@ -169,7 +169,8 @@ def cmd_deploy(args) -> int:
         feedback=args.feedback,
         event_server_ip=args.event_server_ip,
         event_server_port=args.event_server_port,
-        accesskey=args.accesskey or "")
+        accesskey=args.accesskey or "",
+        mesh_broadcast_bytes=args.mesh_broadcast_bytes)
     server = EngineServer(config)
     server.load()
     if server.coordinator is not None and not server.coordinator.is_primary:
@@ -350,6 +351,42 @@ def cmd_trim(args) -> int:
     return 0
 
 
+def cmd_snapshot(args) -> int:
+    """Durability verbs for the nativelog event store: shard files shipped
+    to / restored from a URI-addressed blob store (data/storage/
+    snapshot.py; the HBase snapshot-export role of the reference's
+    replicated default store)."""
+    from predictionio_tpu.data.storage import snapshot as S
+    try:
+        if args.snapshot_command == "create":
+            m = S.create_snapshot(args.appid, args.uri, name=args.name,
+                                  channel_id=args.channelid)
+            total = sum(e["bytes"] for e in m["files"])
+            _print(f"Snapshot {m['name']} created: {len(m['files'])} "
+                   f"file(s), {total} bytes at {args.uri}.")
+        elif args.snapshot_command == "restore":
+            m = S.restore_snapshot(args.uri, args.name,
+                                   app_id=args.appid,
+                                   channel_id=args.channelid,
+                                   force=args.force)
+            _print(f"Snapshot {m['name']} restored "
+                   f"({len(m['files'])} file(s)).")
+        else:
+            snaps = S.list_snapshots(args.uri)
+            if not snaps:
+                _print("No snapshots found.")
+            for m in snaps:
+                total = sum(e["bytes"] for e in m["files"])
+                _print(f"  {m['name']}  app={m['app_id']} "
+                       f"partitions={m['partitions']} files="
+                       f"{len(m['files'])} bytes={total} "
+                       f"created={m['created']}")
+        return 0
+    except S.SnapshotError as e:
+        _print(f"Snapshot failed: {e}")
+        return 1
+
+
 def cmd_run(args) -> int:
     """(Console run — execute a main class/module in the pio environment)"""
     import runpy
@@ -424,6 +461,8 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--event-server-ip", default="0.0.0.0")
     d.add_argument("--event-server-port", type=int, default=7070)
     d.add_argument("--accesskey")
+    d.add_argument("--mesh-broadcast-bytes", type=int, default=1 << 16,
+                   help="multi-process mesh query broadcast buffer size")
     d.set_defaults(func=cmd_deploy)
 
     u = sub.add_parser("undeploy")
@@ -514,6 +553,28 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--src-channelid", type=int)
     tr.add_argument("--dst-channelid", type=int)
     tr.set_defaults(func=cmd_trim)
+
+    sn = sub.add_parser(
+        "snapshot", help="ship/restore nativelog shard snapshots to a "
+        "remote blob URI (the HBase snapshot/export role)")
+    snsub = sn.add_subparsers(dest="snapshot_command", required=True)
+    sc = snsub.add_parser("create")
+    sc.add_argument("--appid", type=int, required=True)
+    sc.add_argument("--uri", required=True,
+                    help="remote blob root, e.g. file:///backups")
+    sc.add_argument("--name", help="snapshot name (default: UTC stamp)")
+    sc.add_argument("--channelid", type=int)
+    sr = snsub.add_parser("restore")
+    sr.add_argument("--uri", required=True)
+    sr.add_argument("--name", required=True)
+    sr.add_argument("--appid", type=int,
+                    help="restore into a different app id")
+    sr.add_argument("--channelid", type=int)
+    sr.add_argument("--force", action="store_true",
+                    help="replace an existing non-empty namespace")
+    sl = snsub.add_parser("list")
+    sl.add_argument("--uri", required=True)
+    sn.set_defaults(func=cmd_snapshot)
 
     r = sub.add_parser("run")
     r.add_argument("main_py")
