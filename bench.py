@@ -168,8 +168,9 @@ def bench_als(full_scale: bool):
     t0 = time.perf_counter()
     user_plan = plan_for_users(ratings, work_budget=cfg.work_budget)
     item_plan = plan_for_items(ratings, work_budget=cfg.work_budget)
-    user_batches = A._upload_plan(mesh, user_plan)
-    item_batches = A._upload_plan(mesh, item_plan)
+    chunk = A.resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
+    user_batches = A._upload_plan(mesh, user_plan, chunk)
+    item_batches = A._upload_plan(mesh, item_plan, chunk)
     prep_s = time.perf_counter() - t0
 
     U = mesh.put_replicated(A._init_factors(n_users, rank, cfg.seed, 1))
@@ -702,6 +703,30 @@ def solver_ablation():
             ("implicit cg_pallas + dual (eig-SMW)",
              dict(solver="cg_pallas", dual_solve="auto",
                   implicit_prefs=True)),
+            # per-solver-call fixed cost amortization: merge this many
+            # independent batches into each solve call (the measured
+            # ~20-30 ms/call dominates the 560 ms solve share at chunk=1)
+            ("cg_pallas + dual + chunk2",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=2)),
+            ("cg_pallas + dual + chunk4",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4)),
+            ("cg_pallas + dual + chunk8",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=8)),
+            ("cg_pallas + dual + chunk4 + fused iteration",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
+                  fuse_iteration=True)),
+            ("implicit cg_pallas + dual + chunk4",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
+                  implicit_prefs=True)),
+            # MXU-packed panel factorization: trailing updates ride the
+            # MXU, substitution is 2R^2/system vs CG's ~96R^2 of VPU
+            # matvecs — the dense-bucket candidate (docs/benchmarks.md)
+            ("chol_pallas + dual + chunk4",
+             dict(solver="chol_pallas", dual_solve="auto",
+                  sweep_chunk=4)),
+            ("schulz_pallas + dual + chunk4",
+             dict(solver="schulz_pallas", dual_solve="auto",
+                  sweep_chunk=4)),
         ]
     else:
         n_users, n_items, nnz, rank = 2_000, 500, 60_000, 32
@@ -711,20 +736,31 @@ def solver_ablation():
             ("cg + dual", dict(solver="cg", dual_solve="auto")),
             ("implicit cg + dual", dict(solver="cg", dual_solve="auto",
                                         implicit_prefs=True)),
+            ("cg + dual + chunk4",
+             dict(solver="cg", dual_solve="auto", sweep_chunk=4)),
+            ("cg + dual + chunk4 + fused iteration",
+             dict(solver="cg", dual_solve="auto", sweep_chunk=4,
+                  fuse_iteration=True)),
         ]
     ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
     ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
     mesh = current_mesh()
     user_plan = plan_for_users(ratings, work_budget=1 << 20)
     item_plan = plan_for_items(ratings, work_budget=1 << 20)
-    user_batches = A._upload_plan(mesh, user_plan)
-    item_batches = A._upload_plan(mesh, item_plan)
+    uploads = {}   # chunk -> (user_batches, item_batches); plans reused
+
+    def batches_for(chunk):
+        if chunk not in uploads:
+            uploads[chunk] = (A._upload_plan(mesh, user_plan, chunk),
+                              A._upload_plan(mesh, item_plan, chunk))
+        return uploads[chunk]
     lam = mesh.put_replicated(np.float32(0.05))
     alpha = mesh.put_replicated(np.float32(1.0))
     for name, kw in configs:
         cfg = ALSConfig(rank=rank, iterations=1, lam=0.05, seed=1,
                         compute_dtype=("bfloat16" if full else "float32"),
                         work_budget=(1 << 20), **kw)
+        user_batches, item_batches = batches_for(cfg.sweep_chunk or 1)
         fdt = cfg.factor_dtype
         import jax.numpy as jnp
         dt = jnp.bfloat16 if fdt == "bfloat16" else np.float32
@@ -737,6 +773,14 @@ def solver_ablation():
                    if imp else None)
 
         def run_iter(U, V):
+            if cfg.fuse_iteration:
+                return A._solve_iteration(
+                    U, V, user_batches, item_batches, lam, alpha,
+                    nratings_reg=True, implicit=imp, rank=rank,
+                    compute_dtype=cfg.compute_dtype, solver=cfg.solver,
+                    dual_solve=cfg.dual_solve,
+                    solver_iters=cfg.solver_iters,
+                    n_users=n_users, n_items=n_items)
             # the conditional keeps the explicit timed path free of even
             # the factor-slice dispatch the gram computation needs
             U = A._run_side(user_batches, U, V, cfg,

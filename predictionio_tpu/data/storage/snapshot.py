@@ -130,55 +130,80 @@ def restore_snapshot(uri: str, name: str,
     src_stem = f"events_{manifest['app_id']}_{manifest['channel_id'] or 0}"
     dst_stem = f"events_{dst_app}_{dst_ch or 0}"
 
-    # verify pass first (checksums + names, data discarded so peak
-    # memory stays one shard, not the namespace), then fetch+write
-    sdir = _snap_dir(root, name)
-    for e in manifest["files"]:
-        if not e["file"].startswith(src_stem):
-            raise SnapshotError(
-                f"manifest file {e['file']!r} does not match the "
-                f"snapshot's namespace {src_stem!r}")
-        data = adapter.read(posixpath.join(sdir, e["file"]))
-        digest = hashlib.sha256(data).hexdigest()
-        del data
-        if digest != e["sha256"]:
-            raise SnapshotError(
-                f"checksum mismatch for {e['file']} in snapshot "
-                f"{name!r}: manifest {e['sha256'][:12]}…, blob "
-                f"{digest[:12]}… — refusing to restore")
-
-    # restore REPLACES the namespace: every live file under the dst stem
-    # counts, including a pre-partitioning legacy log the snapshot may
-    # not name (every read path consults it, so leaving it would merge)
+    # refuse early: restore REPLACES the namespace, and every live file
+    # under the dst stem counts — including a pre-partitioning legacy
+    # log the snapshot may not name (every read path consults it, so
+    # leaving it would merge old events into the restored data)
     import os
-    existing = [f for f in os.listdir(ev.root)
+
+    def _namespace_files():
+        return [f for f in os.listdir(ev.root)
                 if f == f"{dst_stem}.log"
                 or (f.startswith(f"{dst_stem}_p") and f.endswith(".log"))]
-    if existing and not force:
+
+    if _namespace_files() and not force:
+        existing = _namespace_files()
         raise SnapshotError(
             f"target namespace app {dst_app} channel {dst_ch} already "
             f"has {len(existing)} log file(s) (e.g. {existing[0]}); "
             f"restore replaces a namespace — pass --force to overwrite")
-    if existing:
+
+    # stage every blob to a .restore temp first, verifying its checksum
+    # on THIS read (one shard in memory at a time): nothing live is
+    # touched until every file sits verified on local disk, so a failed
+    # fetch or a blob mutated since the manifest leaves the original
+    # namespace intact
+    sdir = _snap_dir(root, name)
+    staged = []
+    try:
+        for e in manifest["files"]:
+            if not e["file"].startswith(src_stem):
+                raise SnapshotError(
+                    f"manifest file {e['file']!r} does not match the "
+                    f"snapshot's namespace {src_stem!r}")
+            data = adapter.read(posixpath.join(sdir, e["file"]))
+            digest = hashlib.sha256(data).hexdigest()
+            if digest != e["sha256"]:
+                raise SnapshotError(
+                    f"checksum mismatch for {e['file']} in snapshot "
+                    f"{name!r}: manifest {e['sha256'][:12]}…, blob "
+                    f"{digest[:12]}… — refusing to restore")
+            fname = dst_stem + e["file"][len(src_stem):]
+            tmp = os.path.join(ev.root, fname + ".restore")
+            with open(tmp, "wb") as f:
+                f.write(data)
+            del data
+            staged.append((tmp, os.path.join(ev.root, fname)))
+    except BaseException:
+        for tmp, _ in staged:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        raise
+
+    if _namespace_files():
         ev.remove(dst_app, dst_ch)   # close handles + delete files
-    for e in manifest["files"]:
-        data = adapter.read(posixpath.join(sdir, e["file"]))
-        fname = dst_stem + e["file"][len(src_stem):]
-        tmp = os.path.join(ev.root, fname + ".restore")
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, os.path.join(ev.root, fname))
+    for tmp, final in staged:
+        os.replace(tmp, final)
     logger.info("snapshot %s restored into app %s channel %s (%d files)",
                 name, dst_app, dst_ch, len(manifest["files"]))
     return manifest
 
 
 def list_snapshots(uri: str) -> List[dict]:
-    """Manifests of every snapshot under `uri` (file:// scans the
-    directory; remote schemes would need an adapter listdir — kept to
-    the local adapter for now, like the reference's fs-level tooling)."""
+    """Manifests of every snapshot under `uri`. Listing needs a directory
+    scan, which the byte-level SchemeAdapter interface doesn't offer —
+    supported for local/mounted file:// roots; remote schemes raise
+    rather than silently reporting an empty backup set."""
     import os
+    from urllib.parse import urlparse
     adapter, root = adapter_for(uri)
+    if urlparse(uri).scheme not in ("file", ""):
+        raise SnapshotError(
+            f"snapshot listing requires a file:// (or mounted) URI; "
+            f"{uri!r} uses a byte-level adapter with no directory "
+            f"listing — read a known snapshot name directly instead")
     base = posixpath.join(root, "snapshots")
     if not os.path.isdir(base):
         return []

@@ -85,6 +85,19 @@ class ALSConfig:
     # large for one device's HBM); GSPMD inserts the all-gathers the
     # per-batch index gathers need — the analog of MLlib's factor-block
     # shuffles, but compiler-scheduled over ICI.
+    sweep_chunk: int = 0
+    # Merge this many same-shape solve batches into one scan step (one
+    # solver call over chunk*B systems). The measured solver cost is
+    # per-CALL fixed (~20-30 ms on v5e regardless of CG iteration count —
+    # docs/benchmarks.md), so fewer, larger calls amortize it; batches
+    # within a half-sweep are independent (they read only the counterpart
+    # table), so merging changes no math. Bounded by the normal-matrix
+    # memory per step (chunk * B * S^2 * 4B). 0 = auto: 4 on single-device
+    # TPU, 1 elsewhere.
+    fuse_iteration: bool = False
+    # Trace both half-sweeps (and the implicit Grams) into ONE program per
+    # iteration, letting XLA overlap the item-side gather DMAs with the
+    # tail of the user-side solves and dropping a dispatch boundary.
 
 
 def default_compute_dtype() -> str:
@@ -232,22 +245,11 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
     return _scatter_rows(factors_out, rows, x)
 
 
-@functools.partial(
-    __import__("jax").jit,
-    static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
-                     "solver", "dual_solve", "solver_iters"),
-    donate_argnums=(0,))
-def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
-                 nratings_reg: bool, implicit: bool, rank: int,
-                 compute_dtype: str, solver: str, dual_solve: str = "auto",
-                 solver_iters: Optional[int] = None):
-    """One half-iteration in ONE dispatch: `groups` is a tuple of stacked
-    same-shape batch groups (rows [N,B], idx/val/mask [N,B,K]); each group
-    is consumed by a `lax.scan` over its leading dim, carrying the donated
-    factor table through every scatter. Collapses the previous ~45
-    dispatches per half-sweep (each with fresh host scalars over a ~65 ms
-    tunnel round-trip) to a single device program, and the per-bucket
-    compile count to one program per plan signature."""
+def _solve_sweep_impl(factors_out, counter_factors, gram, groups, lam,
+                      alpha, *, nratings_reg: bool, implicit: bool,
+                      rank: int, compute_dtype: str, solver: str,
+                      dual_solve: str = "auto",
+                      solver_iters: Optional[int] = None):
     import jax
 
     def body(f, batch):
@@ -265,15 +267,67 @@ def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
     return factors_out
 
 
-@functools.partial(__import__("jax").jit)
-def _gram(factors):
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
+                     "solver", "dual_solve", "solver_iters"),
+    donate_argnums=(0,))
+def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
+                 nratings_reg: bool, implicit: bool, rank: int,
+                 compute_dtype: str, solver: str, dual_solve: str = "auto",
+                 solver_iters: Optional[int] = None):
+    """One half-iteration in ONE dispatch: `groups` is a tuple of stacked
+    same-shape batch groups (rows [N,B], idx/val/mask [N,B,K]); each group
+    is consumed by a `lax.scan` over its leading dim, carrying the donated
+    factor table through every scatter. Collapses the previous ~45
+    dispatches per half-sweep (each with fresh host scalars over a ~65 ms
+    tunnel round-trip) to a single device program, and the per-bucket
+    compile count to one program per plan signature."""
+    return _solve_sweep_impl(
+        factors_out, counter_factors, gram, groups, lam, alpha,
+        nratings_reg=nratings_reg, implicit=implicit, rank=rank,
+        compute_dtype=compute_dtype, solver=solver, dual_solve=dual_solve,
+        solver_iters=solver_iters)
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
+                     "solver", "dual_solve", "solver_iters", "n_users",
+                     "n_items"),
+    donate_argnums=(0, 1))
+def _solve_iteration(U, V, user_groups, item_groups, lam, alpha, *,
+                     nratings_reg: bool, implicit: bool, rank: int,
+                     compute_dtype: str, solver: str,
+                     dual_solve: str = "auto",
+                     solver_iters: Optional[int] = None,
+                     n_users: int = 0, n_items: int = 0):
+    """One FULL iteration (user sweep then item sweep, plus the implicit
+    Grams) traced as a single program: the half-sweeps are data-dependent
+    (the item sweep reads the just-updated U), but fusing them lets XLA
+    prefetch the item side's gather DMAs behind the tail of the user
+    side's solves and drops a host dispatch boundary per iteration."""
+    gram_of = _gram_eig_impl if dual_solve == "auto" else _gram_impl
+    gram_v = gram_of(V[:n_items]) if implicit else None
+    U = _solve_sweep_impl(
+        U, V, gram_v, user_groups, lam, alpha, nratings_reg=nratings_reg,
+        implicit=implicit, rank=rank, compute_dtype=compute_dtype,
+        solver=solver, dual_solve=dual_solve, solver_iters=solver_iters)
+    gram_u = gram_of(U[:n_users]) if implicit else None
+    V = _solve_sweep_impl(
+        V, U, gram_u, item_groups, lam, alpha, nratings_reg=nratings_reg,
+        implicit=implicit, rank=rank, compute_dtype=compute_dtype,
+        solver=solver, dual_solve=dual_solve, solver_iters=solver_iters)
+    return U, V
+
+
+def _gram_impl(factors):
     import jax.numpy as jnp
     return jnp.einsum("ir,is->rs", factors, factors,
                       preferred_element_type=jnp.float32)
 
 
-@functools.partial(__import__("jax").jit)
-def _gram_eig(factors):
+def _gram_eig_impl(factors):
     """Gram + its eigendecomposition — computed ONCE per implicit
     half-sweep and shared by every entity's Woodbury solve (the base
     B = G + reg*I diagonalizes as Q diag(w + reg) Q^T for any reg)."""
@@ -282,6 +336,10 @@ def _gram_eig(factors):
                    preferred_element_type=jnp.float32)
     w, q = jnp.linalg.eigh(G)
     return G, w, q
+
+
+_gram = __import__("jax").jit(_gram_impl)
+_gram_eig = __import__("jax").jit(_gram_eig_impl)
 
 
 # ---------------------------------------------------------------------------
@@ -301,14 +359,30 @@ def _init_factors(n: int, rank: int, seed: int, salt: int,
     return np.abs(f) / np.sqrt(rank)
 
 
-def _upload_plan(mesh: MeshContext, plan: SolvePlan):
+def resolve_sweep_chunk(chunk: int, n_devices: int = 1) -> int:
+    """0 (auto) -> 4 on a single TPU device, 1 elsewhere. The chunked
+    layout is shape-identical math; the default only changes where the
+    per-solver-call fixed cost is measured to matter."""
+    if chunk:
+        return chunk
+    import jax
+    return 4 if (jax.default_backend() == "tpu" and n_devices == 1) else 1
+
+
+def _upload_plan(mesh: MeshContext, plan: SolvePlan, chunk: int = 1):
     """Stack same-shape batches into [N, B(, K)] groups and upload each
     group once, sharded on the batch dim (dim 1) over the mesh data axis.
     The index/rating/mask tensors are constant across iterations, so they
     stay resident in HBM for the whole train (re-uploading per sweep would
     put ~NNZ*12B on the host<->device link every iteration — the dominant
     cost on a tunneled chip). Stacking is what lets `_solve_sweep` consume
-    a whole side in one dispatch via scan."""
+    a whole side in one dispatch via scan.
+
+    `chunk` > 1 merges that many batches into each scan step ([N, B] ->
+    [N/chunk, chunk*B]): batches within a half-sweep are independent, so
+    this only amortizes the solver's per-call fixed cost over more
+    systems (ALSConfig.sweep_chunk); a remainder that doesn't fill a
+    chunk becomes its own group."""
     by_shape = {}
     for b in plan.batches:
         by_shape.setdefault(b.shape, []).append(b)
@@ -319,8 +393,21 @@ def _upload_plan(mesh: MeshContext, plan: SolvePlan):
         idx = np.stack([b.idx for b in bs])      # [N, B, K]
         val = np.stack([b.val for b in bs])
         mask = np.stack([b.mask for b in bs])
-        groups.append(tuple(mesh.put_stacked(x)
-                            for x in (rows, idx, val, mask)))
+        chunks = [(rows, idx, val, mask)]
+        if chunk > 1 and len(bs) > 1:
+            m = min(chunk, len(bs))
+            n_full = (len(bs) // m) * m
+            chunks = []
+            if n_full:
+                chunks.append(tuple(
+                    x[:n_full].reshape(n_full // m, m * x.shape[1],
+                                       *x.shape[2:])
+                    for x in (rows, idx, val, mask)))
+            if len(bs) > n_full:
+                chunks.append(tuple(x[n_full:]
+                                    for x in (rows, idx, val, mask)))
+        for tensors in chunks:
+            groups.append(tuple(mesh.put_stacked(x) for x in tensors))
     return tuple(groups)
 
 
@@ -392,8 +479,9 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
                                   row_multiple).astype(fdt))
     V = put_factors(_init_factors(ratings.n_items, cfg.rank, cfg.seed, 2,
                                   row_multiple).astype(fdt))
-    user_batches = _upload_plan(mesh, user_plan)
-    item_batches = _upload_plan(mesh, item_plan)
+    chunk = resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
+    user_batches = _upload_plan(mesh, user_plan, chunk)
+    item_batches = _upload_plan(mesh, item_plan, chunk)
     # hyperparameters ride along as device-resident scalars: no per-call
     # host uploads, and sweeping lam/alpha (evaluation tuning) does not
     # recompile the sweep program
@@ -411,13 +499,25 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
         telemetry["upload_s"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
     gram_of = _gram_eig if cfg.dual_solve == "auto" else _gram
-    for it in range(cfg.iterations):
-        gram_v = gram_of(V[:ratings.n_items]) if cfg.implicit_prefs \
-            else None
-        U = _run_side(user_batches, U, V, cfg, gram_v, lam_dev, alpha_dev)
-        gram_u = gram_of(U[:ratings.n_users]) if cfg.implicit_prefs \
-            else None
-        V = _run_side(item_batches, V, U, cfg, gram_u, lam_dev, alpha_dev)
+    if cfg.fuse_iteration:
+        for it in range(cfg.iterations):
+            U, V = _solve_iteration(
+                U, V, user_batches, item_batches, lam_dev, alpha_dev,
+                nratings_reg=(cfg.lambda_scaling == "nratings"),
+                implicit=cfg.implicit_prefs, rank=cfg.rank,
+                compute_dtype=cfg.compute_dtype, solver=cfg.solver,
+                dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters,
+                n_users=ratings.n_users, n_items=ratings.n_items)
+    else:
+        for it in range(cfg.iterations):
+            gram_v = gram_of(V[:ratings.n_items]) if cfg.implicit_prefs \
+                else None
+            U = _run_side(user_batches, U, V, cfg, gram_v, lam_dev,
+                          alpha_dev)
+            gram_u = gram_of(U[:ratings.n_users]) if cfg.implicit_prefs \
+                else None
+            V = _run_side(item_batches, V, U, cfg, gram_u, lam_dev,
+                          alpha_dev)
     if telemetry is not None:
         # hard sync again: the loop above only enqueues device work
         float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])

@@ -274,6 +274,152 @@ def cg_solve_pallas(A, b, iters: int = 48, tile: int = 16):
     return x[:B]
 
 
+def _blocked_cholesky_solve(A, b, panel: int = 8):
+    """Batched blocked (right-looking) Cholesky + blocked substitution,
+    written so every slice is static: the Python panel loop unrolls into
+    panel-width rank updates whose trailing syrk is a batched matmul —
+    MXU work — while the per-column factor/substitution steps are cheap
+    [B, M] vector ops. Flop layout per system: ~R^3/3 in trailing matmul
+    updates + 2R^2 substitution, vs CG's ~96 R^2 of cross-sublane VPU
+    matvecs and Schulz's ~72 R^3 of matmuls. Used inside the Pallas tile
+    kernel AND directly (interpret/CPU correctness path).
+
+    A: [B, R, R] SPD (R % panel == 0 — wrappers pad), b: [B, R]."""
+    import jax.numpy as jnp
+
+    B, R = b.shape
+    PW = panel
+    A = jnp.asarray(A, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    rank_in = R
+    if R % PW:
+        # pad to a whole panel with an identity block (decoupled rows
+        # solve to 0) — without this, trailing rows would silently never
+        # be factored
+        pad = PW - R % PW
+        R = R + pad
+        Ap = jnp.zeros((B, R, R), jnp.float32)
+        Ap = Ap.at[:, :rank_in, :rank_in].set(A)
+        Ap = Ap.at[:, rank_in:, rank_in:].set(
+            jnp.eye(pad, dtype=jnp.float32))
+        A = Ap
+        b = jnp.concatenate([b, jnp.zeros((B, pad), b.dtype)], axis=1)
+    nP = R // PW
+    L = jnp.zeros_like(A)
+    for p in range(nP):
+        lo, hi = p * PW, (p + 1) * PW
+        A11 = A[:, lo:hi, lo:hi]                       # [B, PW, PW]
+        # unblocked factor of the diagonal block (PW static steps)
+        L11 = jnp.zeros_like(A11)
+        for c in range(PW):
+            d = jnp.sqrt(jnp.maximum(A11[:, c, c], 1e-30))
+            col = A11[:, :, c] / d[:, None]            # [B, PW]
+            col = col * (jnp.arange(PW) >= c)          # lower part only
+            L11 = L11.at[:, :, c].set(col)
+            A11 = A11 - col[:, :, None] * col[:, None, :]
+        L = L.at[:, lo:hi, lo:hi].set(L11)
+        if hi < R:
+            A21 = A[:, hi:, lo:hi]                     # [B, M, PW]
+            # L21 L11^T = A21: forward substitution, PW static steps
+            L21 = jnp.zeros_like(A21)
+            for c in range(PW):
+                acc = A21[:, :, c]
+                for k in range(c):
+                    acc = acc - L21[:, :, k] * L11[:, c, k][:, None]
+                L21 = L21.at[:, :, c].set(acc / L11[:, c, c][:, None])
+            L = L.at[:, hi:, lo:hi].set(L21)
+            # trailing syrk — the MXU step: A22 -= L21 @ L21^T
+            upd = jnp.einsum("bmk,bnk->bmn", L21, L21,
+                             preferred_element_type=jnp.float32)
+            A = A.at[:, hi:, hi:].add(-upd)
+    # blocked forward substitution: L y = b
+    y = jnp.zeros_like(b)
+    for p in range(nP):
+        lo, hi = p * PW, (p + 1) * PW
+        rhs = b[:, lo:hi]
+        if p:
+            rhs = rhs - jnp.einsum("bmk,bk->bm", L[:, lo:hi, :lo],
+                                   y[:, :lo],
+                                   preferred_element_type=jnp.float32)
+        yp = jnp.zeros_like(rhs)
+        for c in range(PW):
+            acc = rhs[:, c]
+            for k in range(c):
+                acc = acc - L[:, lo + c, lo + k] * yp[:, k]
+            yp = yp.at[:, c].set(acc / L[:, lo + c, lo + c])
+        y = y.at[:, lo:hi].set(yp)
+    # blocked back substitution: L^T x = y
+    x = jnp.zeros_like(b)
+    for p in reversed(range(nP)):
+        lo, hi = p * PW, (p + 1) * PW
+        rhs = y[:, lo:hi]
+        if hi < R:
+            rhs = rhs - jnp.einsum("bkm,bk->bm", L[:, hi:, lo:hi],
+                                   x[:, hi:],
+                                   preferred_element_type=jnp.float32)
+        xp = jnp.zeros_like(rhs)
+        for c in reversed(range(PW)):
+            acc = rhs[:, c]
+            for k in range(c + 1, PW):
+                acc = acc - L[:, lo + k, lo + c] * xp[:, k]
+            xp = xp.at[:, c].set(acc / L[:, lo + c, lo + c])
+        x = x.at[:, lo:hi].set(xp)
+    return x[:, :rank_in]
+
+
+def _chol_kernel(a_ref, b_ref, x_ref, *, panel: int):
+    x_ref[:] = _blocked_cholesky_solve(a_ref[:], b_ref[:], panel)
+
+
+def cholesky_solve_pallas(A, b, tile: int = 8, panel: int = 8,
+                          interpret: bool = False):
+    """MXU-packed panel factorization: grid over batch tiles; each tile's
+    [tile, R, R] systems are factorized in VMEM with panel-width trailing
+    updates as batched matmuls (the MXU share grows as R^3/3 while the
+    sequential column work stays R^2-ish). The candidate replacement for
+    CG on the dense (K >= rank) ALS buckets, whose cross-sublane matvecs
+    bound the VPU path (docs/benchmarks.md)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, rank = A.shape[0], A.shape[-1]
+    if rank % panel:
+        pad = panel - rank % panel
+        R2 = rank + pad
+        Ap = jnp.zeros((B, R2, R2), A.dtype)
+        Ap = Ap.at[:, :rank, :rank].set(A)
+        Ap = Ap.at[:, rank:, rank:].set(jnp.eye(pad, dtype=A.dtype))
+        A = Ap
+        b = jnp.concatenate([b, jnp.zeros((B, pad), b.dtype)], axis=1)
+    R2 = A.shape[-1]
+    if B % tile != 0:
+        padb = tile - B % tile
+        A = jnp.concatenate(
+            [A, jnp.broadcast_to(jnp.eye(R2, dtype=A.dtype),
+                                 (padb, R2, R2))], axis=0)
+        b = jnp.concatenate([b, jnp.zeros((padb, R2), b.dtype)], axis=0)
+    kernel = functools.partial(_chol_kernel, panel=panel)
+    x = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((A.shape[0], R2), jnp.float32),
+        grid=(A.shape[0] // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, R2, R2), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, R2), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, R2), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(A.astype(jnp.float32), b)
+    return x[:B, :rank]
+
+
 def resolve_solver(method: str, n_devices: int = 1) -> str:
     """'auto' -> concrete method: CG on TPU (Pallas single-device; the jnp
     formulation under GSPMD meshes, where pallas_call can't consume sharded
@@ -305,4 +451,8 @@ def spd_solve(A, b, method: str = "auto", iters: int | None = None,
         return schulz_solve(A, b, iters, compute_dtype)
     if method == "schulz_pallas":
         return schulz_solve_pallas(A, b, iters, compute_dtype)
+    if method == "chol_pallas":
+        return cholesky_solve_pallas(A, b)
+    if method == "chol_blocked":   # jnp form (any backend / GSPMD meshes)
+        return _blocked_cholesky_solve(A, b)
     raise ValueError(f"unknown solver {method!r}")

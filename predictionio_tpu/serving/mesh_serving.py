@@ -148,15 +148,27 @@ class MeshQueryCoordinator:
             try:
                 handler(obj)
             except Exception as e:
-                # Under this module's determinism contract the primary
-                # raised the SAME exception at the SAME point (its HTTP
-                # layer catches it, answers 500, and keeps serving), so
-                # both sides skipped the same remaining collectives and
-                # the mesh is still in sync — continue, mirroring the
-                # primary. A worker-ONLY failure (contract violation)
-                # is unrecoverable under either policy: exiting here
-                # would wedge the primary's next broadcast just the
-                # same, so log loudly and let the operator decide.
-                logger.error("mesh worker: query handler raised %s: %s "
-                             "(continuing — the primary answers 500 for "
-                             "the same query)", type(e).__name__, e)
+                # Two failure classes, different policies. Host-level
+                # exceptions (KeyError/ValueError in supplement/predict)
+                # are deterministic under this module's contract: the
+                # primary raised the SAME error at the SAME point (its
+                # HTTP layer answers 500 and keeps serving), both sides
+                # skipped the same collectives, the mesh is in sync —
+                # continue, mirroring the primary. Device/XLA runtime
+                # errors are the worker-only class (per-host OOM, device
+                # fault): the worker may have diverged mid-collective,
+                # and looping would hide a wedged mesh — crash loudly so
+                # a supervisor can redeploy.
+                mod = type(e).__module__ or ""
+                if ("Xla" in type(e).__name__ or "jaxlib" in mod
+                        or mod.startswith("jax")):
+                    logger.critical(
+                        "mesh worker: device-level failure (%s: %s) — "
+                        "possible mid-collective divergence, exiting",
+                        type(e).__name__, e)
+                    raise
+                logger.error(
+                    "mesh worker: query handler raised %s: %s "
+                    "(continuing — under the determinism contract the "
+                    "primary answers 500 for the same query)",
+                    type(e).__name__, e)

@@ -357,6 +357,7 @@ def cmd_snapshot(args) -> int:
     snapshot.py; the HBase snapshot-export role of the reference's
     replicated default store)."""
     from predictionio_tpu.data.storage import snapshot as S
+    from predictionio_tpu.data.storage.registry import StorageError
     try:
         if args.snapshot_command == "create":
             m = S.create_snapshot(args.appid, args.uri, name=args.name,
@@ -382,7 +383,8 @@ def cmd_snapshot(args) -> int:
                        f"{len(m['files'])} bytes={total} "
                        f"created={m['created']}")
         return 0
-    except S.SnapshotError as e:
+    except (S.SnapshotError, StorageError) as e:
+        # StorageError: e.g. an unregistered URI scheme from adapter_for
         _print(f"Snapshot failed: {e}")
         return 1
 

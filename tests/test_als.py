@@ -291,6 +291,32 @@ class TestMeshEquivalence:
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("implicit", [False, True])
+def test_sweep_chunk_and_fused_iteration_match_baseline(implicit):
+    """sweep_chunk merges independent solve batches into larger scan
+    steps and fuse_iteration traces both half-sweeps into one program —
+    neither changes any math, so factors must match the default path to
+    float tolerance (explicit exactly: same ops, same order within each
+    system)."""
+    rng = np.random.default_rng(13)
+    n_u, n_i, nnz = 500, 150, 7000
+    ui = rng.integers(0, n_u, nnz)
+    ii = rng.integers(0, n_i, nnz)
+    vv = rng.uniform(1, 5, nnz).astype(np.float32)
+    r = RatingsCOO(ui, ii, vv, n_u, n_i)
+    kw = dict(rank=8, iterations=3, lam=0.05, seed=2, work_budget=512,
+              implicit_prefs=implicit)
+    base = als_train(r, ALSConfig(**kw))
+    for variant in (ALSConfig(sweep_chunk=3, **kw),
+                    ALSConfig(fuse_iteration=True, **kw),
+                    ALSConfig(sweep_chunk=2, fuse_iteration=True, **kw)):
+        m = als_train(r, variant)
+        np.testing.assert_allclose(m.user_factors, base.user_factors,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(m.item_factors, base.item_factors,
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_train_telemetry_phases():
     """als_train(telemetry=) reports every phase with sane values and
     does not perturb the result (bench.py's product-path split)."""
