@@ -27,6 +27,18 @@ from predictionio_tpu.data.event import Event, to_millis as _millis
 ABSENT = object()
 
 
+class SQLError(Exception):
+    """Server-reported SQL error, dialect-neutral: wire clients (pgwire,
+    mywire) subclass it so the shared DAO layer can branch on semantic
+    conditions without knowing the backend (the reference's JDBC backend
+    serves both PG and MySQL through one DAO set —
+    data/.../jdbc/StorageClient.scala:33-54)."""
+
+    @property
+    def unique_violation(self) -> bool:
+        return False
+
+
 # ---------------------------------------------------------------------------
 # Metadata records
 # ---------------------------------------------------------------------------

@@ -29,12 +29,12 @@ from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import (Event, from_millis, new_event_id,
                                          to_millis)
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.data.storage.base import (ABSENT, AccessKey, App,
+from predictionio_tpu.data.storage.base import (ABSENT, SQLError,
+                                                AccessKey, App,
                                                 Channel, EngineInstance,
                                                 EngineManifest,
                                                 EvaluationInstance, Model)
-from predictionio_tpu.data.storage.pgwire import (UNIQUE_VIOLATION,
-                                                  PGConnection, PGError,
+from predictionio_tpu.data.storage.pgwire import (PGConnection,
                                                   PGProtocolError,
                                                   connect_from_env)
 
@@ -50,7 +50,16 @@ def _unhex_bytea(v: str) -> bytes:
 
 
 class StorageClient:
-    def __init__(self, config, conn: Optional[PGConnection] = None):
+    """Shared SQL-backend client shape: DAO map + one transparent
+    reconnect on transport failure. The MySQL backend subclasses this
+    with its own wire client, DAO map, and transport-error classes —
+    the reference's one-JDBC-backend-two-drivers design."""
+
+    # overridden by dialect subclasses
+    _TRANSPORT_ERRORS: tuple = ()          # set below (forward refs)
+    _DAOS: dict = {}
+
+    def __init__(self, config, conn=None):
         self.config = config
         self._explicit_conn = conn is not None
         self.conn = conn if conn is not None else self._connect()
@@ -68,11 +77,11 @@ class StorageClient:
 
     def execute(self, sql, params=()):
         """One transparent reconnect on transport failure (a dropped
-        server connection must not permanently poison the backend; server
-        errors — PGError — propagate untouched)."""
+        server connection must not permanently poison the backend;
+        server errors — SQLError — propagate untouched)."""
         try:
             return self.conn.execute(sql, params)
-        except (OSError, PGProtocolError):
+        except self._TRANSPORT_ERRORS:
             if self._explicit_conn:
                 raise
             try:
@@ -88,17 +97,7 @@ class StorageClient:
     def get_data_object(self, kind: str, namespace: str):
         key = f"{namespace}/{kind}"
         if key not in self._objects:
-            ctor = {
-                "apps": PGApps,
-                "access_keys": PGAccessKeys,
-                "channels": PGChannels,
-                "engine_instances": PGEngineInstances,
-                "engine_manifests": PGEngineManifests,
-                "evaluation_instances": PGEvaluationInstances,
-                "models": PGModels,
-                "events": PGEvents,
-            }[kind]
-            self._objects[key] = ctor(self, namespace)
+            self._objects[key] = self._DAOS[kind](self, namespace)
         return self._objects[key]
 
     def close(self):
@@ -127,8 +126,8 @@ class PGApps(base.Apps):
                 f"INSERT INTO {self.t} (name,description) VALUES ($1,$2) "
                 "RETURNING id", (app.name, app.description))
             return int(rows[0][0])
-        except PGError as e:
-            if e.sqlstate == UNIQUE_VIOLATION:
+        except SQLError as e:
+            if e.unique_violation:
                 return None
             raise
 
@@ -178,8 +177,8 @@ class PGAccessKeys(base.AccessKeys):
                 "VALUES ($1,$2,$3)",
                 (key, k.appid, json.dumps(list(k.events))))
             return key
-        except PGError as e:
-            if e.sqlstate == UNIQUE_VIOLATION:
+        except SQLError as e:
+            if e.unique_violation:
                 return None
             raise
 
@@ -232,8 +231,8 @@ class PGChannels(base.Channels):
                 f"INSERT INTO {self.t} (name,appid) VALUES ($1,$2) "
                 "RETURNING id", (channel.name, channel.appid))
             return int(rows[0][0])
-        except PGError as e:
-            if e.sqlstate == UNIQUE_VIOLATION:
+        except SQLError as e:
+            if e.unique_violation:
                 return None
             raise
 
@@ -650,3 +649,16 @@ class PGEvents(base.Events):
                 [np.nan if v is None else float(v) for v in rest[0]],
                 dtype=np.float32)
         return out
+
+
+StorageClient._TRANSPORT_ERRORS = (OSError, PGProtocolError)
+StorageClient._DAOS = {
+    "apps": PGApps,
+    "access_keys": PGAccessKeys,
+    "channels": PGChannels,
+    "engine_instances": PGEngineInstances,
+    "engine_manifests": PGEngineManifests,
+    "evaluation_instances": PGEvaluationInstances,
+    "models": PGModels,
+    "events": PGEvents,
+}

@@ -33,7 +33,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
-class PGError(Exception):
+from predictionio_tpu.data.storage.base import SQLError
+
+
+class PGError(SQLError):
     """Server-reported error (ErrorResponse)."""
 
     def __init__(self, fields: Dict[str, str]):
@@ -42,6 +45,10 @@ class PGError(Exception):
         super().__init__(
             f"{fields.get('S', 'ERROR')}: {fields.get('M', '?')} "
             f"(sqlstate {self.sqlstate})")
+
+    @property
+    def unique_violation(self) -> bool:
+        return self.sqlstate == UNIQUE_VIOLATION
 
 
 class PGProtocolError(Exception):

@@ -97,6 +97,7 @@ def _backend_module(type_: str):
         "memory": "predictionio_tpu.data.storage.memory",
         "localfs": "predictionio_tpu.data.storage.localfs",
         "pgsql": "predictionio_tpu.data.storage.pgsql",  # wire-protocol PG
+        "mysql": "predictionio_tpu.data.storage.mysql",  # wire-protocol MySQL
         "nativelog": "predictionio_tpu.data.storage.nativelog",  # C++ log
         "remotefs": "predictionio_tpu.data.storage.remotefs",  # URI blobs
         "hdfs": "predictionio_tpu.data.storage.remotefs",  # HDFS role
