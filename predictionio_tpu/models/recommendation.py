@@ -215,6 +215,10 @@ class ALSAlgorithmParams(Params):
     # custom-query variant: property keys copied onto each ItemScore in the
     # result JSON (e.g. ("creationYear",)); requires data source read_items
     return_properties: Tuple[str, ...] = ()
+    # solver-call batching / whole-iteration fusion (ops/als.ALSConfig
+    # sweep_chunk / fuse_iteration; 0 = auto)
+    sweep_chunk: int = 0
+    fuse_iteration: bool = False
 
 
 @dataclass
@@ -287,6 +291,8 @@ class ALSAlgorithm(P2LAlgorithm):
             raise ValueError("No ratings to train on")
         from predictionio_tpu.ops.als import default_compute_dtype
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
+                        sweep_chunk=p.sweep_chunk,
+                        fuse_iteration=p.fuse_iteration,
                         seed=p.seed if p.seed is not None else 0,
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype())
@@ -445,6 +451,8 @@ class MeshALSAlgorithm(ALSAlgorithm):
             raise ValueError("No ratings to train on")
         from predictionio_tpu.ops.als import default_compute_dtype
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
+                        sweep_chunk=p.sweep_chunk,
+                        fuse_iteration=p.fuse_iteration,
                         seed=p.seed if p.seed is not None else 0,
                         compute_dtype=p.compute_dtype
                         or default_compute_dtype(),

@@ -143,6 +143,10 @@ class ALSAlgorithmParams(Params):
     lam: float = 0.01
     seed: Optional[int] = None
     compute_dtype: Optional[str] = None  # None = bf16 on TPU, f32 on CPU
+    # solver-call batching / whole-iteration fusion (ops/als.ALSConfig
+    # sweep_chunk / fuse_iteration; 0 = auto)
+    sweep_chunk: int = 0
+    fuse_iteration: bool = False
 
 
 @dataclass
@@ -171,6 +175,8 @@ class RecommendedUserALSAlgorithm(P2LAlgorithm):
         coo = RatingsCOO(ui, ii, counts, len(follower_ix), len(followed_ix))
         from predictionio_tpu.ops.als import default_compute_dtype
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
+                        sweep_chunk=p.sweep_chunk,
+                        fuse_iteration=p.fuse_iteration,
                         implicit_prefs=True, alpha=1.0,
                         seed=p.seed if p.seed is not None else 0,
                         compute_dtype=p.compute_dtype
