@@ -303,16 +303,24 @@ def cmd_accesskey(args) -> int:
 
 
 def cmd_template(args) -> int:
-    """Offline template gallery: scaffolds the built-in engine templates
-    (the GitHub-backed gallery of Console.scala Template.scala:130-416 is
-    network-bound; the built-ins ship in-tree instead)."""
-    from predictionio_tpu.tools.templates import (get_template,
+    """Template gallery: built-ins + an optional URI-addressed index
+    (the reference's remote gallery mechanism, Template.scala:130-416;
+    --gallery or PIO_TEMPLATE_GALLERY points at <root>/index.json)."""
+    from predictionio_tpu.data.storage.registry import StorageError
+    from predictionio_tpu.tools.templates import (GalleryError,
+                                                  get_template,
                                                   list_templates)
-    if args.template_command == "list":
-        for name, desc in list_templates():
-            _print(f"  {name:28s} {desc}")
-        return 0
-    return get_template(args.name, args.directory)
+    try:
+        if args.template_command == "list":
+            for name, desc in list_templates(gallery=args.gallery):
+                _print(f"  {name:28s} {desc}")
+            return 0
+        return get_template(args.name, args.directory,
+                            gallery=args.gallery)
+    except (GalleryError, StorageError) as e:
+        # StorageError: unregistered URI scheme from the adapter registry
+        _print(f"Template gallery error: {e}")
+        return 1
 
 
 def cmd_export(args) -> int:
@@ -529,10 +537,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     tp = sub.add_parser("template")
     tsub = tp.add_subparsers(dest="template_command", required=True)
-    tsub.add_parser("list")
+    tl = tsub.add_parser("list")
+    tl.add_argument("--gallery", help="template index URI "
+                    "(default: $PIO_TEMPLATE_GALLERY)")
     tg = tsub.add_parser("get")
     tg.add_argument("name")
     tg.add_argument("directory")
+    tg.add_argument("--gallery", help="template index URI "
+                    "(default: $PIO_TEMPLATE_GALLERY)")
     tp.set_defaults(func=cmd_template)
 
     ex = sub.add_parser("export")

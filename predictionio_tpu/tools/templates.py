@@ -1,16 +1,30 @@
-"""Offline engine-template gallery.
+"""Engine-template gallery: built-ins + URI-addressed remote index.
 
 Plays the role of the reference's GitHub-backed template tool
 (reference: tools/src/main/scala/io/prediction/tools/console/Template.scala:130-416
-`pio template list/get`) with the built-in template families shipped
-in-tree: `get` scaffolds a working engine directory (engine.json + README +
-seed script) wired to the corresponding predictionio_tpu.models factory.
+`pio template list/get` — templates.json index + tarball download +
+extract). Two sources:
+
+  - the built-in template families shipped in-tree (`get` scaffolds a
+    working engine directory wired to a predictionio_tpu.models factory);
+  - a gallery at a URI (``PIO_TEMPLATE_GALLERY`` env or
+    ``pio template --gallery``): ``<root>/index.json`` lists
+    ``{"templates": [{"name", "description", "archive"}]}`` and each
+    archive is a .tar.gz fetched through the same scheme-adapter
+    registry the model store uses (``file://`` built-in; http/gs/s3
+    adapters plug in via ``remotefs.register_scheme``) and extracted
+    with path-traversal protection. The reference's remote-index
+    mechanism is therefore complete; pointing it at a network gallery
+    is configuration, not code.
 """
 
 from __future__ import annotations
 
+import io
 import json
 import os
+import posixpath
+import tarfile
 
 TEMPLATES = {
     "recommendation": {
@@ -85,11 +99,108 @@ TEMPLATES = {
 }
 
 
-def list_templates():
-    return [(name, t["description"]) for name, t in sorted(TEMPLATES.items())]
+class GalleryError(RuntimeError):
+    pass
 
 
-def get_template(name: str, directory: str) -> int:
+def _gallery_uri(gallery=None):
+    return gallery or os.environ.get("PIO_TEMPLATE_GALLERY") or None
+
+
+def _gallery_index(uri: str):
+    """[{name, description, archive}] from <uri>/index.json. Every field
+    is remote content: parse failures and unsafe archive paths become
+    GalleryError, never tracebacks."""
+    from predictionio_tpu.data.storage.remotefs import adapter_for
+    adapter, root = adapter_for(uri)
+    p = posixpath.join(root, "index.json")
+    if not adapter.exists(p):
+        raise GalleryError(f"no index.json at gallery {uri}")
+    try:
+        idx = json.loads(adapter.read(p).decode("utf-8"))
+    except ValueError as e:
+        raise GalleryError(f"index.json at {uri} is not valid JSON: {e}")
+    out = []
+    for e in idx.get("templates", []):
+        if not isinstance(e, dict) or not e.get("name") \
+                or not e.get("archive"):
+            raise GalleryError(f"gallery entry missing name/archive: {e}")
+        arc = e["archive"]
+        if (arc.startswith(("/", "\\")) or ".." in arc.split("/")
+                or (len(arc) > 1 and arc[1] == ":")):
+            # the index must not reach outside its own root
+            raise GalleryError(f"unsafe archive path {arc!r} in index")
+        out.append(e)
+    return out
+
+
+def _safe_extract(data: bytes, directory: str) -> int:
+    """Extract a .tar.gz, refusing absolute paths, parent escapes, links,
+    and devices (the index is remote content — never trust member
+    names). ALL members are validated before anything is written, so a
+    rejected archive leaves no partial, plausible-looking engine
+    directory behind. Returns the number of files written."""
+    try:
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tf:
+            members = tf.getmembers()      # parses every header up front
+            for m in members:
+                name = m.name
+                if (name.startswith(("/", "\\"))
+                        or ".." in name.split("/")
+                        or (len(name) > 1 and name[1] == ":")):
+                    raise GalleryError(f"unsafe archive member {name!r}")
+                if not (m.isdir() or m.isreg()):
+                    raise GalleryError(
+                        f"archive member {name!r} is not a regular file "
+                        f"(links/devices are refused)")
+            n = 0
+            for m in members:
+                if m.isdir():
+                    os.makedirs(os.path.join(directory, m.name),
+                                exist_ok=True)
+                    continue
+                dst = os.path.join(directory, m.name)
+                os.makedirs(os.path.dirname(dst) or directory,
+                            exist_ok=True)
+                src = tf.extractfile(m)
+                with open(dst, "wb") as f:
+                    f.write(src.read())
+                n += 1
+            return n
+    except tarfile.TarError as e:
+        raise GalleryError(f"archive is not a valid tar.gz: {e}")
+
+
+def list_templates(gallery=None):
+    """Built-ins plus, when a gallery URI is configured, its index
+    entries (gallery wins on name collisions, as the reference's remote
+    index shadows nothing local — there was nothing local there)."""
+    out = {name: t["description"] for name, t in TEMPLATES.items()}
+    uri = _gallery_uri(gallery)
+    if uri:
+        for e in _gallery_index(uri):
+            out[e["name"]] = ((e.get("description") or "")
+                              + f" [gallery {uri}]")
+    return sorted(out.items())
+
+
+def get_template(name: str, directory: str, gallery=None) -> int:
+    uri = _gallery_uri(gallery)
+    if uri:
+        entries = {e["name"]: e for e in _gallery_index(uri)}
+        if name in entries:
+            from predictionio_tpu.data.storage.remotefs import adapter_for
+            adapter, root = adapter_for(uri)
+            blob = posixpath.join(root, entries[name]["archive"])
+            if not adapter.exists(blob):
+                raise GalleryError(
+                    f"gallery index names {entries[name]['archive']!r} "
+                    f"but the blob is missing at {uri}")
+            os.makedirs(directory, exist_ok=True)
+            n = _safe_extract(adapter.read(blob), directory)
+            print(f"Engine template {name} created in {directory} "
+                  f"({n} file(s) from {uri}).")
+            return 0
     if name not in TEMPLATES:
         print(f"Unknown template {name!r}. Try `pio template list`.")
         return 1

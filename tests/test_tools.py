@@ -178,6 +178,143 @@ class TestCLI:
         assert (tdir / "README.md").exists()
         assert cli_main(["template", "get", "nope", str(tdir)]) == 1
 
+    @staticmethod
+    def _make_gallery(root, archives):
+        """Build a file:// gallery: index.json + per-template tar.gz."""
+        import io
+        import tarfile
+        root.mkdir(parents=True, exist_ok=True)
+        entries = []
+        for name, files in archives.items():
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+                for fname, content in files:
+                    data = content.encode()
+                    ti = tarfile.TarInfo(fname)
+                    ti.size = len(data)
+                    tf.addfile(ti, io.BytesIO(data))
+            (root / f"{name}.tar.gz").write_bytes(buf.getvalue())
+            entries.append({"name": name, "description": f"{name} desc",
+                            "archive": f"{name}.tar.gz"})
+        (root / "index.json").write_text(
+            json.dumps({"templates": entries}))
+
+    def test_gallery_index_list_and_get(self, tmp_env, tmp_path, capsys):
+        """The remote-index mechanism of the reference's template tool
+        (Template.scala:130-416): list merges the URI index, get fetches
+        and extracts the archive through the scheme adapter."""
+        g = tmp_path / "gallery"
+        self._make_gallery(g, {"custom-engine": [
+            ("engine.json", '{"engineFactory": "recommendation"}'),
+            ("src/main.py", "print('hi')\n")]})
+        uri = f"file://{g}"
+        assert cli_main(["template", "list", "--gallery", uri]) == 0
+        out = capsys.readouterr().out
+        assert "custom-engine" in out and "recommendation" in out
+        tdir = tmp_path / "eng2"
+        assert cli_main(["template", "get", "custom-engine", str(tdir),
+                         "--gallery", uri]) == 0
+        assert json.loads((tdir / "engine.json").read_text())[
+            "engineFactory"] == "recommendation"
+        assert (tdir / "src" / "main.py").read_text() == "print('hi')\n"
+        # built-ins still resolve when absent from the gallery
+        tdir3 = tmp_path / "eng3"
+        assert cli_main(["template", "get", "recommendation", str(tdir3),
+                         "--gallery", uri]) == 0
+        # env-var configuration path
+        import os
+        os.environ["PIO_TEMPLATE_GALLERY"] = uri
+        try:
+            assert cli_main(["template", "list"]) == 0
+            assert "custom-engine" in capsys.readouterr().out
+        finally:
+            del os.environ["PIO_TEMPLATE_GALLERY"]
+
+    def test_gallery_rejects_traversal_and_links(self, tmp_env, tmp_path):
+        """Archive members escaping the target dir (or links) must be
+        refused — the index is remote content."""
+        import io
+        import tarfile
+        g = tmp_path / "gallery"
+        g.mkdir()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            data = b"evil"
+            ti = tarfile.TarInfo("../evil.txt")
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+        (g / "bad.tar.gz").write_bytes(buf.getvalue())
+        (g / "index.json").write_text(json.dumps({"templates": [
+            {"name": "bad", "archive": "bad.tar.gz"}]}))
+        tdir = tmp_path / "out"
+        assert cli_main(["template", "get", "bad", str(tdir),
+                         "--gallery", f"file://{g}"]) == 1
+        assert not (tmp_path / "evil.txt").exists()
+        # symlink member
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            ti = tarfile.TarInfo("link")
+            ti.type = tarfile.SYMTYPE
+            ti.linkname = "/etc/passwd"
+            tf.addfile(ti)
+        (g / "bad.tar.gz").write_bytes(buf.getvalue())
+        assert cli_main(["template", "get", "bad", str(tdir),
+                         "--gallery", f"file://{g}"]) == 1
+
+    def test_gallery_missing_index_fails_cleanly(self, tmp_env, tmp_path):
+        assert cli_main(["template", "list", "--gallery",
+                         f"file://{tmp_path}/nothing"]) == 1
+
+    def test_gallery_bad_content_fails_cleanly(self, tmp_env, tmp_path):
+        """Malformed index JSON, corrupt archives, traversal archive
+        paths, null descriptions, and unregistered schemes all take the
+        clean error path (exit 1), never a traceback — the index is
+        remote content."""
+        g = tmp_path / "g"
+        g.mkdir()
+        uri = f"file://{g}"
+        (g / "index.json").write_text("{not json")
+        assert cli_main(["template", "list", "--gallery", uri]) == 1
+        (g / "index.json").write_text(json.dumps({"templates": [
+            {"name": "x", "archive": "x.tar.gz", "description": None}]}))
+        assert cli_main(["template", "list", "--gallery", uri]) == 0
+        (g / "x.tar.gz").write_bytes(b"not a gzip")
+        tdir = tmp_path / "o"
+        assert cli_main(["template", "get", "x", str(tdir),
+                         "--gallery", uri]) == 1
+        (g / "index.json").write_text(json.dumps({"templates": [
+            {"name": "x", "archive": "../outside.tar.gz"}]}))
+        assert cli_main(["template", "get", "x", str(tdir),
+                         "--gallery", uri]) == 1
+        assert cli_main(["template", "list", "--gallery",
+                         "gs://nope/x"]) == 1
+
+    def test_gallery_rejected_archive_writes_nothing(self, tmp_env,
+                                                     tmp_path):
+        """A rejected archive must not leave a partial engine directory:
+        valid files followed by an unsafe member extract nothing."""
+        import io
+        import tarfile
+        g = tmp_path / "g"
+        g.mkdir()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            data = b'{"engineFactory": "recommendation"}'
+            ti = tarfile.TarInfo("engine.json")
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+            bad = tarfile.TarInfo("link")
+            bad.type = tarfile.SYMTYPE
+            bad.linkname = "/etc/passwd"
+            tf.addfile(bad)
+        (g / "t.tar.gz").write_bytes(buf.getvalue())
+        (g / "index.json").write_text(json.dumps({"templates": [
+            {"name": "t", "archive": "t.tar.gz"}]}))
+        tdir = tmp_path / "out"
+        assert cli_main(["template", "get", "t", str(tdir),
+                         "--gallery", f"file://{g}"]) == 1
+        assert not (tdir / "engine.json").exists()
+
 
 class TestDashboard:
     def test_lists_evaluations(self, tmp_env):
