@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# The full measurement session to run, IN ORDER, the moment the TPU
+# tunnel answers — on an IDLE box (no concurrent pytest/build: host
+# contention poisons the numbers; see docs/benchmarks.md).
+#
+#   bash scripts/tpu_bench_session.sh [outdir]
+#
+# Outputs land unpiped (tail-buffering hides progress otherwise) in
+# <outdir> (default /tmp/tpu_session_<ts>):
+#   bench.json       — headline line (roofline_fraction, serve wait sweep)
+#   ablation.txt     — solver/chunk/fusion/cholesky configuration matrix
+# Afterwards: update docs/benchmarks.md + docs/ROUND3.md from these
+# files, copy bench.json over BENCH_r03.json if the driver hasn't, and
+# flip resolve_sweep_chunk / fuse_iteration / micro_batch_wait_ms
+# defaults where the data says so.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/tpu_session_$(date +%H%M%S)}
+mkdir -p "$OUT"
+echo "== probe =="
+if ! timeout 90 python -c "import jax; d=jax.devices(); print(d); import sys; sys.exit(0 if d and d[0].platform=='tpu' else 1)"; then
+    echo "tunnel not answering / not TPU — aborting (re-run later)"
+    exit 1
+fi
+rc=0
+echo "== bench (headline + roofline + serve sweep) -> $OUT/bench.json =="
+if ! python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
+    echo "BENCH FAILED (rc != 0) — bench.json is an error line, do NOT"
+    echo "copy it over BENCH_r03.json; tail of stderr:"
+    tail -c 1000 "$OUT/bench.err"
+    rc=1
+fi
+tail -c 2000 "$OUT/bench.json"; echo
+echo "== ablation -> $OUT/ablation.txt =="
+if ! python bench.py --ablation > "$OUT/ablation.txt" 2>&1; then
+    echo "ABLATION FAILED (rc != 0)"
+    rc=1
+fi
+cat "$OUT/ablation.txt"
+echo "== done: $OUT (rc=$rc) =="
+exit $rc

@@ -1,0 +1,54 @@
+"""MeshQueryCoordinator unit behavior (single-process pieces: wire
+format, inactivity, guard pass-through). The 2-process end-to-end
+contract lives in tests/test_distributed.py."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.serving.mesh_serving import (MeshQueryCoordinator,
+                                                   _SHUTDOWN)
+
+
+class TestWireFormat:
+    def test_encode_decode_round_trip(self):
+        c = MeshQueryCoordinator(max_bytes=4096)
+        for obj in ({"user": "u1", "num": 5},
+                    [{"user": "a"}, {"user": "b", "filters": ["x"] * 50}],
+                    {"unicode": "événement ☃"}):
+            buf = c._encode(obj)
+            assert buf.shape == (4096,) and buf.dtype == np.uint8
+            assert MeshQueryCoordinator._decode(buf) == obj
+
+    def test_payload_too_large_names_the_knob(self):
+        c = MeshQueryCoordinator(max_bytes=64)
+        with pytest.raises(ValueError, match="max_bytes"):
+            c._encode({"blob": "x" * 200})
+
+    def test_shutdown_sentinel_decodes_to_none(self):
+        buf = np.zeros(128, np.uint8)
+        buf[:4] = np.frombuffer(
+            np.uint32(_SHUTDOWN).tobytes(), np.uint8)
+        assert MeshQueryCoordinator._decode(buf) is None
+
+
+class TestSingleProcess:
+    def test_inactive_and_guard_passthrough(self):
+        c = MeshQueryCoordinator()
+        assert c.n_processes == 1 and not c.multi_process
+        ran = []
+        with c.serialized({"q": 1}):     # no broadcast single-process
+            ran.append(True)
+        assert ran == [True]
+        c.shutdown()                     # no peers: marks down only
+        assert c._down
+
+    def test_create_if_distributed_returns_none_single_process(self):
+        assert MeshQueryCoordinator.create_if_distributed() is None
+
+    def test_server_guard_is_nullcontext_without_coordinator(self):
+        from predictionio_tpu.serving.server import (EngineServer,
+                                                     ServerConfig)
+        s = EngineServer(ServerConfig(port=0, micro_batch=0))
+        with s._spmd_guard({"q": 1}):
+            pass
+        assert s.coordinator is None

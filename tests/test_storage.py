@@ -577,6 +577,52 @@ class TestNativeLogPartitions:
         assert list(ev.find(1)) == []
         c.close()
 
+    def test_concurrent_same_id_overwrites_keep_one_copy(self, tmp_path):
+        """Racing preexisting-id inserts with DIFFERENT entities (so
+        different shards) must end with exactly one live copy per id —
+        the striped overwrite lock serializes same-id racers to
+        last-writer-wins instead of letting them delete each other's
+        fresh append (or leaving duplicates)."""
+        import threading
+        c = self._client(tmp_path, 8)
+        ev = c.get_data_object("events", "test")
+        ev.init(1)
+        n_ids, n_threads = 12, 6
+        barrier = threading.Barrier(n_threads)
+        errors = []
+
+        def racer(tid):
+            try:
+                barrier.wait(timeout=10)
+                for j in range(n_ids):
+                    # per-thread entity: ids route to varying shards
+                    e = Event(event="rate", entity_type="user",
+                              entity_id=f"t{tid}u{j}", event_time=t(j),
+                              event_id=f"shared{j}")
+                    ev.insert(e, 1)
+            except Exception as exc:   # surfaced below
+                errors.append(exc)
+
+        ts = [threading.Thread(target=racer, args=(i,))
+              for i in range(n_threads)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(30)
+        # a hang here IS the bug class under test (lock deadlock): fail
+        # loudly instead of racing the assertions against live threads
+        assert not any(th.is_alive() for th in ts), "racer threads hung"
+        assert not errors, errors
+        found = list(ev.find(1))
+        by_id = {}
+        for e in found:
+            by_id.setdefault(e.event_id, []).append(e)
+        assert len(found) == n_ids, {k: len(v) for k, v in by_id.items()}
+        assert set(by_id) == {f"shared{j}" for j in range(n_ids)}
+        for j in range(n_ids):
+            assert ev.get(f"shared{j}", 1) is not None
+        c.close()
+
     def test_torn_tail_recovery(self, tmp_path):
         """A crash mid-append leaves a torn record at the file tail; on
         reopen every complete record must still be readable (the index
