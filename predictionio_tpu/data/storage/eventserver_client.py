@@ -35,7 +35,7 @@ import threading
 import urllib.parse
 from typing import Dict, List, Optional, Sequence
 
-from predictionio_tpu.data.event import Event, to_millis
+from predictionio_tpu.data.event import Event, from_millis, to_millis
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import ABSENT
 
@@ -240,6 +240,9 @@ class RemoteEvents(base.Events):
 
     PAGE_SIZE = 10_000  # unbounded reads paginate (one giant JSON body
     #                     for a 20M-event store would OOM both sides)
+    COLUMNAR_PAGE = 500_000  # rows per columnar window (~25 MB JSON):
+    #                     far fewer round trips than the object path's
+    #                     pages, same bounded-response guarantee
 
     def _find_params(self, app_id, channel_id, start_time, until_time,
                      entity_type, entity_id, event_names,
@@ -291,6 +294,125 @@ class RemoteEvents(base.Events):
             return gen
         import itertools
         return itertools.islice(gen, limit)   # big bounded reads page too
+
+    def find_columnar(self, app_id, channel_id=None, property_field=None,
+                      start_time=None, until_time=None, entity_type=None,
+                      entity_id=None, event_names=None,
+                      target_entity_type=None, target_entity_id=None,
+                      limit=None, reversed_order=False):
+        """Training-ingest read over the wire as flat column arrays
+        (GET /events/columnar.json): one response of JSON columns is
+        ~4x leaner than paging per-event objects and parses without
+        per-event dicts. Servers predating the route (404 body without
+        column keys) fall back to the streamed-find default."""
+        import numpy as np
+        if reversed_order:
+            # entity-scoped small reads: the object path is fine
+            return super().find_columnar(
+                app_id, channel_id=channel_id,
+                property_field=property_field, start_time=start_time,
+                until_time=until_time, entity_type=entity_type,
+                entity_id=entity_id, event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id, limit=limit,
+                reversed_order=True)
+        base = self._find_params(app_id, channel_id, start_time,
+                                 until_time, entity_type, entity_id,
+                                 event_names, target_entity_type,
+                                 target_entity_id)
+        if property_field is not None:
+            base["propertyField"] = property_field
+
+        def fetch(extra):
+            status, body = self._request(
+                "GET", "/events/columnar.json", dict(base, **extra))
+            if status == 404 and not (isinstance(body, dict)
+                                      and "entity_id" in body):
+                return None                 # server predates the route
+            if status != 200:
+                raise RemoteError(status, (body or {}).get("message", ""))
+            return body
+
+        keys = ["entity_id", "target_entity_id", "event", "t"] + (
+            ["prop"] if property_field is not None else [])
+        unbounded = limit is None or limit < 0
+        # Big reads page by TIME WINDOWS so neither side ever holds the
+        # whole store as one JSON body (the same OOM rationale as the
+        # object path's pagination): each page keeps only its COMPLETE
+        # milliseconds — the boundary millisecond is refetched whole on
+        # the next request — so correctness never depends on a stable
+        # intra-millisecond order across requests.
+        chunks = []
+        remaining = None if unbounded else limit
+        page = self.COLUMNAR_PAGE
+        cursor_ms = None
+        while True:
+            extra = {"limit": page}
+            if not unbounded and remaining <= page:
+                extra["limit"] = remaining
+            if cursor_ms is not None:
+                extra["startTime"] = self._iso(from_millis(cursor_ms))
+            body = fetch(extra)
+            if body is None:
+                # old server: stream the object path instead
+                return super().find_columnar(
+                    app_id, channel_id=channel_id,
+                    property_field=property_field, start_time=start_time,
+                    until_time=until_time, entity_type=entity_type,
+                    entity_id=entity_id, event_names=event_names,
+                    target_entity_type=target_entity_type,
+                    target_entity_id=target_entity_id, limit=limit)
+            n = len(body["t"])
+            got_full_page = n >= extra["limit"] >= 0
+            if not got_full_page or (not unbounded and remaining <= page):
+                chunks.append(body)
+                if remaining is not None:
+                    remaining -= n
+                break
+            last = body["t"][-1]
+            keep = next((i for i in range(n - 1, -1, -1)
+                         if body["t"][i] < last), -1) + 1
+            if keep:
+                chunks.append({k: body[k][:keep] for k in keys})
+                if remaining is not None:
+                    remaining -= keep
+                    if remaining <= 0:
+                        break
+                cursor_ms = last
+            else:
+                # the page is entirely one millisecond: fetch that
+                # millisecond whole (bounded by events-per-ms), move on
+                full = fetch({"limit": -1,
+                              "startTime": self._iso(from_millis(last)),
+                              "untilTime": self._iso(
+                                  from_millis(last + 1))})
+                chunks.append(full)
+                if remaining is not None:
+                    remaining -= len(full["t"])
+                    if remaining <= 0:
+                        break
+                cursor_ms = last + 1
+
+        def col(k, dtype):
+            return np.concatenate(
+                [np.asarray(c[k], dtype=dtype) for c in chunks]) \
+                if chunks else np.array([], dtype=dtype)
+
+        out = {
+            "entity_id": col("entity_id", str),
+            "target_entity_id": col("target_entity_id", str),
+            "event": col("event", str),
+            "t": col("t", np.int64),
+        }
+        if property_field is not None:
+            out["prop"] = np.concatenate(
+                [np.array([np.nan if v is None else v
+                           for v in c.get("prop", [])], dtype=np.float32)
+                 for c in chunks]) if chunks else np.array(
+                     [], dtype=np.float32)
+        if not unbounded:
+            out = {k: v[:limit] for k, v in out.items()}
+        return out
 
     def _find_paginated(self, base_params):
         """Stream an unbounded time-ascending find in PAGE_SIZE chunks.

@@ -52,6 +52,99 @@ def remote(tmp_env):
     s.stop()
 
 
+class TestRemoteColumnar:
+    def test_columnar_matches_direct_backend(self, remote):
+        """GET /events/columnar.json: the remote columnar read equals
+        the server backend's own find_columnar (the PEvents bulk-scan
+        role over the network, one response instead of paged objects)."""
+        ev, app_id, _ = remote
+        for i in range(30):
+            ev.insert(mk(eid=f"u{i % 7}", sec=i,
+                         target_entity_type="item",
+                         target_entity_id=f"i{i % 5}",
+                         properties=DataMap(
+                             {"rating": float(i % 5) + 0.5})), app_id)
+        # one event without the property: must surface as NaN
+        ev.insert(mk(event="view", eid="u9", sec=40,
+                     target_entity_type="item", target_entity_id="i1"),
+                  app_id)
+        got = ev.find_columnar(app_id, property_field="rating")
+        ref = Storage.get_events().find_columnar(
+            app_id, property_field="rating")
+        assert got["entity_id"].tolist() == ref["entity_id"].tolist()
+        assert got["target_entity_id"].tolist() == \
+            ref["target_entity_id"].tolist()
+        assert got["event"].tolist() == ref["event"].tolist()
+        assert got["t"].tolist() == ref["t"].tolist()
+        np.testing.assert_array_equal(np.isnan(got["prop"]),
+                                      np.isnan(ref["prop"]))
+        np.testing.assert_allclose(got["prop"][~np.isnan(got["prop"])],
+                                   ref["prop"][~np.isnan(ref["prop"])])
+        # filters push down; no property field -> no prop column
+        sub = ev.find_columnar(app_id, event_names=["view"])
+        assert sub["event"].tolist() == ["view"] and "prop" not in sub
+        lim = ev.find_columnar(app_id, property_field="rating", limit=5)
+        assert len(lim["t"]) == 5
+
+    def test_columnar_pages_by_time_windows(self, remote, monkeypatch):
+        """With a tiny page the columnar read spans many windows — and
+        events sharing one millisecond (including a millisecond LARGER
+        than the page) must come through exactly once, in order, since
+        boundary milliseconds are refetched whole."""
+        ev, app_id, _ = remote
+        # 3 events per second for 20 ticks, plus 12 events in ONE tick
+        for i in range(20):
+            for j in range(3):
+                ev.insert(mk(eid=f"u{i}_{j}", sec=i,
+                             properties=DataMap({"rating": float(j)})),
+                          app_id)
+        for j in range(12):
+            ev.insert(mk(eid=f"burst{j}", sec=30,
+                         properties=DataMap({"rating": 1.0})), app_id)
+        monkeypatch.setattr(type(ev), "COLUMNAR_PAGE", 8)
+        got = ev.find_columnar(app_id, property_field="rating")
+        ref = Storage.get_events().find_columnar(
+            app_id, property_field="rating")
+        assert got["t"].tolist() == ref["t"].tolist()
+        assert sorted(got["entity_id"].tolist()) == \
+            sorted(ref["entity_id"].tolist())
+        assert len(got["prop"]) == 72
+        # row alignment survives the windowed reassembly: each entity
+        # still pairs with ITS property value
+        pairs = dict(zip(got["entity_id"].tolist(),
+                         got["prop"].tolist()))
+        for i in range(20):
+            for j in range(3):
+                assert pairs[f"u{i}_{j}"] == float(j)
+        # bounded read across windows honors the limit exactly
+        lim = ev.find_columnar(app_id, property_field="rating", limit=50)
+        assert len(lim["t"]) == 50
+        assert lim["t"].tolist() == ref["t"].tolist()[:50]
+
+    def test_columnar_empty(self, remote):
+        ev, app_id, _ = remote
+        out = ev.find_columnar(app_id, property_field="rating",
+                               event_names=["nosuch"])
+        assert len(out["entity_id"]) == 0 and len(out["prop"]) == 0
+
+    def test_columnar_falls_back_on_old_server(self, remote, monkeypatch):
+        """A server without the columnar route (404) must transparently
+        fall back to the streamed-find default."""
+        ev, app_id, _ = remote
+        ev.insert(mk(properties=DataMap({"rating": 2.0})), app_id)
+        orig = ev._request
+
+        def no_columnar(method, path, params=None, body=None):
+            if path == "/events/columnar.json":
+                return 404, {"message": "not found"}
+            return orig(method, path, params, body)
+
+        monkeypatch.setattr(ev, "_request", no_columnar)
+        out = ev.find_columnar(app_id, property_field="rating")
+        assert len(out["entity_id"]) == 1
+        np.testing.assert_allclose(out["prop"], [2.0])
+
+
 class TestRemoteEvents:
     def test_insert_get_delete(self, remote):
         ev, app_id, _ = remote
