@@ -668,8 +668,13 @@ def main():
     if serve_sweep:
         out["serve_wait_sweep_ms"] = serve_sweep
     if os.environ.get("PIO_BENCH_CPU_FALLBACK"):
-        out["note"] = ("TPU tunnel unreachable; CPU smoke-mode fallback "
-                       "(full_scale=false, NOT a chip measurement)")
+        out["note"] = (
+            "TPU tunnel unreachable; CPU smoke-mode fallback "
+            "(full_scale=false, NOT a chip measurement). The TPU "
+            "measurement plan is staged: scripts/tpu_bench_session.sh "
+            "runs this bench + --ablation (sweep_chunk/fused-iteration/"
+            "chol_pallas rows) on an idle box as soon as the tunnel "
+            "answers; see docs/ROUND3.md pending-on-hardware list.")
     print(json.dumps(out))
 
 
