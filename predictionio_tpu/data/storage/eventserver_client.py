@@ -29,6 +29,7 @@ event API).
 from __future__ import annotations
 
 import datetime as dt
+import gzip
 import http.client
 import json
 import threading
@@ -108,6 +109,9 @@ class RemoteEvents(base.Events):
         payload = (json.dumps(body).encode("utf-8")
                    if body is not None else None)
         headers = {"Content-Type": "application/json"} if payload else {}
+        # bulk responses (columnar training reads) gzip ~10x; the server
+        # only compresses when asked and past a size floor
+        headers["Accept-Encoding"] = "gzip"
         for attempt in (0, 1):   # one transparent reconnect, like pgsql
             c = self._conn()
             try:
@@ -120,6 +124,11 @@ class RemoteEvents(base.Events):
                 c.close()
                 if attempt:
                     raise
+        # decode OUTSIDE the retry loop: a corrupt gzip body is a
+        # response-decoding problem, not a transport failure — retrying
+        # would silently re-send writes (BadGzipFile is an OSError)
+        if resp.headers.get("Content-Encoding") == "gzip":
+            data = gzip.decompress(data)
         try:
             decoded = json.loads(data.decode("utf-8")) if data else None
         except ValueError:

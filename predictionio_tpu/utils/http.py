@@ -10,6 +10,7 @@ serialized behind the algorithm's own jit calls (XLA queues per-device).
 
 from __future__ import annotations
 
+import gzip
 import json
 import logging
 import re
@@ -59,6 +60,25 @@ class Response:
 
 
 Handler = Callable[[Request], Response]
+
+
+def _accepts_gzip(value: str) -> bool:
+    """True when an Accept-Encoding value allows gzip — token match, not
+    substring (``gzip;q=0`` is an explicit refusal)."""
+    for part in value.split(","):
+        bits = part.strip().split(";")
+        if bits[0].strip().lower() != "gzip":
+            continue
+        for b in bits[1:]:
+            b = b.strip().lower()
+            if b.startswith("q="):
+                try:
+                    if float(b[2:]) == 0.0:
+                        return False
+                except ValueError:
+                    pass
+        return True
+    return False
 
 
 class Router:
@@ -136,6 +156,15 @@ class HttpServer:
                 payload = resp.payload()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
+                # transparent gzip for clients that ask: bulk JSON (the
+                # columnar training reads) compresses ~10x, which is the
+                # difference on a thin link; tiny responses skip the
+                # CPU cost. Header names are case-insensitive — use the
+                # Message object, not the plain dict.
+                accept = self.headers.get("Accept-Encoding") or ""
+                if (_accepts_gzip(accept) and len(payload) >= 1024):
+                    payload = gzip.compress(payload, compresslevel=1)
+                    self.send_header("Content-Encoding", "gzip")
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)

@@ -121,6 +121,52 @@ class TestRemoteColumnar:
         assert len(lim["t"]) == 50
         assert lim["t"].tolist() == ref["t"].tolist()[:50]
 
+    def test_columnar_rides_gzip(self, remote):
+        """Bulk responses gzip on the wire when the client asks (the
+        thin-link case remote training exists for), and the client
+        decodes transparently; non-asking clients get identity."""
+        import gzip as _gzip
+        import http.client as hc
+        ev, app_id, _ = remote
+        for i in range(300):
+            ev.insert(mk(eid=f"u{i}", sec=i % 50,
+                         properties=DataMap({"rating": 1.0})), app_id)
+        # raw request WITH gzip: encoded on the wire
+        conn = hc.HTTPConnection("127.0.0.1", ev.port, timeout=10)
+        conn.request("GET", "/events/columnar.json?accessKey="
+                     f"{ev.access_key}&limit=-1",
+                     headers={"Accept-Encoding": "gzip"})
+        r = conn.getresponse()
+        raw = r.read()
+        assert r.headers.get("Content-Encoding") == "gzip"
+        import json as _json
+        body = _json.loads(_gzip.decompress(raw))
+        assert len(body["t"]) == 300
+        # raw request WITHOUT gzip: identity
+        conn.request("GET", "/events/columnar.json?accessKey="
+                     f"{ev.access_key}&limit=-1")
+        r = conn.getresponse()
+        assert r.headers.get("Content-Encoding") is None
+        assert len(_json.loads(r.read())["t"]) == 300
+        # lowercase header name works (case-insensitive per RFC)
+        conn.request("GET", "/events/columnar.json?accessKey="
+                     f"{ev.access_key}&limit=-1",
+                     headers={"accept-encoding": "gzip"})
+        r = conn.getresponse()
+        assert r.headers.get("Content-Encoding") == "gzip"
+        r.read()
+        # explicit refusal gzip;q=0 gets identity
+        conn.request("GET", "/events/columnar.json?accessKey="
+                     f"{ev.access_key}&limit=-1",
+                     headers={"Accept-Encoding": "gzip;q=0, identity"})
+        r = conn.getresponse()
+        assert r.headers.get("Content-Encoding") is None
+        r.read()
+        conn.close()
+        # the storage client decodes transparently
+        cols = ev.find_columnar(app_id)
+        assert len(cols["t"]) == 300
+
     def test_columnar_empty(self, remote):
         ev, app_id, _ = remote
         out = ev.find_columnar(app_id, property_field="rating",
