@@ -359,6 +359,37 @@ def cmd_trim(args) -> int:
     return 0
 
 
+def cmd_servers(args) -> int:
+    """Probe the stack's service ports and report what's live — the
+    operator's one-glance view of the daemons pio-start-all manages
+    (plus any deployed engine server)."""
+    import urllib.error
+    from concurrent.futures import ThreadPoolExecutor
+
+    def probe(name, port):
+        """(display row, is_up) — probes run concurrently so a dropped
+        host costs one timeout, not four."""
+        url = f"http://{args.ip}:{port}/"
+        try:
+            with urllib.request.urlopen(url, timeout=3) as resp:
+                return f"  {name:14s} :{port:<6d} UP ({resp.status})", True
+        except urllib.error.HTTPError as e:
+            # an HTTP error still means something is listening
+            return f"  {name:14s} :{port:<6d} UP ({e.code})", True
+        except Exception:
+            return f"  {name:14s} :{port:<6d} down", False
+
+    targets = [("eventserver", args.event_server_port),
+               ("engine", args.engine_port),
+               ("dashboard", args.dashboard_port),
+               ("adminserver", args.admin_port)]
+    with ThreadPoolExecutor(len(targets)) as ex:
+        rows = list(ex.map(lambda t: probe(*t), targets))
+    for row, _ in rows:
+        _print(row)
+    return 0 if any(up for _, up in rows) else 1
+
+
 def cmd_snapshot(args) -> int:
     """Durability verbs for the nativelog event store: shard files shipped
     to / restored from a URI-addressed blob store (data/storage/
@@ -567,6 +598,15 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--src-channelid", type=int)
     tr.add_argument("--dst-channelid", type=int)
     tr.set_defaults(func=cmd_trim)
+
+    sv = sub.add_parser("servers",
+                        help="probe the stack's service ports")
+    sv.add_argument("--ip", default="127.0.0.1")
+    sv.add_argument("--event-server-port", type=int, default=7070)
+    sv.add_argument("--engine-port", type=int, default=8000)
+    sv.add_argument("--dashboard-port", type=int, default=9000)
+    sv.add_argument("--admin-port", type=int, default=7071)
+    sv.set_defaults(func=cmd_servers)
 
     sn = sub.add_parser(
         "snapshot", help="ship/restore nativelog shard snapshots to a "

@@ -316,6 +316,30 @@ class TestCLI:
         assert not (tdir / "engine.json").exists()
 
 
+class TestServersVerb:
+    def test_probes_live_and_down_ports(self, tmp_env, capsys):
+        """pio servers reports UP for a listening service and down for
+        the rest; exit 0 when anything is live, 1 when nothing is."""
+        from predictionio_tpu.data.api.event_server import (
+            EventServer, EventServerConfig)
+        s = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+        s.start()
+        try:
+            assert cli_main(["servers", "--event-server-port",
+                             str(s.config.port),
+                             "--engine-port", "1",
+                             "--dashboard-port", "1",
+                             "--admin-port", "1"]) == 0
+            out = capsys.readouterr().out
+            assert "eventserver" in out and "UP" in out
+            assert out.count("down") == 3
+        finally:
+            s.stop()
+        assert cli_main(["servers", "--event-server-port", "1",
+                         "--engine-port", "1", "--dashboard-port", "1",
+                         "--admin-port", "1"]) == 1
+
+
 class TestDashboard:
     def test_lists_evaluations(self, tmp_env):
         from predictionio_tpu.tools.dashboard import (Dashboard,
