@@ -132,19 +132,56 @@ def synthetic_ml20m(n_users, n_items, nnz, seed=0):
     return user_idx, item_idx, rating
 
 
+def hard_sync(x) -> float:
+    """Close a timed region with a one-element host fetch: it cannot
+    complete before the device finished the enqueued chain, even where
+    block_until_ready is a no-op (the round-1 axon timing bug)."""
+    import jax
+    return float(np.asarray(jax.device_get(x[:1, :1]))[0, 0])
+
+
+def prepare_als_run(mesh, ratings, cfg, seed: int = 1,
+                    batch_multiple: int = 1):
+    """The shared scaffold of every timed ALS benchmark: build both
+    solve plans, upload them (sweep-chunk merged), init device-resident
+    factors and hyperparameter scalars. Returns a dict so callers pick
+    what they need."""
+    from predictionio_tpu.ops import als as A
+    from predictionio_tpu.ops.ratings import plan_for_items, plan_for_users
+
+    user_plan = plan_for_users(ratings, work_budget=cfg.work_budget,
+                               batch_multiple=batch_multiple)
+    item_plan = plan_for_items(ratings, work_budget=cfg.work_budget,
+                               batch_multiple=batch_multiple)
+    chunk = A.resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
+    return {
+        "user_plan": user_plan, "item_plan": item_plan,
+        "user_batches": A._upload_plan(mesh, user_plan, chunk),
+        "item_batches": A._upload_plan(mesh, item_plan, chunk),
+        "U": mesh.put_replicated(
+            A._init_factors(ratings.n_users, cfg.rank, seed, 1)),
+        "V": mesh.put_replicated(
+            A._init_factors(ratings.n_items, cfg.rank, seed, 2)),
+        "lam": mesh.put_replicated(np.float32(cfg.lam)),
+        "alpha": mesh.put_replicated(np.float32(cfg.alpha)),
+    }
+
+
 def bench_als(full_scale: bool):
     import jax
     from predictionio_tpu.ops import als as A
     from predictionio_tpu.ops.als import ALSConfig, ALSModel, als_rmse
-    from predictionio_tpu.ops.ratings import (RatingsCOO, plan_for_items,
-                                              plan_for_users)
+    from predictionio_tpu.ops.ratings import RatingsCOO
     from predictionio_tpu.parallel.mesh import current_mesh
 
     if full_scale:
         n_users, n_items, nnz, rank = 138_493, 26_744, 20_000_000, 200
         iters_timed = 4
-    else:  # CPU smoke mode
-        n_users, n_items, nnz, rank = 2_000, 500, 60_000, 32
+    else:  # CPU smoke mode — nnz >= 1M so the fixed dispatch overhead is
+        # a small fraction of an iteration and scale_check_ratio ~ 1.0
+        # actually validates the timing (at the old 60k, a 27 ms
+        # iteration was mostly overhead and the 0.6..1.67 gate was loose)
+        n_users, n_items, nnz, rank = 20_000, 4_000, 1_200_000, 32
         iters_timed = 4
 
     t0 = time.perf_counter()
@@ -166,29 +203,23 @@ def bench_als(full_scale: bool):
 
     # host prep + one-time HBM residency for the solve plans
     t0 = time.perf_counter()
-    user_plan = plan_for_users(ratings, work_budget=cfg.work_budget)
-    item_plan = plan_for_items(ratings, work_budget=cfg.work_budget)
-    chunk = A.resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
-    user_batches = A._upload_plan(mesh, user_plan, chunk)
-    item_batches = A._upload_plan(mesh, item_plan, chunk)
+    run = prepare_als_run(mesh, ratings, cfg, seed=cfg.seed)
+    user_plan, item_plan = run["user_plan"], run["item_plan"]
+    user_batches, item_batches = run["user_batches"], run["item_batches"]
     prep_s = time.perf_counter() - t0
 
-    U = mesh.put_replicated(A._init_factors(n_users, rank, cfg.seed, 1))
-    V = mesh.put_replicated(A._init_factors(n_items, rank, cfg.seed, 2))
-    lam_dev = mesh.put_replicated(np.float32(cfg.lam))
-    alpha_dev = mesh.put_replicated(np.float32(cfg.alpha))
+    U, V = run["U"], run["V"]
+    lam_dev, alpha_dev = run["lam"], run["alpha"]
 
     def run_iters(k):
-        """k full iterations dispatched back-to-back, closed by a HARD sync:
-        fetching one element of V to host cannot complete before the device
-        finished the whole chain, so the wall-clock includes execution even
-        if block_until_ready is a no-op on this platform (the round-1 bug)."""
+        """k full iterations dispatched back-to-back, closed by hard_sync
+        so the wall-clock includes execution."""
         nonlocal U, V
         t0 = time.perf_counter()
         for _ in range(k):
             U = A._run_side(user_batches, U, V, cfg, None, lam_dev, alpha_dev)
             V = A._run_side(item_batches, V, U, cfg, None, lam_dev, alpha_dev)
-        float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])
+        hard_sync(V)
         return time.perf_counter() - t0
 
     # warmup compiles the two sweep programs (one per side)
@@ -239,8 +270,14 @@ def bench_als(full_scale: bool):
         "hbm_gb_per_iteration": round(hbm_bytes / 1e9, 2),
         "counted_flops_per_iteration": flops_iter,
         "scale_check_ratio": round(scale_ratio, 3),
-        "padding_overhead": round(user_plan.padding_overhead
-                                  + item_plan.padding_overhead, 3),
+        # combined padded/real gather-position ratio across both sweeps
+        # (rounds 1-3 reported the SUM of the two per-side ratios, which
+        # read as a ~2.4x tax when the real inflation was ~1.2x/side)
+        "padding_overhead": round(
+            (user_plan.padded_work + item_plan.padded_work)
+            / max(user_plan.nnz + item_plan.nnz, 1), 3),
+        "padding_overhead_user": round(user_plan.padding_overhead, 3),
+        "padding_overhead_item": round(item_plan.padding_overhead, 3),
         "warmup_s": warm_s,
         "prep_s": round(prep_s, 3),
         "datagen_s": gen_s,
@@ -495,6 +532,7 @@ def bench_rest_latency(model, n_queries=200, wait_ms=2.0):
         d_b = stats.get("batches", 0) - pre.get("batches", 0)
         return {"p50_ms": float(np.percentile(lat, 50) * 1000),
                 "p95_ms": float(np.percentile(lat, 95) * 1000),
+                "p99_ms": float(np.percentile(lat, 99) * 1000),
                 "qps_serial": float(1.0 / lat.mean()),
                 "qps_concurrent16": float(n_total / conc_dt),
                 "server_avg_total_ms": stats["avgServingSec"] * 1000,
@@ -647,6 +685,7 @@ def main():
             s = bench_rest_latency(model, n_queries=100, wait_ms=w)
             serve_sweep[f"{w:g}"] = {
                 "p50_ms": round(s["p50_ms"], 3),
+                "p99_ms": round(s["p99_ms"], 3),
                 "qps_concurrent16": round(s["qps_concurrent16"], 1),
                 "avg_batch": round(s["serve_avg_batch_size"], 2)}
     product_stats = {}
@@ -811,7 +850,199 @@ def solver_ablation():
                   flush=True)
 
 
+def mesh_sweep():
+    """Multi-chip weak scaling, measured: run the ALS iteration on 1
+    device and on the full visible slice, reporting ratings/s/chip for
+    each plus the compiled program's collective instructions (the
+    GSPMD-emitted ICI traffic). Run: python bench.py --mesh-sweep.
+    On a 1-chip host this degrades to the single-chip row — the sweep is
+    staged so a multi-chip slice produces the scaling artifact with no
+    code changes (VERDICT r3 item 6)."""
+    import jax
+    from predictionio_tpu.ops import als as A
+    from predictionio_tpu.ops.als import ALSConfig
+    from predictionio_tpu.ops.ratings import RatingsCOO
+    from predictionio_tpu.parallel.collective_stats import collective_stats
+    from predictionio_tpu.parallel.mesh import make_mesh
+    from predictionio_tpu.ops.solve import resolve_solver
+
+    full = jax.default_backend() not in ("cpu",)
+    if full:
+        n_users, n_items, nnz, rank = 138_493, 26_744, 20_000_000, 200
+    else:
+        n_users, n_items, nnz, rank = 20_000, 4_000, 1_200_000, 32
+    ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
+    ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
+    configure_compilation_cache()
+
+    devices = jax.devices()
+    rows = []
+    for n in sorted({1, len(devices)}):
+        mesh = make_mesh(devices=devices[:n])
+        cfg = ALSConfig(rank=rank, iterations=1, lam=0.05, seed=1,
+                        compute_dtype=("bfloat16" if full else "float32"),
+                        work_budget=(1 << 20),
+                        solver=resolve_solver("auto", n))
+        run = prepare_als_run(mesh, ratings, cfg, batch_multiple=n)
+        U, V = run["U"], run["V"]
+        user_b, item_b = run["user_batches"], run["item_batches"]
+        lam, alpha = run["lam"], run["alpha"]
+
+        def run_iter(U, V):
+            U = A._run_side(user_b, U, V, cfg, None, lam, alpha)
+            V = A._run_side(item_b, V, U, cfg, None, lam, alpha)
+            return U, V
+
+        U, V = run_iter(U, V)   # warm/compile
+        hard_sync(V)
+        t0 = time.perf_counter()
+        for _ in range(2):
+            U, V = run_iter(U, V)
+        hard_sync(V)
+        dt = (time.perf_counter() - t0) / 2
+        comp = A._solve_sweep.lower(
+            U, V, None, user_b, lam, alpha,
+            nratings_reg=True, implicit=False, rank=rank,
+            compute_dtype=cfg.compute_dtype, solver=cfg.solver).compile()
+        rows.append({
+            "n_devices": n,
+            "s_per_iteration": round(dt, 4),
+            "ratings_per_sec_per_chip": round(nnz / dt / n, 1),
+            "collective_instructions": collective_stats(comp),
+        })
+    out = {"metric": "als_mesh_weak_scaling", "backend":
+           jax.default_backend(), "full_scale": full, "rows": rows}
+    if len(rows) == 2:
+        out["weak_scaling_efficiency"] = round(
+            rows[1]["ratings_per_sec_per_chip"]
+            / rows[0]["ratings_per_sec_per_chip"], 3)
+    print(json.dumps(out), flush=True)
+
+
+def full_scale_cpu_report(out_path="FULLSCALE_CPU.json"):
+    """Tunnel-independent full-scale evidence: build the REAL ML-20M /
+    rank-200 plan (138,493 x 26,744, 20M nnz — BASELINE.json north star),
+    run iterations on CPU, and emit plan statistics + convergence to a
+    committed artifact. Proves the north-star shape builds, fits in
+    memory, and converges without any TPU; the per-iteration *time* is a
+    CPU number and is labeled as such. Run: python bench.py --full-scale-cpu
+    """
+    import resource
+
+    import jax
+    from predictionio_tpu.ops import als as A
+    from predictionio_tpu.ops.als import ALSConfig, ALSModel, als_rmse
+    from predictionio_tpu.ops.ratings import (RatingsCOO, plan_for_items,
+                                              plan_for_users)
+    from predictionio_tpu.parallel.mesh import current_mesh
+    from predictionio_tpu.ops.solve import resolve_solver
+
+    n_users, n_items, nnz, rank = 138_493, 26_744, 20_000_000, 200
+    t0 = time.perf_counter()
+    ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
+    ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
+    gen_s = time.perf_counter() - t0
+
+    configure_compilation_cache()
+    mesh = current_mesh()
+    cfg = ALSConfig(rank=rank, iterations=1, lam=0.05, seed=1,
+                    work_budget=(1 << 20),
+                    solver=resolve_solver("auto", mesh.n_devices))
+
+    t0 = time.perf_counter()
+    user_plan = plan_for_users(ratings, work_budget=cfg.work_budget)
+    item_plan = plan_for_items(ratings, work_budget=cfg.work_budget)
+    plan_s = time.perf_counter() - t0
+
+    host_plan_bytes = sum(
+        b.rows.nbytes + b.idx.nbytes + b.val.nbytes + b.mask.nbytes
+        for p in (user_plan, item_plan) for b in p.batches)
+    factor_bytes = (n_users + n_items + 2) * rank * 4
+    flops_iter = als_iteration_flops(user_plan, item_plan, rank)
+    hbm_bytes = als_iteration_hbm_bytes(user_plan, item_plan, rank,
+                                        "bfloat16")
+    v5e_roofline_s = hbm_bytes / DEVICE_HBM_BW["TPU v5 lite"]
+
+    t0 = time.perf_counter()
+    chunk = A.resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
+    user_batches = A._upload_plan(mesh, user_plan, chunk)
+    item_batches = A._upload_plan(mesh, item_plan, chunk)
+    upload_s = time.perf_counter() - t0
+
+    U = mesh.put_replicated(A._init_factors(n_users, rank, cfg.seed, 1))
+    V = mesh.put_replicated(A._init_factors(n_items, rank, cfg.seed, 2))
+    lam_dev = mesh.put_replicated(np.float32(cfg.lam))
+    alpha_dev = mesh.put_replicated(np.float32(cfg.alpha))
+
+    sample = np.random.default_rng(0).choice(nnz, 200_000, replace=False)
+    sub = RatingsCOO(ui[sample], ii[sample], vv[sample], n_users, n_items)
+
+    def rmse_now():
+        m = ALSModel(np.asarray(U)[:n_users], np.asarray(V)[:n_items], rank)
+        return round(float(als_rmse(m, sub)), 4)
+
+    rmse_by_iter = [rmse_now()]
+    iter_s = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        U = A._run_side(user_batches, U, V, cfg, None, lam_dev, alpha_dev)
+        V = A._run_side(item_batches, V, U, cfg, None, lam_dev, alpha_dev)
+        hard_sync(V)
+        iter_s.append(round(time.perf_counter() - t0, 2))
+        rmse_by_iter.append(rmse_now())
+
+    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    out = {
+        "artifact": "full_scale_cpu_evidence",
+        "workload": {"n_users": n_users, "n_items": n_items, "nnz": nnz,
+                     "rank": rank},
+        "backend": jax.default_backend(),
+        "plan": {
+            "user_batches": len(user_plan.batches),
+            "item_batches": len(item_plan.batches),
+            "user_scan_groups": len(user_plan.kernel_shapes),
+            "item_scan_groups": len(item_plan.kernel_shapes),
+            "padding_overhead_user": round(user_plan.padding_overhead, 3),
+            "padding_overhead_item": round(item_plan.padding_overhead, 3),
+            "padding_overhead": round(
+                (user_plan.padded_work + item_plan.padded_work)
+                / (user_plan.nnz + item_plan.nnz), 3),
+            "host_plan_gb": round(host_plan_bytes / 1e9, 3),
+            "factor_tables_gb": round(factor_bytes / 1e9, 4),
+            "counted_flops_per_iteration": flops_iter,
+            "hbm_gb_per_iteration": round(hbm_bytes / 1e9, 2),
+            "v5e_roofline_s_per_iteration": round(v5e_roofline_s, 3),
+            "plan_build_s": round(plan_s, 1),
+            "upload_s": round(upload_s, 1),
+            "datagen_s": round(gen_s, 1),
+        },
+        "execution": {
+            "iterations_run": len(iter_s),
+            "cpu_s_per_iteration": iter_s,  # first includes compile
+            "rmse_sample_by_iteration": rmse_by_iter,
+            "converges": rmse_by_iter[-1] < rmse_by_iter[0],
+            "peak_host_rss_gb": round(peak_rss_gb, 2),
+        },
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
 if __name__ == "__main__":
+    if "--full-scale-cpu" in sys.argv:
+        full_scale_cpu_report()
+        raise SystemExit(0)
+    if "--mesh-sweep" in sys.argv:
+        if device_alive() is None:
+            # the artifact file is *.json: even the failure line parses
+            print(json.dumps({"metric": "als_mesh_weak_scaling",
+                              "error": "device unreachable"}))
+            raise SystemExit(1)
+        mesh_sweep()
+        raise SystemExit(0)
     if "--ablation" in sys.argv:
         if device_alive() is None:
             print("device unreachable")

@@ -100,6 +100,8 @@ def _backend_module(type_: str):
         "mysql": "predictionio_tpu.data.storage.mysql",  # wire-protocol MySQL
         "nativelog": "predictionio_tpu.data.storage.nativelog",  # C++ log
         "remotefs": "predictionio_tpu.data.storage.remotefs",  # URI blobs
+        # embedded document-index metadata store (the Elasticsearch role)
+        "docindex": "predictionio_tpu.data.storage.docindex",
         "hdfs": "predictionio_tpu.data.storage.remotefs",  # HDFS role
         # Events DAO over a remote event server's REST API (network-only
         # access to the central store)

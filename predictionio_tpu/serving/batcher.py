@@ -7,12 +7,15 @@ concurrent load the server can coalesce queries that arrive within a short
 window into ONE batched device call (Algorithm.batch_predict) and fan the
 results back out — the standard accelerator-serving pattern.
 
-Opt-in via ServerConfig.micro_batch > 1. Every dispatch holds the door
-open for up to `max_wait_ms` (default 2 ms) so requests still mid-flight
-through HTTP parsing join the current batch — an isolated query
-therefore pays up to max_wait extra latency (microscopic next to one
-device round trip), and concurrent load coalesces into full batches
-instead of fragments.
+Opt-in via ServerConfig.micro_batch > 1. The coalescing window is
+ADAPTIVE: each dispatch holds the door open for up to `max_wait_ms` only
+while the recent inter-arrival rate says more queries are actually
+coming (EMA of arrival gaps <= window); an isolated query on an idle
+server dispatches immediately and pays no window at all. The window also
+closes early the moment the batch fills, and `latency_budget_ms`, when
+set, caps how long the OLDEST query in a batch may sit in the coalescing
+stage regardless of arrival rate (the knob for tail-latency-sensitive
+deployments; it bounds queueing delay, not device time).
 """
 
 from __future__ import annotations
@@ -20,34 +23,45 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
 
 
 class _Pending:
-    __slots__ = ("query", "event", "result", "error")
+    __slots__ = ("query", "event", "result", "error", "t_enqueue")
 
     def __init__(self, query):
         self.query = query
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.t_enqueue = time.perf_counter()
 
 
 class MicroBatcher:
     def __init__(self, process_batch, max_batch: int = 32,
-                 max_wait_ms: float = 2.0):
+                 max_wait_ms: float = 5.0,
+                 latency_budget_ms: Optional[float] = None):
         """process_batch: fn(List[query]) -> List[result]."""
         self.process_batch = process_batch
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
+        self.latency_budget_s = (latency_budget_ms / 1000.0
+                                 if latency_budget_ms is not None else None)
         # realized coalescing telemetry (read via /stats.json): whether
         # concurrent load actually forms full batches is THE datum for
         # tuning micro_batch_wait_ms on a given link
         self.n_batches = 0
         self.n_queries = 0
         self.max_batch_seen = 0
+        # batches dispatched without holding the window (idle fast path)
+        self.n_immediate = 0
+        # adaptive-window state, touched only by the dispatch thread:
+        # EMA of query inter-arrival gaps; None until two arrivals seen
+        self._ema_gap: Optional[float] = None
+        self._prev_arrival: Optional[float] = None
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -63,7 +77,8 @@ class MicroBatcher:
         mx = self.max_batch_seen
         return {"batches": nb, "batchedQueries": nq,
                 "avgBatchSize": (nq / nb if nb else 0.0),
-                "maxBatchSize": mx}
+                "maxBatchSize": mx,
+                "immediateBatches": self.n_immediate}
 
     def submit(self, query) -> Any:
         """Blocking: enqueue and wait for the batched result."""
@@ -74,37 +89,59 @@ class MicroBatcher:
             raise p.error
         return p.result
 
+    def _observe_arrival(self, t_enqueue: float):
+        """EMA of inter-arrival gaps (clipped at 1 s so one idle night
+        doesn't take minutes of traffic to forget)."""
+        if self._prev_arrival is not None:
+            gap = min(max(t_enqueue - self._prev_arrival, 0.0), 1.0)
+            self._ema_gap = (gap if self._ema_gap is None
+                             else 0.7 * self._ema_gap + 0.3 * gap)
+        self._prev_arrival = t_enqueue
+
     def _loop(self):
         while not self._stop.is_set():
             try:
                 first = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
+            self._observe_arrival(first.t_enqueue)
             batch = [first]
             # adaptive batching: drain the backlog that accumulated while
             # the previous batch was on the device, then hold the door
             # open for at most max_wait so requests mid-flight through
             # HTTP parsing (threads arrive staggered under the GIL) join
-            # this batch instead of forming a tiny next one. The window
-            # is a few ms — noise next to one device round trip — and it
-            # is what turns 16 concurrent clients into batches of ~16
-            # rather than ~4.
-            import time
-            deadline = time.perf_counter() + self.max_wait_s
+            # this batch instead of forming a tiny next one — but ONLY
+            # when the recent arrival rate says anyone else is coming
+            # (EMA gap <= window). An idle server dispatches immediately,
+            # so the window costs isolated queries nothing; under 16-way
+            # concurrent load it is what turns the stream into batches of
+            # ~16 rather than ~4.
+            hold = (self._ema_gap is not None
+                    and self._ema_gap <= self.max_wait_s)
+            deadline = time.perf_counter() + (self.max_wait_s if hold
+                                              else 0.0)
+            if self.latency_budget_s is not None:
+                # cap the oldest query's time in the coalescing stage
+                deadline = min(deadline,
+                               first.t_enqueue + self.latency_budget_s)
             while len(batch) < self.max_batch:
                 try:
-                    batch.append(self._q.get_nowait())
+                    p = self._q.get_nowait()
                 except queue.Empty:
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
                         break
                     try:
-                        batch.append(self._q.get(timeout=remaining))
+                        p = self._q.get(timeout=remaining)
                     except queue.Empty:
                         break
+                self._observe_arrival(p.t_enqueue)
+                batch.append(p)
             self.n_batches += 1
             self.n_queries += len(batch)
             self.max_batch_seen = max(self.max_batch_seen, len(batch))
+            if not hold:
+                self.n_immediate += 1
             try:
                 results = self.process_batch([p.query for p in batch])
                 if len(results) != len(batch):

@@ -38,6 +38,16 @@ logger = logging.getLogger(__name__)
 _SHUTDOWN = 0xFFFFFFFF
 
 
+class MeshServingUnavailable(RuntimeError):
+    """The mesh coordinator cannot serve: a broadcast collective failed to
+    complete (a worker process is dead or wedged) and the coordinator is
+    poisoned. Maps to HTTP 503 — the operator must redeploy the mesh, the
+    same recovery the reference's MasterActor expects after an executor
+    loss (CreateServer.scala:277-400 bind-retry/undeploy role)."""
+
+    http_status = 503
+
+
 class MeshQueryCoordinator:
     """Serializes and broadcasts query payloads so every JAX process runs
     the same SPMD predict program in the same order.
@@ -48,27 +58,35 @@ class MeshQueryCoordinator:
     (a dict for single queries, a list for micro-batched windows).
     """
 
-    def __init__(self, max_bytes: int = 1 << 16):
+    def __init__(self, max_bytes: int = 1 << 16,
+                 broadcast_timeout_s: float = 30.0):
         import jax
         self.max_bytes = max_bytes
+        self.broadcast_timeout_s = broadcast_timeout_s
         self.n_processes = jax.process_count()
         self.is_primary = jax.process_index() == 0
         self._lock = threading.Lock()
         self._down = False
+        # poisoned = a broadcast never completed (dead/wedged worker):
+        # every subsequent query fails fast with 503 instead of queueing
+        # behind a collective that will never finish
+        self._poisoned = False
 
     @property
     def multi_process(self) -> bool:
         return self.n_processes > 1
 
     @classmethod
-    def create_if_distributed(cls, max_bytes: int = 1 << 16
+    def create_if_distributed(cls, max_bytes: int = 1 << 16,
+                              broadcast_timeout_s: float = 30.0
                               ) -> Optional["MeshQueryCoordinator"]:
         """A coordinator when running under a multi-process mesh, else
         None (single-process serving needs no broadcast)."""
         try:
             import jax
             if jax.process_count() > 1:
-                return cls(max_bytes=max_bytes)
+                return cls(max_bytes=max_bytes,
+                           broadcast_timeout_s=broadcast_timeout_s)
         except Exception:  # jax not initialized — plain local serving
             pass
         return None
@@ -97,6 +115,48 @@ class MeshQueryCoordinator:
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.broadcast_one_to_all(buf))
 
+    def _bcast_watched(self, buf: np.ndarray) -> np.ndarray:
+        """Primary-side broadcast under a watchdog. The collective blocks
+        forever if a participant process is gone, so it runs in a daemon
+        thread with a deadline; on timeout the coordinator is POISONED —
+        the hung thread is abandoned (it can never be cancelled), no
+        further broadcasts are attempted, and every queued/future query
+        raises MeshServingUnavailable (503) instead of waiting on a
+        collective with a missing participant."""
+        done = threading.Event()
+        result: list = []
+
+        def run():
+            try:
+                result.append(self._bcast(buf))
+            except BaseException as e:  # runtime teardown raises SystemExit
+                result.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="mesh-bcast-watchdog")
+        t.start()
+        if not done.wait(self.broadcast_timeout_s):
+            self._poisoned = True
+            logger.critical(
+                "mesh broadcast did not complete within %.1fs — a worker "
+                "process is dead or wedged; coordinator poisoned, all "
+                "further mesh queries answer 503 until redeploy",
+                self.broadcast_timeout_s)
+            raise MeshServingUnavailable(
+                f"mesh broadcast timed out after "
+                f"{self.broadcast_timeout_s:.1f}s (worker dead?); "
+                f"redeploy the mesh")
+        out = result[0]
+        if isinstance(out, BaseException):
+            self._poisoned = True
+            logger.critical("mesh broadcast failed (%s: %s) — "
+                            "coordinator poisoned", type(out).__name__, out)
+            raise MeshServingUnavailable(
+                f"mesh broadcast failed: {out}") from out
+        return out
+
     # -- primary side -------------------------------------------------------
     @contextmanager
     def serialized(self, payload):
@@ -107,10 +167,18 @@ class MeshQueryCoordinator:
         if not self.multi_process or not self.is_primary:
             yield
             return
+        if self._poisoned:
+            raise MeshServingUnavailable(
+                "mesh coordinator is poisoned (earlier broadcast never "
+                "completed); redeploy the mesh")
         with self._lock:
             if self._down:
                 raise RuntimeError("mesh coordinator is shut down")
-            self._bcast(self._encode(payload))
+            if self._poisoned:  # poisoned while we queued on the lock
+                raise MeshServingUnavailable(
+                    "mesh coordinator is poisoned (earlier broadcast "
+                    "never completed); redeploy the mesh")
+            self._bcast_watched(self._encode(payload))
             yield
 
     def shutdown(self):
@@ -122,11 +190,15 @@ class MeshQueryCoordinator:
             if self._down:          # lost the race to another stop()
                 return
             self._down = True
+            if self._poisoned:      # a release bcast would hang too
+                logger.warning("mesh coordinator poisoned: skipping "
+                               "worker-release broadcast")
+                return
             buf = np.zeros(self.max_bytes, np.uint8)
             buf[:4] = np.frombuffer(
                 np.uint32(_SHUTDOWN).tobytes(), np.uint8)
             try:
-                self._bcast(buf)
+                self._bcast_watched(buf)
             except Exception as e:  # peers already gone
                 logger.warning("mesh coordinator shutdown bcast: %s", e)
 

@@ -15,6 +15,7 @@ lastServingSec counters match the reference status page.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import threading
@@ -50,15 +51,28 @@ class ServerConfig:
     feedback: bool = False
     # >1 coalesces concurrent queries into one batched device call
     # (beyond-parity). On by default so a plain `pio deploy` gets the same
-    # concurrency mitigation the benchmarks measure; single queries pay at
-    # most micro_batch_wait_ms.
+    # concurrency mitigation the benchmarks measure. The window is
+    # ADAPTIVE (serving/batcher.py): an isolated query on an idle server
+    # dispatches immediately and pays none of it, so the default follows
+    # the round-3 throughput sweep (wait=5 ms gave ~1.5x the qps of
+    # wait=2 under 16-way load) without the idle-p50 cost that sweep
+    # charged.
     micro_batch: int = 16
-    micro_batch_wait_ms: float = 2.0
+    micro_batch_wait_ms: float = 5.0
+    # optional cap on how long the oldest query may sit in the
+    # coalescing stage (ms), for tail-latency-sensitive deployments
+    micro_batch_latency_budget_ms: Optional[float] = None
     # multi-process mesh serving: per-query broadcast buffer size; raise
     # it when large micro-batched windows of filter-heavy queries exceed
     # the default 64 KiB (every broadcast ships the full buffer, so keep
     # it as small as the workload allows)
     mesh_broadcast_bytes: int = 1 << 16
+    # watchdog deadline for the primary's per-query broadcast collective:
+    # if a worker process dies, the collective never completes — after
+    # this many seconds the coordinator poisons itself and answers 503
+    # (serving/mesh_serving.py MeshServingUnavailable) instead of
+    # queueing every subsequent query forever
+    mesh_broadcast_timeout_s: float = 30.0
 
 
 class EngineServer:
@@ -77,7 +91,8 @@ class EngineServer:
             from predictionio_tpu.serving.mesh_serving import \
                 MeshQueryCoordinator
             mesh_coordinator = MeshQueryCoordinator.create_if_distributed(
-                max_bytes=config.mesh_broadcast_bytes)
+                max_bytes=config.mesh_broadcast_bytes,
+                broadcast_timeout_s=config.mesh_broadcast_timeout_s)
         self.coordinator = mesh_coordinator
         self.engine = engine
         self.engine_params = engine_params
@@ -94,6 +109,10 @@ class EngineServer:
         self.serving_seconds = 0.0
         self.last_serving_sec = 0.0
         self.predict_seconds = 0.0
+        # per-request serving-time ring for tail percentiles (p50/p95/p99
+        # in /stats.json); 4096 samples bounds memory and keeps the
+        # percentiles a rolling view of recent traffic
+        self._lat_ring = collections.deque(maxlen=4096)
         self.start_time = utcnow()
         self.server: Optional[HttpServer] = None
         self.batcher = None
@@ -101,7 +120,8 @@ class EngineServer:
             from predictionio_tpu.serving.batcher import MicroBatcher
             self.batcher = MicroBatcher(
                 self.handle_query_batch, max_batch=config.micro_batch,
-                max_wait_ms=config.micro_batch_wait_ms)
+                max_wait_ms=config.micro_batch_wait_ms,
+                latency_budget_ms=config.micro_batch_latency_budget_ms)
         self.router = self._build_router()
 
     # -- model loading (createServerActorWithEngine, :206-265) -------------
@@ -193,6 +213,7 @@ class EngineServer:
             self.serving_seconds += dt
             self.last_serving_sec = dt
             self.predict_seconds += predict_dt
+            self._lat_ring.append(dt)
         return pred_dict
 
     def _spmd_guard(self, payload):
@@ -270,6 +291,9 @@ class EngineServer:
             self.serving_seconds += dt
             self.last_serving_sec = dt / max(len(queries), 1)
             self.predict_seconds += predict_dt
+            # every query in the window experienced the window's wall
+            # time inside the server: one ring sample per query
+            self._lat_ring.extend([dt] * len(queries))
         return out
 
     # -- feedback loop (:526-596) ------------------------------------------
@@ -361,6 +385,13 @@ class EngineServer:
                 "microBatch": self.config.micro_batch,
                 "startTime": self.start_time.isoformat(),
             }
+            if self._lat_ring:
+                import numpy as _np
+                p50, p95, p99 = _np.percentile(
+                    list(self._lat_ring), (50, 95, 99))
+                out.update({"p50ServingSec": float(p50),
+                            "p95ServingSec": float(p95),
+                            "p99ServingSec": float(p99)})
             if self.batcher is not None:
                 # realized coalescing (avg/max batch size) — the datum
                 # for tuning micro_batch_wait_ms on a given link

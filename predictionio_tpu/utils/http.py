@@ -151,8 +151,15 @@ class HttpServer:
                     resp = Response(400, {"message":
                                           f"missing field {e}"})
                 except Exception as e:
-                    logger.exception("handler error")
-                    resp = Response(500, {"message": str(e)})
+                    # exceptions that know their HTTP status (e.g. mesh
+                    # coordinator poisoned -> 503) pass it through
+                    status = getattr(e, "http_status", None)
+                    if status:
+                        logger.error("handler error (%d): %s", status, e)
+                        resp = Response(int(status), {"message": str(e)})
+                    else:
+                        logger.exception("handler error")
+                        resp = Response(500, {"message": str(e)})
                 payload = resp.payload()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)

@@ -37,5 +37,13 @@ if ! python bench.py --ablation > "$OUT/ablation.txt" 2>&1; then
     rc=1
 fi
 cat "$OUT/ablation.txt"
+echo "== mesh sweep (1 chip vs slice) -> $OUT/mesh_sweep.json =="
+if ! python bench.py --mesh-sweep > "$OUT/mesh_sweep.json" \
+        2> "$OUT/mesh_sweep.err"; then
+    echo "MESH SWEEP FAILED (rc != 0; single-chip tunnel still emits the"
+    echo "1-device row — a real failure means the device hung)"
+    rc=1
+fi
+tail -c 1500 "$OUT/mesh_sweep.json"; echo
 echo "== done: $OUT (rc=$rc) =="
 exit $rc

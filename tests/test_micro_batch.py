@@ -34,6 +34,46 @@ class TestMicroBatcher:
         assert max(batches) > 1          # some coalescing happened
         assert sum(batches) == 16
 
+    def test_idle_query_dispatches_immediately(self):
+        """An isolated query on an idle server must not pay the window:
+        the adaptive policy holds the door open only when the recent
+        arrival rate says more queries are coming."""
+        import time
+        b = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=500)
+        try:
+            t0 = time.perf_counter()
+            assert b.submit(7) == 7
+            assert time.perf_counter() - t0 < 0.25  # << the 500 ms window
+            assert b.stats()["immediateBatches"] >= 1
+        finally:
+            b.stop()
+
+    def test_dense_arrivals_hold_window_and_budget_caps_it(self):
+        """With a dense arrival history the dispatcher holds the window
+        (query waits ~max_wait); latency_budget_ms caps that hold."""
+        import time
+
+        held = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=300)
+        try:
+            held._ema_gap = 1e-4           # dense recent traffic
+            held._prev_arrival = time.perf_counter()
+            t0 = time.perf_counter()
+            held.submit(1)
+            assert time.perf_counter() - t0 >= 0.25   # window held
+        finally:
+            held.stop()
+
+        capped = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=300,
+                              latency_budget_ms=40)
+        try:
+            capped._ema_gap = 1e-4
+            capped._prev_arrival = time.perf_counter()
+            t0 = time.perf_counter()
+            capped.submit(1)
+            assert time.perf_counter() - t0 < 0.2     # budget closed it
+        finally:
+            capped.stop()
+
     def test_error_propagates_to_all_waiters(self):
         def handler(queries):
             raise RuntimeError("boom")

@@ -44,10 +44,30 @@ class _CompositeClient:
         self.meta_client.close()
 
 
-@pytest.fixture(params=["memory", "sqlite", "nativelog", "nativelog-p4"])
+@pytest.fixture(params=["memory", "sqlite", "nativelog", "nativelog-p4",
+                        "docindex"])
 def client(request, tmp_path):
     if request.param == "memory":
         c = MemClient(StorageClientConfig("TEST", "memory", {}))
+    elif request.param == "docindex":
+        # document-index metadata backend (the Elasticsearch role):
+        # metadata kinds on docindex, events/models on the memory double
+        # — the same split the reference runs (ES metadata next to an
+        # HBase event store and HDFS models)
+        from predictionio_tpu.data.storage.docindex import \
+            StorageClient as DocClient
+
+        class _MetaOnDoc(_CompositeClient):
+            def get_data_object(self, kind, namespace):
+                if kind in ("events", "models"):
+                    return self.events_client.get_data_object(
+                        kind, namespace)
+                return self.meta_client.get_data_object(kind, namespace)
+
+        c = _MetaOnDoc(
+            MemClient(StorageClientConfig("TEST", "memory", {})),
+            DocClient(StorageClientConfig(
+                "TEST", "docindex", {"PATH": str(tmp_path / "dix")})))
     elif request.param.startswith("nativelog"):
         from predictionio_tpu.data.storage.nativelog import \
             StorageClient as NativeClient
@@ -649,3 +669,83 @@ class TestNativeLogPartitions:
         cols = ev2.find_columnar(1)
         assert len(cols["entity_id"]) == 10
         c2.close()
+
+
+class TestDocIndex:
+    """The document-index backend's own paradigm guarantees (beyond the
+    shared DAO spec): log replay durability, torn-tail tolerance,
+    compaction, and term queries answered off the inverted index."""
+
+    def _client(self, tmp_path):
+        from predictionio_tpu.data.storage.docindex import StorageClient
+        return StorageClient(StorageClientConfig(
+            "TEST", "docindex", {"PATH": str(tmp_path / "dix")}))
+
+    def test_survives_reopen(self, tmp_path):
+        c = self._client(tmp_path)
+        apps = c.get_data_object("apps", "ns")
+        aid = apps.insert(App(0, "persisted", "d"))
+        aid2 = apps.insert(App(0, "deleted"))
+        apps.delete(aid2)
+        c.close()
+        c2 = self._client(tmp_path)
+        apps2 = c2.get_data_object("apps", "ns")
+        assert apps2.get(aid).name == "persisted"
+        assert apps2.get(aid2) is None
+        assert apps2.get_by_name("persisted").id == aid
+        # int-id sequence continues past the replayed ids
+        assert apps2.insert(App(0, "next")) == aid2 + 1
+        c2.close()
+
+    def test_torn_tail_ignored(self, tmp_path):
+        c = self._client(tmp_path)
+        apps = c.get_data_object("apps", "ns")
+        aid = apps.insert(App(0, "whole"))
+        c.close()
+        path = tmp_path / "dix" / "ns" / "apps.log"
+        with open(path, "ab") as f:
+            f.write(b'{"op":"put","id":"99","doc":{"id":99,"na')  # crash
+        c2 = self._client(tmp_path)
+        apps2 = c2.get_data_object("apps", "ns")
+        assert apps2.get(aid).name == "whole"
+        assert apps2.get(99) is None
+        c2.close()
+
+    def test_compaction_rewrites_log(self, tmp_path):
+        from predictionio_tpu.data.storage.docindex import DocIndex
+        ix = DocIndex(str(tmp_path / "c" / "x.log"), fsync=False)
+        for i in range(1500):
+            ix.put("hot", {"v": i})          # 1499 dead ops
+        assert ix.get("hot") == {"v": 1499}
+        # compaction fired at the 1024-dead-ops threshold and appends
+        # resumed after it: the log holds far fewer than 1500 ops
+        n_ops = sum(1 for _ in open(tmp_path / "c" / "x.log", "rb"))
+        assert n_ops < 600
+        ix.close()
+        ix2 = DocIndex(str(tmp_path / "c" / "x.log"), fsync=False)
+        assert ix2.get("hot") == {"v": 1499}
+        ix2.close()
+
+    def test_term_queries_use_posting_lists(self, tmp_path):
+        from predictionio_tpu.data.storage.docindex import DocIndex
+        ix = DocIndex(str(tmp_path / "q" / "x.log"), fsync=False)
+        for i in range(100):
+            ix.put(str(i), {"status": "DONE" if i % 3 == 0 else "INIT",
+                            "shard": i % 5, "t": i})
+        hits = ix.search(eq={"status": "DONE", "shard": 0},
+                         sort="t", reverse=True)
+        assert [d["t"] for d in hits] == [90, 75, 60, 45, 30, 15, 0]
+        # the intersection really came from the index, not a scan
+        assert ix._inv["status"]["DONE"] & ix._inv["shard"][0] == \
+            {str(d["t"]) for d in hits}
+        assert ix.search(eq={"status": "GONE"}) == []
+        ix.close()
+
+    def test_refuses_event_and_model_roles(self, tmp_path):
+        from predictionio_tpu.data.storage.registry import StorageError
+        c = self._client(tmp_path)
+        with pytest.raises(StorageError, match="metadata backend"):
+            c.get_data_object("events", "ns")
+        with pytest.raises(StorageError, match="metadata backend"):
+            c.get_data_object("models", "ns")
+        c.close()
