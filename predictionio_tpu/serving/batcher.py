@@ -7,15 +7,25 @@ concurrent load the server can coalesce queries that arrive within a short
 window into ONE batched device call (Algorithm.batch_predict) and fan the
 results back out — the standard accelerator-serving pattern.
 
-Opt-in via ServerConfig.micro_batch > 1. The coalescing window is
-ADAPTIVE: each dispatch holds the door open for up to `max_wait_ms` only
-while the recent inter-arrival rate says more queries are actually
-coming (EMA of arrival gaps <= window); an isolated query on an idle
-server dispatches immediately and pays no window at all. The window also
-closes early the moment the batch fills, and `latency_budget_ms`, when
-set, caps how long the OLDEST query in a batch may sit in the coalescing
-stage regardless of arrival rate (the knob for tail-latency-sensitive
-deployments; it bounds queueing delay, not device time).
+Opt-in via ServerConfig.micro_batch > 1. Coalescing is DRAIN-FIRST:
+each dispatch takes everything that queued while the previous batch was
+on the device — under load the queue grows, so batches grow, which is
+the self-regulating part that delivers the throughput. On top of that,
+the door is held open (up to `max_wait_ms`) only while MORE queries are
+known to be in flight (submitted, unanswered, not in this batch) than
+the batch holds: that covers the instants between a submit's counter
+increment and its queue put, and nothing else — a query still being
+HTTP-parsed is invisible to the server and no window can wait for it
+honestly. A lone closed-loop client (serial requests) always sees
+`batch == inflight` and dispatches immediately with no window cost; so
+does an idle server. Two earlier designs were rejected by measurement:
+an unconditional window (rounds 2-3) charged every serial query the
+full window, and an EMA-of-arrival-gaps gate charged them the same way
+because one closed-loop client's gaps equal the service time — dense by
+any rate heuristic. `latency_budget_ms`, when set, caps how long the
+OLDEST query may sit in the coalescing stage (the knob for
+tail-latency-sensitive deployments; it bounds queueing delay, not
+device time).
 """
 
 from __future__ import annotations
@@ -56,12 +66,13 @@ class MicroBatcher:
         self.n_batches = 0
         self.n_queries = 0
         self.max_batch_seen = 0
-        # batches dispatched without holding the window (idle fast path)
+        # batches dispatched without ever blocking on the window
+        # (idle / closed-loop-serial fast path)
         self.n_immediate = 0
-        # adaptive-window state, touched only by the dispatch thread:
-        # EMA of query inter-arrival gaps; None until two arrivals seen
-        self._ema_gap: Optional[float] = None
-        self._prev_arrival: Optional[float] = None
+        # queries submitted and not yet answered — the adaptive window's
+        # signal: hold only while the batch is smaller than this
+        self._inflight = 0
+        self._flight_lock = threading.Lock()
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -83,20 +94,13 @@ class MicroBatcher:
     def submit(self, query) -> Any:
         """Blocking: enqueue and wait for the batched result."""
         p = _Pending(query)
+        with self._flight_lock:
+            self._inflight += 1
         self._q.put(p)
         p.event.wait()
         if p.error is not None:
             raise p.error
         return p.result
-
-    def _observe_arrival(self, t_enqueue: float):
-        """EMA of inter-arrival gaps (clipped at 1 s so one idle night
-        doesn't take minutes of traffic to forget)."""
-        if self._prev_arrival is not None:
-            gap = min(max(t_enqueue - self._prev_arrival, 0.0), 1.0)
-            self._ema_gap = (gap if self._ema_gap is None
-                             else 0.7 * self._ema_gap + 0.3 * gap)
-        self._prev_arrival = t_enqueue
 
     def _loop(self):
         while not self._stop.is_set():
@@ -104,43 +108,43 @@ class MicroBatcher:
                 first = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            self._observe_arrival(first.t_enqueue)
             batch = [first]
-            # adaptive batching: drain the backlog that accumulated while
-            # the previous batch was on the device, then hold the door
-            # open for at most max_wait so requests mid-flight through
-            # HTTP parsing (threads arrive staggered under the GIL) join
-            # this batch instead of forming a tiny next one — but ONLY
-            # when the recent arrival rate says anyone else is coming
-            # (EMA gap <= window). An idle server dispatches immediately,
-            # so the window costs isolated queries nothing; under 16-way
-            # concurrent load it is what turns the stream into batches of
-            # ~16 rather than ~4.
-            hold = (self._ema_gap is not None
-                    and self._ema_gap <= self.max_wait_s)
-            deadline = time.perf_counter() + (self.max_wait_s if hold
-                                              else 0.0)
+            # Drain-first batching: take the backlog that accumulated
+            # while the previous batch was on the device (the
+            # self-regulating coalescing), then hold the door open ONLY
+            # while more queries are known in flight (submitted,
+            # unanswered, not yet in this batch) — i.e. between their
+            # counter increment and queue put, microseconds away. When
+            # batch == inflight nobody else is known to be coming: a
+            # closed-loop serial client, or an idle server, dispatches
+            # with zero window cost. max_wait bounds the hold in case a
+            # counted straggler stalls before reaching the queue.
+            held = False
+            deadline = time.perf_counter() + self.max_wait_s
             if self.latency_budget_s is not None:
                 # cap the oldest query's time in the coalescing stage
                 deadline = min(deadline,
                                first.t_enqueue + self.latency_budget_s)
             while len(batch) < self.max_batch:
                 try:
-                    p = self._q.get_nowait()
+                    batch.append(self._q.get_nowait())
+                    continue
                 except queue.Empty:
-                    remaining = deadline - time.perf_counter()
-                    if remaining <= 0:
-                        break
-                    try:
-                        p = self._q.get(timeout=remaining)
-                    except queue.Empty:
-                        break
-                self._observe_arrival(p.t_enqueue)
-                batch.append(p)
+                    pass
+                if self._inflight <= len(batch):
+                    break          # nobody else known in flight
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                held = True
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
             self.n_batches += 1
             self.n_queries += len(batch)
             self.max_batch_seen = max(self.max_batch_seen, len(batch))
-            if not hold:
+            if not held:
                 self.n_immediate += 1
             try:
                 results = self.process_batch([p.query for p in batch])
@@ -148,10 +152,14 @@ class MicroBatcher:
                     raise RuntimeError(
                         f"batch handler returned {len(results)} results "
                         f"for {len(batch)} queries")
+                with self._flight_lock:
+                    self._inflight -= len(batch)
                 for p, r in zip(batch, results):
                     p.result = r
                     p.event.set()
             except BaseException as e:  # propagate to every waiter
+                with self._flight_lock:
+                    self._inflight -= len(batch)
                 for p in batch:
                     p.error = e
                     p.event.set()

@@ -51,12 +51,13 @@ class ServerConfig:
     feedback: bool = False
     # >1 coalesces concurrent queries into one batched device call
     # (beyond-parity). On by default so a plain `pio deploy` gets the same
-    # concurrency mitigation the benchmarks measure. The window is
-    # ADAPTIVE (serving/batcher.py): an isolated query on an idle server
-    # dispatches immediately and pays none of it, so the default follows
-    # the round-3 throughput sweep (wait=5 ms gave ~1.5x the qps of
-    # wait=2 under 16-way load) without the idle-p50 cost that sweep
-    # charged.
+    # concurrency mitigation the benchmarks measure. Coalescing is
+    # drain-first and self-regulating (serving/batcher.py); the window
+    # is held only while more submitted-but-unanswered queries exist
+    # than the batch holds, so idle and closed-loop-serial traffic pay
+    # nothing and max_wait_ms is just the stall bound on a counted
+    # straggler between its submit and its enqueue — not a per-query
+    # tax, and not a knob that needs tuning per link anymore.
     micro_batch: int = 16
     micro_batch_wait_ms: float = 5.0
     # optional cap on how long the oldest query may sit in the

@@ -36,8 +36,7 @@ class TestMicroBatcher:
 
     def test_idle_query_dispatches_immediately(self):
         """An isolated query on an idle server must not pay the window:
-        the adaptive policy holds the door open only when the recent
-        arrival rate says more queries are coming."""
+        the door is held only while MORE queries are known in flight."""
         import time
         b = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=500)
         try:
@@ -48,15 +47,31 @@ class TestMicroBatcher:
         finally:
             b.stop()
 
-    def test_dense_arrivals_hold_window_and_budget_caps_it(self):
-        """With a dense arrival history the dispatcher holds the window
-        (query waits ~max_wait); latency_budget_ms caps that hold."""
+    def test_closed_loop_serial_pays_no_window(self):
+        """The policy the EMA-of-gaps design got wrong: one serial
+        client's inter-arrival gap equals the service time (dense!), but
+        batch == inflight at every dispatch, so no window is paid."""
+        import time
+        b = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=300)
+        try:
+            t0 = time.perf_counter()
+            for i in range(5):
+                assert b.submit(i) == i
+            # 5 serial queries << one 300 ms window, let alone five
+            assert time.perf_counter() - t0 < 0.3
+            assert b.stats()["immediateBatches"] >= 5
+        finally:
+            b.stop()
+
+    def test_inflight_straggler_holds_window_and_budget_caps_it(self):
+        """With a straggler counted in flight but never arriving, the
+        dispatcher holds up to max_wait; latency_budget_ms caps it."""
         import time
 
         held = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=300)
         try:
-            held._ema_gap = 1e-4           # dense recent traffic
-            held._prev_arrival = time.perf_counter()
+            with held._flight_lock:
+                held._inflight += 1        # phantom straggler
             t0 = time.perf_counter()
             held.submit(1)
             assert time.perf_counter() - t0 >= 0.25   # window held
@@ -66,13 +81,37 @@ class TestMicroBatcher:
         capped = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=300,
                               latency_budget_ms=40)
         try:
-            capped._ema_gap = 1e-4
-            capped._prev_arrival = time.perf_counter()
+            with capped._flight_lock:
+                capped._inflight += 1
             t0 = time.perf_counter()
             capped.submit(1)
             assert time.perf_counter() - t0 < 0.2     # budget closed it
         finally:
             capped.stop()
+
+    def test_concurrent_inflight_coalesces_without_full_window(self):
+        """16 concurrent closed-loop clients: batches form from known
+        in-flight queries without serial-style window stalls — total
+        wall time stays far below n_batches * max_wait."""
+        import time
+        done = []
+
+        def handler(qs):
+            time.sleep(0.002)   # a device call worth of latency
+            done.append(len(qs))
+            return qs
+
+        b = MicroBatcher(handler, max_batch=16, max_wait_ms=200)
+        try:
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(16) as ex:
+                results = list(ex.map(b.submit, range(64)))
+            dt = time.perf_counter() - t0
+            assert sorted(results) == list(range(64))
+            assert max(done) > 1               # real coalescing
+            assert dt < len(done) * 0.2 * 0.5  # no per-batch window stall
+        finally:
+            b.stop()
 
     def test_error_propagates_to_all_waiters(self):
         def handler(queries):
