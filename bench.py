@@ -319,13 +319,17 @@ def mllib_shaped_cpu_baseline(full_scale: bool):
     U = np.abs(rng.standard_normal((n_users, rank))) / np.sqrt(rank)
     V = np.abs(rng.standard_normal((n_items, rank))) / np.sqrt(rank)
 
-    from scipy.linalg import cho_factor, cho_solve
+    try:
+        from scipy.linalg import cho_factor, cho_solve
 
-    def chol_solve(A, b):
-        # SPD Cholesky (n^3/3 flops); check_finite off — the scans cost
-        # more than the factorization at small rank
-        return cho_solve(cho_factor(A, lower=True, check_finite=False),
-                         b, check_finite=False)
+        def chol_solve(A, b):
+            # SPD Cholesky (n^3/3 flops); check_finite off — the scans
+            # cost more than the factorization at small rank
+            return cho_solve(
+                cho_factor(A, lower=True, check_finite=False), b,
+                check_finite=False)
+    except ImportError:      # scipy is optional: LU arm still measures
+        chol_solve = np.linalg.solve
 
     # The baseline deserves its best foot: LAPACK LU via np.linalg.solve
     # has lower per-call overhead and wins at small rank; Cholesky halves

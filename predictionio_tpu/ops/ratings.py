@@ -189,6 +189,32 @@ def build_solve_plan(group_idx: np.ndarray, counter_idx: np.ndarray,
     sizes = bucket_lengths(int(counts[present].max()), min_k,
                            ratio=bucket_ratio)
     ks = sizes[np.searchsorted(sizes, counts[present], side="left")]
+    # Merge SPARSE buckets upward: a bucket holding a handful of
+    # entities still costs a whole scan group in the compiled sweep
+    # (XLA program size — the finer ladder's one real cost, measured as
+    # minutes of full-scale compile) for almost no work. Entities move
+    # to the next ladder size while their cumulative padding stays
+    # within `merge_cap` of their ORIGINAL bucket, so the tail giants
+    # (one entity per bucket by nature, big nnz) never cascade into a
+    # 2x-padded monster bucket.
+    min_bucket, merge_cap, work_share = 32, 1.25, 0.002
+    ks_orig = ks.copy()
+    cnts_present = counts[present]
+    for i in range(len(sizes) - 1):
+        members = ks == sizes[i]
+        n_mem = int(np.count_nonzero(members))
+        # merge only buckets that are BOTH sparse and a negligible share
+        # of the total work — at small scale every bucket is sparse and
+        # merging would buy padding for nothing; at full scale this
+        # fires exactly on the long tail of near-singleton buckets
+        if (0 < n_mem < min_bucket
+                and int(cnts_present[members].sum()) < work_share * nnz):
+            movable = members & (sizes[i + 1] <= merge_cap * ks_orig)
+            if movable.sum() == n_mem:
+                # move only when the WHOLE bucket can go — a partial
+                # move keeps the source group alive and buys padding
+                # without reducing the compiled program
+                ks[movable] = sizes[i + 1]
 
     batches: List[SolveBatch] = []
     for k in np.unique(ks):

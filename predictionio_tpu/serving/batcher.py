@@ -66,8 +66,10 @@ class MicroBatcher:
         self.n_batches = 0
         self.n_queries = 0
         self.max_batch_seen = 0
-        # batches dispatched without ever blocking on the window
-        # (idle / closed-loop-serial fast path)
+        # batches dispatched without ever blocking on the window —
+        # includes idle/serial traffic AND fully-drained batches under
+        # saturated load; (batches - immediateBatches) is the number of
+        # dispatches that actually waited for a straggler
         self.n_immediate = 0
         # queries submitted and not yet answered — the adaptive window's
         # signal: hold only while the batch is smaller than this

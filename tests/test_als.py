@@ -118,6 +118,33 @@ class TestSolvePlan:
         assert np.all(steps <= 1.0)
         assert np.all(np.diff(sizes) > 0)
 
+    def test_sparse_bucket_merge_bounded(self):
+        """Sparse near-empty buckets merge upward (fewer compiled scan
+        groups) but NEVER past 1.25x an entity's original bucket, and
+        never when the bucket carries a real share of the work."""
+        rng = np.random.default_rng(3)
+        # many entities at count 40 (dense bucket), a FEW at count 66
+        # (sparse: padded 72 -> merges to 80 within cap), and one giant
+        # at 5000 (sparse but heavy; must stay put)
+        gi = np.concatenate([
+            np.repeat(np.arange(200), 40),
+            np.repeat(np.arange(200, 203), 66),
+            np.full(5000, 203),
+        ]).astype(np.int64)
+        ci = rng.integers(0, 50, gi.size).astype(np.int32)
+        vals = rng.random(gi.size).astype(np.float32)
+        plan = build_solve_plan(gi, ci, vals, 204, work_budget=1 << 14)
+        ks_used = {k for _, k in plan.kernel_shapes}
+        # the giant keeps its own (un-merged) bucket at its natural size
+        assert max(ks_used) >= 5000
+        # per-entity padding bound holds for every real row
+        for b in plan.batches:
+            for row_i, ent in enumerate(b.rows):
+                if ent < 0:
+                    continue
+                c = b.mask[row_i].sum()
+                assert b.shape[1] <= max(8, 1.25 * 1.125 * c + 8)
+
     def test_empty(self):
         plan = build_solve_plan(np.array([], dtype=np.int64),
                                 np.array([], dtype=np.int32),
