@@ -2,9 +2,10 @@
 
 This is the ragged->fixed-shape edge (SURVEY.md hard part #3): events per
 user/item are power-law ragged, XLA wants static shapes. Entities are
-bucketed by rating count into power-of-two segment lengths K; each bucket is
-processed as [B, K] padded batches with B chosen to keep B*K work roughly
-constant, so the whole sweep compiles to ~log2(max_count) kernel shapes.
+bucketed by rating count into geometric-ladder segment lengths K
+(bucket_lengths); each bucket is processed as [B, K] padded batches with B
+chosen to keep B*K work roughly constant, so the whole sweep compiles to a
+ladder's worth of kernel shapes consumed by one scan program per side.
 
 Replaces the grouping/shuffle phase of MLlib's block ALS (reference consumer:
 examples/scala-parallel-recommendation/custom-prepartor/src/main/scala/
@@ -122,10 +123,6 @@ class SolvePlan:
         return self.padded_work / self.nnz
 
 
-def _next_pow2(x: int, floor: int) -> int:
-    return max(floor, 1 << int(np.ceil(np.log2(max(x, 1)))))
-
-
 def bucket_lengths(max_count: int, min_k: int = 8,
                    ratio: float = 1.125) -> np.ndarray:
     """Padded segment lengths: a geometric ladder (ratio ~1.125) aligned
@@ -165,8 +162,9 @@ def build_solve_plan(group_idx: np.ndarray, counter_idx: np.ndarray,
                      batch_multiple: int = 1,
                      bucket_ratio: float = 1.125) -> SolvePlan:
     """Group COO entries by `group_idx`, bucket groups by padded segment
-    length K (power of two), and emit [B, K] batches with B ~= work_budget/K
-    rounded up to `batch_multiple` (the mesh data-parallel degree).
+    length K (geometric ladder, bucket_lengths), and emit [B, K] batches
+    with B ~= work_budget/K rounded up to `batch_multiple` (the mesh
+    data-parallel degree).
 
     Vectorized host numpy — no per-entity Python loops.
     """

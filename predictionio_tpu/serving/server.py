@@ -21,6 +21,8 @@ import logging
 import threading
 import time
 import urllib.request
+
+import numpy as np
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -387,8 +389,7 @@ class EngineServer:
                 "startTime": self.start_time.isoformat(),
             }
             if self._lat_ring:
-                import numpy as _np
-                p50, p95, p99 = _np.percentile(
+                p50, p95, p99 = np.percentile(
                     list(self._lat_ring), (50, 95, 99))
                 out.update({"p50ServingSec": float(p50),
                             "p95ServingSec": float(p95),
