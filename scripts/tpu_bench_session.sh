@@ -23,6 +23,14 @@ if ! timeout 90 python -c "import jax; d=jax.devices(); print(d); import sys; sy
     exit 1
 fi
 rc=0
+echo "== kernel-shape probe (new ladder K values vs Mosaic) =="
+if ! timeout 600 python scripts/tpu_kernel_probe.py 200 > "$OUT/kernel_probe.txt" 2>&1; then
+    echo "KERNEL PROBE FAILED — a (solver, K) pair broke on real Mosaic"
+    echo "layouts; fix the ladder/solver BEFORE burning bench time:"
+    tail -20 "$OUT/kernel_probe.txt"
+    exit 1
+fi
+tail -3 "$OUT/kernel_probe.txt"
 echo "== bench (headline + roofline + serve sweep) -> $OUT/bench.json =="
 if ! python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
     echo "BENCH FAILED (rc != 0) — bench.json is an error line, do NOT"

@@ -1,0 +1,70 @@
+"""30-second TPU kernel sanity gate for the measurement session.
+
+The round-4 bucket ladder introduces K values the Pallas solvers have
+never seen on real Mosaic layouts (odd multiples of 8: 24, 40, 56, ...,
+and odd multiples of 16 beyond 128). The dual ALS route builds [B, K, K]
+systems at exactly those K, so before the multi-minute full-scale bench
+compiles, solve a tiny batch at every ladder size below rank and at the
+rank itself, for each production solver, and compare against the
+LAPACK reference. A failure names the exact (solver, K) pair so the
+ladder can be hot-patched in-session (worst case: round dual K up to a
+proven multiple) instead of diagnosing a mid-bench Mosaic error.
+
+Run (idle TPU box): python scripts/tpu_kernel_probe.py [rank=200]
+Exit 0 = all (solver, K) pairs pass.
+"""
+
+import sys
+
+import numpy as np
+
+
+def main(rank: int = 200) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from predictionio_tpu.ops.ratings import bucket_lengths
+    from predictionio_tpu.ops.solve import cholesky_solve, spd_solve
+
+    if jax.default_backend() != "tpu":
+        print("not a TPU backend — probe is for the real chip; "
+              "CPU equivalence is covered by tests/test_solve.py")
+        return 1
+
+    ks = [int(k) for k in bucket_lengths(rank * 4) if k <= rank] + [rank]
+    solvers = ["cg_pallas", "chol_pallas", "schulz_pallas"]
+    rng = np.random.default_rng(0)
+    failures = []
+    for k in sorted(set(ks)):
+        m = rng.standard_normal((64, k, k)).astype(np.float32)
+        A = jnp.asarray(m @ m.transpose(0, 2, 1)
+                        + 0.5 * k * np.eye(k, dtype=np.float32))
+        b = jnp.asarray(rng.standard_normal((64, k)).astype(np.float32))
+        ref = np.asarray(cholesky_solve(A, b))
+        scale = np.maximum(np.abs(ref).max(), 1e-6)
+        for s in solvers:
+            try:
+                # cg's iteration budget tracks K; the schulz solvers
+                # keep their production default (18 Newton-Schulz steps)
+                it = k + 8 if s.startswith("cg") else None
+                got = np.asarray(spd_solve(A, b, method=s, iters=it))
+                err = float(np.abs(got - ref).max() / scale)
+                ok = err < 5e-3
+            except Exception as e:  # Mosaic/compile error — the target
+                err, ok = None, False
+                print(f"FAIL {s} K={k}: {type(e).__name__}: "
+                      f"{str(e)[:200]}", flush=True)
+            if not ok:
+                failures.append((s, k, err))
+            else:
+                print(f"ok   {s} K={k} relerr={err:.2e}", flush=True)
+    if failures:
+        print(f"FAILURES: {failures}")
+        return 1
+    print("all solver/K pairs pass")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 200))

@@ -131,6 +131,29 @@ class TestCGSolve:
             np.linalg.norm(x_true)
         assert rel < 1e-3
 
+    @pytest.mark.parametrize("k", [24, 40, 56, 144])
+    def test_cg_pallas_interpret_new_ladder_ks(self, k):
+        """The round-4 bucket ladder feeds the kernel K values that are
+        multiples of 8 but not 16 (24, 40, 56, ...) — check the kernel
+        math at each (Mosaic layout behavior at these K is gated
+        separately by scripts/tpu_kernel_probe.py on the real chip)."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from predictionio_tpu.ops import solve as S
+
+        A, rhs, x_true = make_spd(8, k, 60.0)
+        kernel = functools.partial(S._cg_kernel, iters=k + 8)
+        x = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, k), jnp.float32),
+            interpret=True,
+        )(jnp.asarray(A), jnp.asarray(rhs))
+        rel = np.linalg.norm(np.asarray(x) - x_true) / \
+            np.linalg.norm(x_true)
+        assert rel < 1e-3
+
     def test_als_with_cg_matches_cholesky(self, mesh8):
         from predictionio_tpu.ops.als import ALSConfig, als_rmse, als_train
         from predictionio_tpu.ops.ratings import RatingsCOO
