@@ -323,11 +323,25 @@ class EngineServer:
         threading.Thread(target=_post, daemon=True).start()
 
     # -- routes -------------------------------------------------------------
+    def _ring_percentiles(self):
+        """(p50, p95, p99) of recent serving seconds, or None when no
+        traffic yet. Callers must hold self._lock."""
+        if not self._lat_ring:
+            return None
+        return np.percentile(list(self._lat_ring), (50, 95, 99))
+
     def _status_page(self, req: Request) -> Response:
         with self._lock:
             avg = (self.serving_seconds / self.request_count
                    if self.request_count else 0.0)
             inst = self.engine_instance
+            pct = self._ring_percentiles()
+            tail = ""
+            if pct is not None:
+                p50, p95, p99 = pct
+                tail = (f"<tr><td>p50 / p95 / p99 serving time</td>"
+                        f"<td>{p50:.6f} / {p95:.6f} / {p99:.6f} s"
+                        f"</td></tr>")
         html = f"""<html><head><title>Engine Server at
 {self.config.ip}:{self.config.port}</title></head><body>
 <h1>Engine Server</h1>
@@ -338,7 +352,7 @@ class EngineServer:
 <tr><td>Request count</td><td>{self.request_count}</td></tr>
 <tr><td>Average serving time</td><td>{avg:.6f} s</td></tr>
 <tr><td>Last serving time</td><td>{self.last_serving_sec:.6f} s</td></tr>
-</table></body></html>"""
+{tail}</table></body></html>"""
         return Response(200, html, content_type="text/html; charset=UTF-8")
 
     def _queries(self, req: Request) -> Response:
@@ -388,12 +402,11 @@ class EngineServer:
                 "microBatch": self.config.micro_batch,
                 "startTime": self.start_time.isoformat(),
             }
-            if self._lat_ring:
-                p50, p95, p99 = np.percentile(
-                    list(self._lat_ring), (50, 95, 99))
-                out.update({"p50ServingSec": float(p50),
-                            "p95ServingSec": float(p95),
-                            "p99ServingSec": float(p99)})
+            pct = self._ring_percentiles()
+            if pct is not None:
+                out.update({"p50ServingSec": float(pct[0]),
+                            "p95ServingSec": float(pct[1]),
+                            "p99ServingSec": float(pct[2])})
             if self.batcher is not None:
                 # realized coalescing (avg/max batch size) — the datum
                 # for tuning micro_batch_wait_ms on a given link
