@@ -250,6 +250,36 @@ class EventServer:
                            "--stats argument."})
         return Response(200, self.stats.to_dict(access_key.appid))
 
+    def _metrics(self, req: Request) -> Response:
+        """Prometheus text exposition (beyond-parity). Unauthenticated —
+        scrapers don't carry access keys — and therefore AGGREGATE only
+        (event counts across all apps, no per-app split; the keyed
+        /stats.json keeps the per-app view). 404 unless --stats, like
+        /stats.json."""
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To expose metrics, launch Event Server with "
+                           "--stats argument."})
+        from predictionio_tpu.utils.prometheus import (CONTENT_TYPE,
+                                                        render_metrics)
+        d = self.stats.to_dict(None)
+        cur = d["currentWindow"]
+        m = [
+            ("pio_event_window_start_seconds", "gauge",
+             "Start of the current counter window (unix time)",
+             [(None, d["startTime"])]),
+            ("pio_event_window_events", "gauge",
+             "Events accepted in the current window, by event name",
+             [({"event": k}, v) for k, v in
+              sorted(cur["byEvent"].items())] or [(None, 0)]),
+            ("pio_event_window_statuses", "gauge",
+             "Responses in the current window, by HTTP status",
+             [({"status": k}, v) for k, v in
+              sorted(cur["byStatus"].items())] or [(None, 0)]),
+        ]
+        return Response(200, render_metrics(m),
+                        content_type=CONTENT_TYPE)
+
     def _webhook_json(self, req: Request) -> Response:
         access_key, channel_id = self._authenticate(req)
         name = req.path_args[0]
@@ -302,6 +332,7 @@ class EventServer:
         r.add("GET", "/events/<id>.json", guarded(self._get_event))
         r.add("DELETE", "/events/<id>.json", guarded(self._delete_event))
         r.add("GET", "/stats.json", guarded(self._get_stats))
+        r.add("GET", "/metrics", self._metrics)
         r.add("POST", "/webhooks/<name>.json", guarded(self._webhook_json))
         r.add("GET", "/webhooks/<name>.json", guarded(self._webhook_get))
         r.add("POST", "/webhooks/<name>", guarded(self._webhook_form))

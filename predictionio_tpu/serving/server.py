@@ -429,6 +429,46 @@ class EngineServer:
             return Response(200, {"message": "trace stopped"})
         return Response(400, {"message": "action must be start|stop"})
 
+    def _metrics(self, req: Request) -> Response:
+        """Prometheus text exposition of the serving counters
+        (beyond-parity; same numbers as /stats.json)."""
+        from predictionio_tpu.utils.prometheus import (CONTENT_TYPE,
+                                                        render_metrics)
+        with self._lock:
+            n = self.request_count
+            m = [
+                ("pio_engine_requests_total", "counter",
+                 "Queries served", [(None, n)]),
+                ("pio_engine_serving_seconds_total", "counter",
+                 "Cumulative serve wall time",
+                 [(None, self.serving_seconds)]),
+                ("pio_engine_predict_seconds_total", "counter",
+                 "Cumulative device/predict time",
+                 [(None, self.predict_seconds)]),
+            ]
+            pct = self._ring_percentiles()
+            if pct is not None:
+                m.append(("pio_engine_serving_seconds", "summary",
+                          "Recent serving-time quantiles (rolling ring)",
+                          [({"quantile": q}, float(v)) for q, v in
+                           zip(("0.5", "0.95", "0.99"), pct)]))
+        if self.batcher is not None:
+            b = self.batcher.stats()
+            m += [
+                ("pio_engine_batches_total", "counter",
+                 "Micro-batch dispatches", [(None, b["batches"])]),
+                ("pio_engine_batched_queries_total", "counter",
+                 "Queries through the micro-batcher",
+                 [(None, b["batchedQueries"])]),
+                ("pio_engine_immediate_batches_total", "counter",
+                 "Dispatches that never blocked on the window",
+                 [(None, b["immediateBatches"])]),
+                ("pio_engine_max_batch_size", "gauge",
+                 "Largest coalesced batch", [(None, b["maxBatchSize"])]),
+            ]
+        return Response(200, render_metrics(m),
+                        content_type=CONTENT_TYPE)
+
     def _build_router(self) -> Router:
         r = Router()
         r.add("GET", "/", self._status_page)
@@ -439,6 +479,7 @@ class EngineServer:
         r.add("GET", "/stop", self._stop)
         r.add("GET", "/plugins.json", self._plugins)
         r.add("GET", "/stats.json", self._stats)
+        r.add("GET", "/metrics", self._metrics)
         r.add("POST", "/profile.json", self._profile)
         return r
 

@@ -1,0 +1,40 @@
+"""Minimal Prometheus text-exposition rendering (no dependencies).
+
+Beyond-parity observability: the reference exposes counters only as
+JSON/HTML status pages (CreateServer.scala:418-420, Stats.scala:40-79);
+modern deployments scrape. Both HTTP servers serve ``GET /metrics`` in
+the v0.0.4 text format rendered here.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Optional, Sequence, Tuple
+
+Sample = Tuple[Optional[Mapping[str, str]], float]
+
+# the exposition format version this module renders; callers use it as
+# the HTTP Content-Type so header and body can never disagree
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_metrics(metrics: Iterable[Tuple[str, str, str,
+                                           Sequence[Sample]]]) -> str:
+    """metrics: (name, type, help, samples); samples are
+    (labels-or-None, value). Returns the exposition text."""
+    out = []
+    for name, mtype, help_, samples in metrics:
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lab = ""
+            if labels:
+                inner = ",".join(f'{k}="{_escape(v)}"'
+                                 for k, v in sorted(labels.items()))
+                lab = "{" + inner + "}"
+            out.append(f"{name}{lab} {value}")
+    return "\n".join(out) + "\n"

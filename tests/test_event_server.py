@@ -225,3 +225,36 @@ class TestWebhooks:
         assert events[0]["entityId"] == "8a25ff1d98"
         assert events[0]["targetEntityId"] == "a6b5da1054"
         assert events[0]["eventTime"].startswith("2026-03-26T21:35:57")
+
+
+class TestMetrics:
+    def test_prometheus_exposition(self, server):
+        import urllib.request
+        p = server.config.port
+        for i in range(3):
+            call(p, "POST", "/events.json?accessKey=testkey",
+                 dict(EVENT, entityId=f"m{i}"))
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{p}/metrics", timeout=10)
+        assert raw.status == 200
+        assert raw.headers["Content-Type"].startswith("text/plain")
+        text = raw.read().decode()
+        assert "# TYPE pio_event_window_events gauge" in text
+        assert 'pio_event_window_events{event="rate"} 3' in text
+        assert 'pio_event_window_statuses{status="201"} 3' in text
+
+    def test_metrics_404_without_stats_flag(self, tmp_env):
+        import urllib.error
+        import urllib.request
+        from predictionio_tpu.data.api.event_server import (
+            EventServer, EventServerConfig)
+        s = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                          stats=False))
+        s.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{s.config.port}/metrics", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            s.stop()

@@ -187,6 +187,23 @@ class TestMicroBatchedServer:
         assert stats["batchedQueries"] >= 8
         assert stats["avgBatchSize"] > 0
 
+    def test_metrics_endpoint_prometheus_format(self, server):
+        for _ in range(3):
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{server.config.port}/queries.json",
+                data=json.dumps({"user": "u1", "num": 2}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST"), timeout=30).read()
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.config.port}/metrics", timeout=10)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+        assert "# TYPE pio_engine_requests_total counter" in text
+        assert "pio_engine_requests_total 3" in text
+        assert 'pio_engine_serving_seconds{quantile="0.99"}' in text
+        assert "pio_engine_batches_total" in text
+
     def test_concurrent_queries_correct_per_user(self, server):
         def ask(u):
             req = urllib.request.Request(
