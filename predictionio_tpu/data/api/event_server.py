@@ -340,10 +340,12 @@ class EventServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, background: bool = True) -> "EventServer":
-        self.server = HttpServer(self.router, self.config.ip,
-                                 self.config.port)
-        self.server.start(background=background)
-        self.config.port = self.server.port
+        srv = HttpServer(self.router, self.config.ip, self.config.port)
+        self.server = srv
+        srv.start(background=background)
+        # read the port from the local: a concurrent stop() (signal
+        # handler) may null self.server the instant serve_forever returns
+        self.config.port = srv.port
         logger.info("Event Server started on %s:%d",
                     self.config.ip, self.config.port)
         return self

@@ -97,8 +97,13 @@ class MicroBatcher:
         """Blocking: enqueue and wait for the batched result."""
         p = _Pending(query)
         with self._flight_lock:
+            # check-and-enqueue is atomic with stop()'s set-and-sweep
+            # (both under _flight_lock), so no submitter can slip a
+            # pending item in after the shutdown sweep ran
+            if self._stop.is_set():
+                raise RuntimeError("micro-batcher is shut down")
             self._inflight += 1
-        self._q.put(p)
+            self._q.put(p)
         p.event.wait()
         if p.error is not None:
             raise p.error
@@ -169,3 +174,17 @@ class MicroBatcher:
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=2)
+        # fail every waiter still queued: without this sweep their
+        # untimed event.wait() blocks forever and a clean shutdown
+        # strands request threads mid-flight. Atomic with submit()'s
+        # check-and-enqueue via _flight_lock, so nothing can enqueue
+        # after the sweep.
+        with self._flight_lock:
+            while True:
+                try:
+                    p = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                self._inflight -= 1
+                p.error = RuntimeError("server shutting down")
+                p.event.set()

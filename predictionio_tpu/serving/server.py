@@ -485,19 +485,30 @@ class EngineServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, background: bool = True) -> "EngineServer":
-        self.server = HttpServer(self.router, self.config.ip,
-                                 self.config.port)
-        self.server.start(background=background)
-        self.config.port = self.server.port
+        srv = HttpServer(self.router, self.config.ip, self.config.port)
+        self.server = srv
+        srv.start(background=background)
+        # read the port from the local: a concurrent stop() (signal
+        # handler) may null self.server the instant serve_forever returns
+        self.config.port = srv.port
         logger.info("Engine server started on %s:%d", self.config.ip,
                     self.config.port)
         return self
 
     def stop(self):
+        # order matters for a clean drain: stop ACCEPTING first (the
+        # HTTP listener), then the batcher (which fails any still-queued
+        # waiters so their request threads return 500 instead of
+        # blocking forever), then release the mesh workers. self.server
+        # is nulled LAST — deploy's foreground loop watches it, and
+        # signaling "stopped" before the worker-release broadcast lets
+        # the primary's interpreter exit mid-collective and strand the
+        # workers (observed as a poisoned release bcast in the 2-proc
+        # test)
+        if self.server:
+            self.server.stop()
         if self.batcher is not None:
             self.batcher.stop()
         if self.coordinator is not None:
             self.coordinator.shutdown()
-        if self.server:
-            self.server.stop()
-            self.server = None
+        self.server = None

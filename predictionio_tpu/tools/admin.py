@@ -84,10 +84,12 @@ class AdminServer:
         return r
 
     def start(self, background: bool = True) -> "AdminServer":
-        self.server = HttpServer(self.router, self.config.ip,
-                                 self.config.port)
-        self.server.start(background=background)
-        self.config.port = self.server.port
+        srv = HttpServer(self.router, self.config.ip, self.config.port)
+        self.server = srv
+        srv.start(background=background)
+        # read the port from the local: a concurrent stop() (signal
+        # handler) may null self.server the instant serve_forever returns
+        self.config.port = srv.port
         return self
 
     def stop(self):

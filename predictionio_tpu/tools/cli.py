@@ -138,6 +138,45 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def _serve_foreground(server, label: str) -> int:
+    """Run a server in the foreground, stopping CLEANLY on SIGTERM/SIGINT
+    (systemd/k8s stop, operator ^C): the listener stops accepting, the
+    engine server's batcher fails any still-queued waiters loudly (no
+    stranded request threads), and the mesh coordinator broadcasts the
+    worker-release so executor processes exit instead of hanging in a
+    collective. The handler fires server.stop() from a helper thread —
+    calling shutdown from inside serve_forever's own thread deadlocks.
+    (The reference's actor system gets this from its lifecycle; a bare
+    HTTP loop has to do it explicitly.)"""
+    import os
+    import signal
+    import threading
+    import time
+
+    def stopper():
+        # stop() no-ops until the HTTP socket exists (a signal can land
+        # during the up-to-3s bind-retry window, e.g. a systemd restart
+        # racing the old instance), so retry until the serve loop is
+        # actually torn down; hard-exit as the systemd-visible fallback
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                server.stop()
+            except Exception:
+                pass
+            time.sleep(0.5)
+        os._exit(0)
+
+    def on_sig(signum, frame):
+        _print(f"{label}: received signal {signum}, shutting down.")
+        threading.Thread(target=stopper, daemon=True).start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, on_sig)
+    server.start(background=False)
+    return 0
+
+
 def cmd_deploy(args) -> int:
     from predictionio_tpu.parallel.mesh import init_distributed
     from predictionio_tpu.serving import EngineServer, ServerConfig
@@ -182,8 +221,7 @@ def cmd_deploy(args) -> int:
         return 0
     _print(f"Engine is deployed and running. Engine API is live at "
            f"http://{config.ip}:{config.port}.")
-    server.start(background=False)
-    return 0
+    return _serve_foreground(server, "engine server")
 
 
 def cmd_undeploy(args) -> int:
@@ -206,24 +244,21 @@ def cmd_eventserver(args) -> int:
     server = EventServer(EventServerConfig(ip=args.ip, port=args.port,
                                            stats=args.stats))
     _print(f"Event Server is listening on http://{args.ip}:{args.port}")
-    server.start(background=False)
-    return 0
+    return _serve_foreground(server, "event server")
 
 
 def cmd_dashboard(args) -> int:
     from predictionio_tpu.tools.dashboard import Dashboard, DashboardConfig
     server = Dashboard(DashboardConfig(ip=args.ip, port=args.port))
     _print(f"Dashboard is listening on http://{args.ip}:{args.port}")
-    server.start(background=False)
-    return 0
+    return _serve_foreground(server, "dashboard")
 
 
 def cmd_adminserver(args) -> int:
     from predictionio_tpu.tools.admin import AdminServer, AdminServerConfig
     server = AdminServer(AdminServerConfig(ip=args.ip, port=args.port))
     _print(f"Admin server is listening on http://{args.ip}:{args.port}")
-    server.start(background=False)
-    return 0
+    return _serve_foreground(server, "admin server")
 
 
 def cmd_app(args) -> int:

@@ -115,6 +115,11 @@ class HttpServer:
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # latched by stop(): a stop that lands BEFORE the socket exists
+        # (e.g. SIGTERM during the bind-retry window) must still win —
+        # start() checks it after binding and tears down immediately
+        # instead of serving as a zombie
+        self._stop_requested = False
 
     def _make_handler(self):
         router = self.router
@@ -203,6 +208,10 @@ class HttpServer:
         else:
             raise last_err
         self.port = self._httpd.server_address[1]  # resolve port 0
+        if self._stop_requested:   # stop() raced the bind — honor it
+            self._httpd.server_close()
+            self._httpd = None
+            return
         if background:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)
@@ -212,6 +221,7 @@ class HttpServer:
         return self
 
     def stop(self):
+        self._stop_requested = True
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -113,6 +113,31 @@ class TestMicroBatcher:
         finally:
             b.stop()
 
+    def test_stop_fails_queued_waiters_loudly(self):
+        """Shutdown with queries still queued: the in-flight batch
+        completes, queued waiters get a loud error (not an eternal
+        event.wait), and later submits are refused."""
+        import time
+        started = threading.Event()
+
+        def handler(qs):
+            started.set()
+            time.sleep(0.3)
+            return qs
+
+        b = MicroBatcher(handler, max_batch=1, max_wait_ms=1)
+        with ThreadPoolExecutor(4) as ex:
+            f1 = ex.submit(b.submit, 1)     # occupies the "device"
+            assert started.wait(2)
+            f2 = ex.submit(b.submit, 2)     # queued behind it
+            time.sleep(0.05)
+            b.stop()
+            assert f1.result(timeout=5) == 1
+            with pytest.raises(RuntimeError, match="shutting down"):
+                f2.result(timeout=5)
+        with pytest.raises(RuntimeError, match="shut down"):
+            b.submit(3)
+
     def test_error_propagates_to_all_waiters(self):
         def handler(queries):
             raise RuntimeError("boom")

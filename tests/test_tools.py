@@ -393,3 +393,51 @@ class TestAdminServer:
             assert body["apps"] == []
         finally:
             s.stop()
+
+
+class TestSignalShutdown:
+    @pytest.mark.timeout(120)
+    def test_eventserver_sigterm_stops_cleanly(self, tmp_path):
+        """SIGTERM (systemd/k8s stop) must shut the foreground server
+        down cleanly — rc 0 and the shutdown message — not kill it
+        mid-request with the port still latched."""
+        import os
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import time
+        import urllib.request
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ, PIO_FS_BASEDIR=str(tmp_path / "store"),
+                   JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(repo, "bin", "pio"),
+             "eventserver", "--ip", "127.0.0.1", "--port", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        try:
+            deadline = time.time() + 60
+            while True:
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=2).read()
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise RuntimeError("event server never came up")
+                    if proc.poll() is not None:
+                        raise AssertionError(
+                            proc.communicate()[0].decode()[-2000:])
+                    time.sleep(0.3)
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                out, _ = proc.communicate()
+        assert proc.returncode == 0, out.decode()[-2000:]
+        assert "shutting down" in out.decode()
