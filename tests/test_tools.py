@@ -441,3 +441,36 @@ class TestSignalShutdown:
                 out, _ = proc.communicate()
         assert proc.returncode == 0, out.decode()[-2000:]
         assert "shutting down" in out.decode()
+
+
+class TestStopLatch:
+    def test_http_stop_before_start_is_latched(self):
+        """A stop() that lands before the socket exists (SIGTERM during
+        the bind-retry window) must win: start() honors the latch at
+        bind time instead of serving as a zombie."""
+        import urllib.request
+        from predictionio_tpu.utils.http import HttpServer, Router
+
+        s = HttpServer(Router(), "127.0.0.1", 0)
+        s.stop()                       # latched pre-bind
+        s.start(background=True)
+        assert s._httpd is None        # torn down the moment it bound
+        # and the port is actually closed (resolved port recorded)
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{s.port}/", timeout=2)
+
+    def test_http_normal_lifecycle_unaffected(self):
+        import urllib.request
+        from predictionio_tpu.utils.http import (HttpServer, Request,
+                                                 Response, Router)
+        r = Router()
+        r.add("GET", "/ping", lambda req: Response(200, {"ok": True}))
+        s = HttpServer(r, "127.0.0.1", 0)
+        s.start(background=True)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{s.port}/ping", timeout=5).read()
+            assert b"ok" in body
+        finally:
+            s.stop()
