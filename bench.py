@@ -848,6 +848,13 @@ def solver_ablation():
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4)),
             ("cg_pallas + dual + chunk8",
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=8)),
+            # once chunking amortizes the solver's per-call fixed cost,
+            # the f32 factor-row gathers are the roofline numerator
+            # (45.5 GB/iter at full scale) — bf16 tables halve it; this
+            # row measures the two levers together
+            ("cg_pallas + dual + chunk4 + bf16 tables",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
+                  factor_dtype="bfloat16")),
             ("cg_pallas + dual + chunk4 + fused iteration",
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
                   fuse_iteration=True)),

@@ -448,7 +448,6 @@ class TestStopLatch:
         """A stop() that lands before the socket exists (SIGTERM during
         the bind-retry window) must win: start() honors the latch at
         bind time instead of serving as a zombie."""
-        import urllib.request
         from predictionio_tpu.utils.http import HttpServer, Router
 
         s = HttpServer(Router(), "127.0.0.1", 0)
@@ -461,9 +460,8 @@ class TestStopLatch:
                 f"http://127.0.0.1:{s.port}/", timeout=2)
 
     def test_http_normal_lifecycle_unaffected(self):
-        import urllib.request
-        from predictionio_tpu.utils.http import (HttpServer, Request,
-                                                 Response, Router)
+        from predictionio_tpu.utils.http import (HttpServer, Response,
+                                                 Router)
         r = Router()
         r.add("GET", "/ping", lambda req: Response(200, {"ok": True}))
         s = HttpServer(r, "127.0.0.1", 0)
