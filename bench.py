@@ -18,11 +18,14 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 import json
 import os
 import sys
+import threading
 import time
+from contextlib import contextmanager
 
 import numpy as np
 
 SPARK_CPU_BASELINE_RATINGS_PER_SEC = 2.0e5
+MAX_INGEST_BATCH = 50  # the reference's /batch/events.json cap
 
 # Peak dense-matmul throughput per device kind (flop/s, bf16 with f32
 # accumulation). Used to SELF-VALIDATE the measurement: a benched number
@@ -346,28 +349,71 @@ def mllib_shaped_cpu_baseline(full_scale: bool):
     t_ch = time.perf_counter() - t0
     solve = chol_solve if t_ch < t_lu else np.linalg.solve
 
-    def half_sweep(group_idx, counter_idx, vals, n_groups, counter, out):
+    def half_sweep(group_idx, counter_idx, vals, n_groups, counter, out,
+                   n_workers=1):
+        """One ALS half-sweep over all entities, optionally fanned out
+        over a thread pool the way Spark fans entity blocks over executor
+        cores (reference entry: core/src/main/scala/io/prediction/
+        workflow/WorkflowContext.scala:25-45). Per-entity Gram+solve is
+        BLAS, which releases the GIL, so threads scale on real cores."""
         order = np.argsort(group_idx, kind="stable")
         g, c, r = group_idx[order], counter_idx[order], vals[order]
         counts = np.bincount(g, minlength=n_groups)
         starts = np.concatenate([[0], np.cumsum(counts)])
         eye = np.eye(rank)
-        for e in range(n_groups):
-            lo, hi = starts[e], starts[e + 1]
-            if lo == hi:
-                continue
-            Vs = counter[c[lo:hi]].astype(np.float64)
-            A = Vs.T @ Vs + lam * (hi - lo) * eye
-            b = Vs.T @ r[lo:hi].astype(np.float64)
-            out[e] = solve(A, b)
 
-    t0 = time.perf_counter()
-    half_sweep(ui, ii, vv, n_users, V, U)
-    half_sweep(ii, ui, vv, n_items, U, V)
-    dt = time.perf_counter() - t0
-    return {"baseline_measured_ratings_per_sec": round(nnz / dt, 1),
-            "baseline_measured_s_per_iteration": round(dt, 2),
-            "baseline_measured_nnz": nnz, "baseline_measured_rank": rank}
+        def run_range(e_lo, e_hi):
+            for e in range(e_lo, e_hi):
+                lo, hi = starts[e], starts[e + 1]
+                if lo == hi:
+                    continue
+                Vs = counter[c[lo:hi]].astype(np.float64)
+                A = Vs.T @ Vs + lam * (hi - lo) * eye
+                b = Vs.T @ r[lo:hi].astype(np.float64)
+                out[e] = solve(A, b)
+
+        if n_workers <= 1:
+            run_range(0, n_groups)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+        # contiguous entity ranges, one per worker: same locality a Spark
+        # partition gets, no per-entity task overhead
+        bounds = np.linspace(0, n_groups, n_workers + 1).astype(int)
+        with ThreadPoolExecutor(max_workers=n_workers) as pool:
+            futs = [pool.submit(run_range, bounds[i], bounds[i + 1])
+                    for i in range(n_workers)]
+            for f in futs:
+                f.result()
+
+    ncores = len(os.sched_getaffinity(0)) if hasattr(
+        os, "sched_getaffinity") else (os.cpu_count() or 1)
+
+    def timed_iteration(n_workers):
+        t0 = time.perf_counter()
+        half_sweep(ui, ii, vv, n_users, V, U, n_workers)
+        half_sweep(ii, ui, vv, n_items, U, V, n_workers)
+        return time.perf_counter() - t0
+
+    dt1 = timed_iteration(1)
+    out = {"baseline_measured_ratings_per_sec_1core": round(nnz / dt1, 1),
+           "baseline_measured_s_per_iteration_1core": round(dt1, 2),
+           "baseline_measured_ncores": ncores,
+           "baseline_measured_nnz": nnz, "baseline_measured_rank": rank}
+    if ncores > 1:
+        dtn = timed_iteration(ncores)
+        out["baseline_measured_ratings_per_sec_ncore"] = round(nnz / dtn, 1)
+        out["baseline_measured_s_per_iteration_ncore"] = round(dtn, 2)
+    else:
+        # single-core host: the pooled path measures nothing extra; the
+        # 1-core number IS the whole machine (noted so the artifact is
+        # honest about what "ncore" means here)
+        out["baseline_measured_ratings_per_sec_ncore"] = round(nnz / dt1, 1)
+        out["baseline_measured_s_per_iteration_ncore"] = round(dt1, 2)
+    # the number the north-star ratio divides by: everything this host
+    # can do, i.e. the n-core rate
+    out["baseline_measured_ratings_per_sec"] = (
+        out["baseline_measured_ratings_per_sec_ncore"])
+    return out
 
 
 def bench_product_path(full_scale: bool):
@@ -386,7 +432,6 @@ def bench_product_path(full_scale: bool):
     """
     import tempfile
 
-    from predictionio_tpu.data.storage import registry
     from predictionio_tpu.data.storage.base import App
     from predictionio_tpu.models import recommendation as R
 
@@ -397,27 +442,7 @@ def bench_product_path(full_scale: bool):
 
     backend = os.environ.get("PIO_BENCH_PRODUCT_BACKEND", "nativelog")
     base = tempfile.mkdtemp(prefix="pio_bench_store_")
-    saved = {k: os.environ.get(k) for k in list(os.environ)
-             if k.startswith("PIO_STORAGE")}
-    for k in saved:
-        del os.environ[k]
-    os.environ.update({
-        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "bench_meta",
-        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
-        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "bench_event",
-        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": backend.upper(),
-        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "bench_model",
-        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
-        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
-        "PIO_STORAGE_SOURCES_SQLITE_URL": os.path.join(base, "pio.db"),
-        "PIO_STORAGE_SOURCES_NATIVELOG_TYPE": "nativelog",
-        "PIO_STORAGE_SOURCES_NATIVELOG_PATH": os.path.join(base, "evlog"),
-        "PIO_STORAGE_SOURCES_NATIVELOG_PARTITIONS": "8",
-        "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
-        "PIO_STORAGE_SOURCES_LOCALFS_HOSTS": os.path.join(base, "models"),
-    })
-    registry.clear_cache()
-    try:
+    with bench_storage_env(backend, base):
         from predictionio_tpu.data.storage.registry import Storage
         app_id = Storage.get_meta_data_apps().insert(App(0, "benchapp"))
         ev = Storage.get_events()
@@ -520,19 +545,121 @@ def bench_product_path(full_scale: bool):
             out["product_ratings_per_sec_steady"] = round(
                 pd.ratings_coo.nnz / tel["s_per_iter"], 1)
         return out
-    finally:
-        registry.clear_cache()
-        for k in list(os.environ):
-            if k.startswith("PIO_STORAGE"):
-                del os.environ[k]
-        os.environ.update({k: v for k, v in saved.items() if v is not None})
-        registry.clear_cache()
 
 
-def bench_rest_latency(model, n_queries=200, wait_ms=2.0):
+def bench_ingest(full_scale: bool):
+    """POST /events.json ingest throughput through the real Event Server
+    over loopback HTTP — the one REST surface that had no number
+    (round-4 verdict item 4). Three client shapes per backend:
+    serial single events, /batch/events.json at the 50-event reference
+    cap, and 8 concurrent keep-alive clients posting singles. Backends:
+    nativelog (the scalable C++ store) and sqlite (the embedded
+    operator default). (reference ingest path:
+    data/src/main/scala/io/prediction/data/api/EventServer.scala:226-260)
+    """
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from predictionio_tpu.data.api.event_server import (EventServer,
+                                                        EventServerConfig)
+
+    n_single = 2_000 if full_scale else 500
+    n_batch_events = 20_000 if full_scale else 5_000
+    n_conc = 2_000 if full_scale else 500
+
+    out = {}
+    for backend in ("nativelog", "sqlite"):
+        base = tempfile.mkdtemp(prefix=f"pio_bench_ingest_{backend}_")
+        server = None
+        with bench_storage_env(backend, base):
+            try:
+                from predictionio_tpu.data.storage.base import (AccessKey,
+                                                                App)
+                from predictionio_tpu.data.storage.registry import Storage
+                app_id = Storage.get_meta_data_apps().insert(
+                    App(0, "benchapp"))
+                Storage.get_events().init(app_id)
+                Storage.get_meta_data_access_keys().insert(
+                    AccessKey("benchkey", app_id, []))
+                server = EventServer(
+                    EventServerConfig(ip="127.0.0.1", port=0))
+                server.start()
+                port = server.config.port
+                path = "/events.json?accessKey=benchkey"
+
+                def event(j):
+                    return {"event": "rate", "entityType": "user",
+                            "entityId": f"u{j % 997}",
+                            "targetEntityType": "item",
+                            "targetEntityId": f"i{j % 499}",
+                            "properties": {"rating": float(j % 5 + 1)}}
+
+                c = _Client(port)
+                for j in range(20):  # warm the connection + code paths
+                    resp = json.loads(c.post(event(j), path=path))
+                    assert "eventId" in resp, f"ingest rejected: {resp}"
+                # one warm batch, per-event statuses verified — a batch
+                # endpoint returns 200 around per-event failures, which
+                # would otherwise count as ingested (_Client only
+                # raises on transport-level >=400)
+                statuses = json.loads(c.post(
+                    [event(j) for j in range(MAX_INGEST_BATCH)],
+                    path="/batch/events.json?accessKey=benchkey"))
+                bad = [s for s in statuses if s.get("status") != 201]
+                assert not bad, f"batch ingest rejected events: {bad[:3]}"
+
+                t0 = time.perf_counter()
+                for j in range(n_single):
+                    c.post(event(j), path=path)
+                dt_single = time.perf_counter() - t0
+
+                t0 = time.perf_counter()
+                for lo in range(0, n_batch_events, MAX_INGEST_BATCH):
+                    c.post([event(j) for j in
+                            range(lo, min(lo + MAX_INGEST_BATCH,
+                                          n_batch_events))],
+                           path="/batch/events.json?accessKey=benchkey")
+                dt_batch = time.perf_counter() - t0
+                c.close()
+
+                pool = _PerThreadClients(port)
+
+                def post_one(j):
+                    pool.get().post(event(j), path=path)
+
+                with ThreadPoolExecutor(8) as ex:
+                    # warm per-thread connections
+                    list(ex.map(post_one, range(64)))
+                    t0 = time.perf_counter()
+                    list(ex.map(post_one, range(n_conc)))
+                    dt_conc = time.perf_counter() - t0
+                pool.close_all()
+
+                out[f"ingest_events_per_sec_single_{backend}"] = round(
+                    n_single / dt_single, 1)
+                out[f"ingest_events_per_sec_batch_{backend}"] = round(
+                    n_batch_events / dt_batch, 1)
+                out[f"ingest_events_per_sec_concurrent8_{backend}"] = \
+                    round(n_conc / dt_conc, 1)
+            finally:
+                if server is not None:
+                    server.stop()
+    return out
+
+
+def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
     """p50 of POST /queries.json against the trained model via the real
     engine server (loopback HTTP). `wait_ms` sets the micro-batcher's
-    coalescing window — swept by main() to pick the default from data."""
+    coalescing window — swept by main() to pick the default from data;
+    None means "whatever ServerConfig ships", so the headline row always
+    characterizes the configuration a `pio deploy` user actually gets
+    (round-4 verdict: the old 2.0 default measured a config nobody ran).
+
+    The concurrent phase runs an untimed warm burst first (the scorer
+    pads batch dims to powers of two, so the first burst compiles each
+    new shape — timing it mixes compilation into qps and produced the
+    round-4 3x main-block-vs-sweep spread), then `reps` timed bursts,
+    reporting the median as qps_concurrent16 with min/max alongside."""
     import urllib.request
 
     from predictionio_tpu.core import EngineParams, FirstServing
@@ -551,6 +678,8 @@ def bench_rest_latency(model, n_queries=200, wait_ms=2.0):
     rec_model = R.RecommendationModel(model, user_ix, item_ix)
     algo = R.ALSAlgorithm(R.ALSAlgorithmParams(rank=model.rank))
 
+    if wait_ms is None:
+        wait_ms = ServerConfig.micro_batch_wait_ms  # the shipped default
     engine = R.RecommendationEngineFactory.apply()
     server = EngineServer(ServerConfig(ip="127.0.0.1", port=0,
                                        micro_batch=32,
@@ -582,32 +711,29 @@ def bench_rest_latency(model, n_queries=200, wait_ms=2.0):
         # concurrent throughput: 16 keep-alive clients (serial p50 on a
         # tunneled chip is dominated by the per-transfer D2H floor; the
         # path pipelines, so concurrency recovers throughput)
-        import threading
         from concurrent.futures import ThreadPoolExecutor
         n_workers, n_total = 16, 320
-        tls = threading.local()
-        all_clients = []
-        lock = threading.Lock()
+        pool = _PerThreadClients(server.config.port)
 
         def worker(uid):
-            c = getattr(tls, "client", None)
-            if c is None:
-                c = _Client(server.config.port)
-                tls.client = c
-                with lock:
-                    all_clients.append(c)
-            c.post({"user": str(int(uid)), "num": 10})
+            pool.get().post({"user": str(int(uid)), "num": 10})
         jobs = [users[i % len(users)] for i in range(n_total)]
-        # snapshot batcher counters so the coalescing number covers ONLY
-        # the concurrent phase (warmup + the serial loop run hundreds of
-        # single-query batches that would dilute a cumulative average)
-        pre = json.loads(client.get("/stats.json"))
         with ThreadPoolExecutor(n_workers) as ex:
-            t0 = time.perf_counter()
-            list(ex.map(worker, jobs))
-            conc_dt = time.perf_counter() - t0
-        for c in all_clients:
-            c.close()
+            # untimed warm burst: compiles every power-of-two batch shape
+            # the 16-client load can produce, so the timed reps measure
+            # steady state, not compilation (the round-4 3x spread)
+            list(ex.map(worker, jobs[:64]))
+            # snapshot batcher counters so the coalescing number covers
+            # ONLY the timed bursts (warmup + the serial loop run
+            # hundreds of single-query batches that would dilute a
+            # cumulative average)
+            pre = json.loads(client.get("/stats.json"))
+            qps_reps = []
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                list(ex.map(worker, jobs))
+                qps_reps.append(n_total / (time.perf_counter() - t0))
+        pool.close_all()
         # server-side latency split: device/score time vs serve+HTTP
         stats = json.loads(client.get("/stats.json"))
         d_q = (stats.get("batchedQueries", 0)
@@ -617,10 +743,12 @@ def bench_rest_latency(model, n_queries=200, wait_ms=2.0):
                 "p95_ms": float(np.percentile(lat, 95) * 1000),
                 "p99_ms": float(np.percentile(lat, 99) * 1000),
                 "qps_serial": float(1.0 / lat.mean()),
-                "qps_concurrent16": float(n_total / conc_dt),
+                "qps_concurrent16": float(np.median(qps_reps)),
+                "qps_concurrent16_min": float(min(qps_reps)),
+                "qps_concurrent16_max": float(max(qps_reps)),
                 "server_avg_total_ms": stats["avgServingSec"] * 1000,
                 "server_avg_predict_ms": stats["avgPredictSec"] * 1000,
-                # realized coalescing DURING the concurrent phase — the
+                # realized coalescing DURING the timed bursts — the
                 # datum for tuning micro_batch_wait_ms
                 "serve_avg_batch_size": (d_q / d_b if d_b else 0.0),
                 "serve_max_batch_size": float(
@@ -648,15 +776,22 @@ class _Client:
         self.conn.connect()
         self.conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
-    def post(self, body, timeout=30):
+    def post(self, body, timeout=30, path="/queries.json"):
         if self.conn is None:
             self._connect(timeout)
         try:
-            self.conn.request("POST", "/queries.json",
+            self.conn.request("POST", path,
                               body=json.dumps(body),
                               headers={"Content-Type": "application/json"})
             resp = self.conn.getresponse()
-            return resp.read()
+            out = resp.read()
+            if resp.status >= 400:
+                # every bench loop expects success; counting error
+                # responses (which skip the real work and return fast)
+                # would silently inflate the published rate
+                raise RuntimeError(
+                    f"HTTP {resp.status} from {path}: {out[:200]!r}")
+            return out
         except Exception:
             self.close()
             raise
@@ -675,6 +810,70 @@ class _Client:
         if self.conn is not None:
             self.conn.close()
             self.conn = None
+
+
+class _PerThreadClients:
+    """One keep-alive _Client per worker thread (a shared connection
+    would interleave concurrent requests on one socket)."""
+
+    def __init__(self, port):
+        self.port = port
+        self._tls = threading.local()
+        self._all = []
+        self._lock = threading.Lock()
+
+    def get(self) -> _Client:
+        c = getattr(self._tls, "client", None)
+        if c is None:
+            c = _Client(self.port)
+            self._tls.client = c
+            with self._lock:
+                self._all.append(c)
+        return c
+
+    def close_all(self):
+        for c in self._all:
+            c.close()
+
+
+@contextmanager
+def bench_storage_env(backend: str, base: str):
+    """Scoped PIO_STORAGE environment for a bench run: sqlite metadata,
+    `backend` ("nativelog"/"sqlite") event data, localfs models, all
+    rooted under `base`. Restores the caller's storage env and clears
+    the registry cache on exit (shared by the product-path and ingest
+    benches so the two can't drift)."""
+    from predictionio_tpu.data.storage import registry
+
+    saved = {k: os.environ[k] for k in list(os.environ)
+             if k.startswith("PIO_STORAGE")}
+    for k in saved:
+        del os.environ[k]
+    os.environ.update({
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "bench_meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "bench_event",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": backend.upper(),
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "bench_model",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
+        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITE_URL": os.path.join(base, "pio.db"),
+        "PIO_STORAGE_SOURCES_NATIVELOG_TYPE": "nativelog",
+        "PIO_STORAGE_SOURCES_NATIVELOG_PATH": os.path.join(base, "evlog"),
+        "PIO_STORAGE_SOURCES_NATIVELOG_PARTITIONS": "8",
+        "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_LOCALFS_HOSTS": os.path.join(base, "models"),
+    })
+    registry.clear_cache()
+    try:
+        yield
+    finally:
+        registry.clear_cache()
+        for k in list(os.environ):
+            if k.startswith("PIO_STORAGE"):
+                del os.environ[k]
+        os.environ.update(saved)
+        registry.clear_cache()
 
 
 def measure_d2h_floor_ms() -> dict:
@@ -705,7 +904,6 @@ def device_alive(timeout_s: float = 240.0):
     backend init + one device round trip in a daemon thread; on timeout
     the caller falls back to a CPU smoke run instead of hanging the
     driver."""
-    import threading
     result = []
 
     def probe():
@@ -770,6 +968,10 @@ def main():
                 "p50_ms": round(s["p50_ms"], 3),
                 "p99_ms": round(s["p99_ms"], 3),
                 "qps_concurrent16": round(s["qps_concurrent16"], 1),
+                "qps_concurrent16_min": round(
+                    s["qps_concurrent16_min"], 1),
+                "qps_concurrent16_max": round(
+                    s["qps_concurrent16_max"], 1),
                 "avg_batch": round(s["serve_avg_batch_size"], 2)}
     product_stats = {}
     if not os.environ.get("PIO_BENCH_SKIP_PRODUCT"):
@@ -777,6 +979,9 @@ def main():
     baseline_stats = {}
     if not os.environ.get("PIO_BENCH_SKIP_BASELINE"):
         baseline_stats = mllib_shaped_cpu_baseline(full_scale)
+    ingest_stats = {}
+    if not os.environ.get("PIO_BENCH_SKIP_INGEST"):
+        ingest_stats = bench_ingest(full_scale)
     value = als_stats["ratings_per_sec_per_chip"]
     out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
@@ -790,6 +995,7 @@ def main():
         **{k: round(v, 3) for k, v in rest_stats.items()},
         **product_stats,
         **baseline_stats,
+        **ingest_stats,
     }
     if baseline_stats:
         # the north-star ratio computed from two numbers measured on
@@ -805,7 +1011,8 @@ def main():
             "measurement plan is staged: scripts/tpu_bench_session.sh "
             "runs this bench + --ablation (sweep_chunk/fused-iteration/"
             "chol_pallas rows) on an idle box as soon as the tunnel "
-            "answers; see docs/ROUND3.md pending-on-hardware list.")
+            "answers; see the 'Pending on hardware' section of "
+            "docs/benchmarks.md.")
     print(json.dumps(out))
 
 

@@ -114,10 +114,22 @@ class DocIndex:
 
     # -- in-memory index ----------------------------------------------------
     @staticmethod
-    def _terms(doc: dict) -> Iterable[Tuple[str, Any]]:
+    def _term_key(v):
+        """Posting-list key for a scalar value. bool is an int subclass
+        with hash(True) == hash(1), so untagged keys would cross-match
+        True and 1; ints and floats deliberately share numeric equality
+        (JSON doesn't distinguish 1 from 1.0)."""
+        return ("bool", v) if isinstance(v, bool) else v
+
+    @staticmethod
+    def _indexable(v) -> bool:
+        return isinstance(v, (str, int, float, bool)) or v is None
+
+    @classmethod
+    def _terms(cls, doc: dict) -> Iterable[Tuple[str, Any]]:
         for k, v in doc.items():
-            if isinstance(v, (str, int, bool)) or v is None:
-                yield k, v
+            if cls._indexable(v):
+                yield k, cls._term_key(v)
 
     def _index(self, _id: str, doc: dict):
         if _id in self._docs:
@@ -174,7 +186,15 @@ class DocIndex:
             if eq:
                 ids: Optional[Set[str]] = None
                 for field, value in eq.items():
-                    postings = self._inv.get(field, {}).get(value, set())
+                    if self._indexable(value):
+                        postings = self._inv.get(field, {}).get(
+                            self._term_key(value), set())
+                    else:
+                        # non-scalar filter value (list/dict): the index
+                        # can't hold it — scan so eq stays correct
+                        # instead of silently empty
+                        postings = {i for i, d in self._docs.items()
+                                    if d.get(field) == value}
                     ids = (set(postings) if ids is None
                            else ids & postings)
                     if not ids:
@@ -183,8 +203,19 @@ class DocIndex:
             else:
                 docs = list(self._docs.values())
         if sort is not None:
-            docs.sort(key=lambda d: (d.get(sort) is None, d.get(sort)),
-                      reverse=reverse)
+            # docs missing the sort field go LAST regardless of
+            # direction (folding None into the key inverts the bucket
+            # under reverse=True); the tagged key keeps mixed-type
+            # values comparable (numbers first, then str-rendered)
+            def sort_key(d):
+                v = d[sort]
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    return (0, v, "")
+                return (1, 0.0, str(v))
+            present = [d for d in docs if d.get(sort) is not None]
+            missing = [d for d in docs if d.get(sort) is None]
+            present.sort(key=sort_key, reverse=reverse)
+            docs = present + missing
         if limit is not None and limit >= 0:
             docs = docs[:limit]
         return docs

@@ -153,19 +153,28 @@ def _serve_foreground(server, label: str) -> int:
     import threading
     import time
 
+    torn_down = threading.Event()  # set when serve_forever returns
+
     def stopper():
         # stop() no-ops until the HTTP socket exists (a signal can land
         # during the up-to-3s bind-retry window, e.g. a systemd restart
         # racing the old instance), so retry until the serve loop is
-        # actually torn down; hard-exit as the systemd-visible fallback
+        # actually torn down — observed via torn_down, NOT assumed: a
+        # wedged drain or stuck collective must surface as a nonzero
+        # exit to systemd/k8s, not masquerade as a clean stop
         deadline = time.time() + 15
         while time.time() < deadline:
             try:
                 server.stop()
             except Exception:
                 pass
-            time.sleep(0.5)
-        os._exit(0)
+            if torn_down.wait(0.5):
+                return  # main thread's start() returned; exits 0 there
+        if torn_down.is_set():
+            return  # teardown landed exactly at the deadline — still clean
+        _print(f"{label}: shutdown did not complete within 15s; "
+               "hard-exiting with status 1.")
+        os._exit(1)
 
     def on_sig(signum, frame):
         _print(f"{label}: received signal {signum}, shutting down.")
@@ -174,6 +183,7 @@ def _serve_foreground(server, label: str) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, on_sig)
     server.start(background=False)
+    torn_down.set()
     return 0
 
 

@@ -120,6 +120,10 @@ class HttpServer:
         # start() checks it after binding and tears down immediately
         # instead of serving as a zombie
         self._stop_requested = False
+        # True once the current start() reached serving; lets stop()
+        # tell "idempotent cleanup after a completed lifecycle" (no-op)
+        # apart from "stop racing a bind in progress" (latch)
+        self._has_served = False
 
     def _make_handler(self):
         router = self.router
@@ -193,6 +197,7 @@ class HttpServer:
         # bind retry x3 mirrors the reference MasterActor
         # (CreateServer.scala:363-373)
         import time as _time
+        self._has_served = False   # new lifecycle attempt begins
         last_err = None
         for attempt in range(bind_retries):
             try:
@@ -211,7 +216,9 @@ class HttpServer:
         if self._stop_requested:   # stop() raced the bind — honor it
             self._httpd.server_close()
             self._httpd = None
+            self._stop_requested = False  # consumed; start() works again
             return
+        self._has_served = True
         if background:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)
@@ -221,8 +228,18 @@ class HttpServer:
         return self
 
     def stop(self):
+        if self._httpd is None and self._has_served:
+            # idempotent cleanup after a completed lifecycle (a second
+            # stop(), a try/finally sweep): nothing to do, and latching
+            # here would make the NEXT start() bind-then-die
+            return
         self._stop_requested = True
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+            # the stop acted on a live server, so the latch is consumed:
+            # an HttpServer is restartable (round-4 advisor); the latch
+            # persists only when stop() fired before/at bind time, where
+            # the pending start() must still honor it
+            self._stop_requested = False

@@ -9,10 +9,10 @@
 # <outdir> (default /tmp/tpu_session_<ts>):
 #   bench.json       — headline line (roofline_fraction, serve wait sweep)
 #   ablation.txt     — solver/chunk/fusion/cholesky configuration matrix
-# Afterwards: update docs/benchmarks.md + docs/ROUND3.md from these
-# files, copy bench.json over BENCH_r03.json if the driver hasn't, and
-# flip resolve_sweep_chunk / fuse_iteration / micro_batch_wait_ms
-# defaults where the data says so.
+# Afterwards: update docs/benchmarks.md ("Pending on hardware" section)
+# from these files, copy bench.json over the CURRENT round's
+# BENCH_r<N>.json if the driver hasn't, and flip resolve_sweep_chunk /
+# fuse_iteration / micro_batch_wait_ms defaults where the data says so.
 set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-/tmp/tpu_session_$(date +%H%M%S)}
@@ -34,7 +34,7 @@ tail -3 "$OUT/kernel_probe.txt"
 echo "== bench (headline + roofline + serve sweep) -> $OUT/bench.json =="
 if ! python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
     echo "BENCH FAILED (rc != 0) — bench.json is an error line, do NOT"
-    echo "copy it over BENCH_r03.json; tail of stderr:"
+    echo "copy it over the round's BENCH_r<N>.json; tail of stderr:"
     tail -c 1000 "$OUT/bench.err"
     rc=1
 fi

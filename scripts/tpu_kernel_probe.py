@@ -14,6 +14,7 @@ Run (idle TPU box): python scripts/tpu_kernel_probe.py [rank=200]
 Exit 0 = all (solver, K) pairs pass.
 """
 
+import os
 import sys
 
 import numpy as np
@@ -23,7 +24,10 @@ def main(rank: int = 200) -> int:
     import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    # abspath first: a relative invocation like `python scripts/...`
+    # would otherwise resolve to "scripts", not the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     from predictionio_tpu.ops.ratings import bucket_lengths
     from predictionio_tpu.ops.solve import cholesky_solve, spd_solve
 

@@ -741,6 +741,45 @@ class TestDocIndex:
         assert ix.search(eq={"status": "GONE"}) == []
         ix.close()
 
+    def test_sort_missing_field_goes_last_both_directions(self, tmp_path):
+        # round-4 advisor: folding None into the sort key inverted the
+        # missing-field bucket under reverse=True (a legacy doc without
+        # startTime outranked every completed instance)
+        from predictionio_tpu.data.storage.docindex import DocIndex
+        ix = DocIndex(str(tmp_path / "s" / "x.log"), fsync=False)
+        ix.put("a", {"t": 1})
+        ix.put("b", {"t": 3})
+        ix.put("legacy", {"other": True})
+        asc = ix.search(sort="t")
+        desc = ix.search(sort="t", reverse=True)
+        assert [d.get("t") for d in asc] == [1, 3, None]
+        assert [d.get("t") for d in desc] == [3, 1, None]
+        # mixed-type sort values must order deterministically, not raise
+        ix.put("m", {"t": "zzz"})
+        assert [d.get("t") for d in ix.search(sort="t", reverse=True)][-1] \
+            is None
+        ix.close()
+
+    def test_eq_float_bool_and_nonscalar_filters(self, tmp_path):
+        # round-4 advisor: floats were unindexed (eq silently empty) and
+        # True/1 shared a posting key (bool is an int subclass)
+        from predictionio_tpu.data.storage.docindex import DocIndex
+        ix = DocIndex(str(tmp_path / "f" / "x.log"), fsync=False)
+        ix.put("f1", {"score": 1.5, "flag": True, "tags": ["a", "b"]})
+        ix.put("f2", {"score": 1, "flag": 1, "tags": ["a"]})
+        assert [d["score"] for d in ix.search(eq={"score": 1.5})] == [1.5]
+        assert len(ix.search(eq={"flag": True})) == 1
+        assert len(ix.search(eq={"flag": 1})) == 1
+        assert ix.search(eq={"flag": True})[0] is not \
+            ix.search(eq={"flag": 1})[0]
+        # non-scalar eq value falls back to a scan instead of empty
+        assert len(ix.search(eq={"tags": ["a", "b"]})) == 1
+        # survives the op-log replay (keys round-trip through JSON)
+        ix.close()
+        ix2 = DocIndex(str(tmp_path / "f" / "x.log"), fsync=False)
+        assert len(ix2.search(eq={"flag": True})) == 1
+        ix2.close()
+
     def test_refuses_event_and_model_roles(self, tmp_path):
         from predictionio_tpu.data.storage.registry import StorageError
         c = self._client(tmp_path)

@@ -459,6 +459,24 @@ class TestStopLatch:
             urllib.request.urlopen(
                 f"http://127.0.0.1:{s.port}/", timeout=2)
 
+    def test_http_server_is_restartable(self):
+        """stop() of a live server consumes the latch (round-4 advisor:
+        it used to latch permanently, so a stopped instance could never
+        start again — start() tore down immediately after bind)."""
+        from predictionio_tpu.utils.http import (HttpServer, Response,
+                                                 Router)
+        r = Router()
+        r.add("GET", "/ping", lambda req: Response(200, {"ok": True}))
+        s = HttpServer(r, "127.0.0.1", 0)
+        for _ in range(2):
+            s.start(background=True)
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{s.port}/ping", timeout=5).read()
+                assert b"ok" in body
+            finally:
+                s.stop()
+
     def test_http_normal_lifecycle_unaffected(self):
         from predictionio_tpu.utils.http import (HttpServer, Response,
                                                  Router)
