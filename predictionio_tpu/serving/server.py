@@ -342,6 +342,13 @@ class EngineServer:
                 tail = (f"<tr><td>p50 / p95 / p99 serving time</td>"
                         f"<td>{p50:.6f} / {p95:.6f} / {p99:.6f} s"
                         f"</td></tr>")
+            if self.coordinator is not None:
+                h = self.coordinator.health()
+                state = ("POISONED — redeploy the mesh" if h["poisoned"]
+                         else "healthy")
+                tail += (f"<tr><td>Mesh coordinator "
+                         f"({h['processes']} processes)</td>"
+                         f"<td>{state}</td></tr>")
         html = f"""<html><head><title>Engine Server at
 {self.config.ip}:{self.config.port}</title></head><body>
 <h1>Engine Server</h1>
@@ -411,6 +418,8 @@ class EngineServer:
                 # realized coalescing (avg/max batch size) — the datum
                 # for tuning micro_batch_wait_ms on a given link
                 out.update(self.batcher.stats())
+            if self.coordinator is not None:
+                out["meshCoordinator"] = self.coordinator.health()
             return Response(200, out)
 
     def _profile(self, req: Request) -> Response:
@@ -465,6 +474,16 @@ class EngineServer:
                  [(None, b["immediateBatches"])]),
                 ("pio_engine_max_batch_size", "gauge",
                  "Largest coalesced batch", [(None, b["maxBatchSize"])]),
+            ]
+        if self.coordinator is not None:
+            h = self.coordinator.health()
+            m += [
+                ("pio_engine_mesh_processes", "gauge",
+                 "Processes in the serving mesh",
+                 [(None, h["processes"])]),
+                ("pio_engine_mesh_poisoned", "gauge",
+                 "1 when a mesh broadcast failed and every query answers "
+                 "503 until redeploy", [(None, int(h["poisoned"]))]),
             ]
         return Response(200, render_metrics(m),
                         content_type=CONTENT_TYPE)

@@ -44,6 +44,93 @@ def _insert_batched(event_iter, app_id: int,
     return n
 
 
+def _movielens_lines(path: str):
+    """Resolve `path` to (iterator of text lines, format) for a real
+    MovieLens dataset. Accepts the ML-100K `u.data` TSV, the
+    ML-20M/ml-latest `ratings.csv`, a directory containing either, or
+    the published .zip archive of either — local files only, no network
+    assumption. Format is "tsv" (user\\titem\\trating\\tts) or "csv"
+    (userId,movieId,rating,timestamp header)."""
+    import io
+    import os
+    import zipfile
+
+    def fmt_of(name: str) -> str:
+        return "tsv" if os.path.basename(name) == "u.data" else "csv"
+
+    if path.endswith(".zip"):
+        zf = zipfile.ZipFile(path)
+        try:
+            members = [n for n in zf.namelist()
+                       if os.path.basename(n) in ("ratings.csv", "u.data")]
+            if not members:
+                raise ValueError(
+                    f"{path}: no ratings.csv or u.data in the archive")
+            member = members[0]
+            wrapper = io.TextIOWrapper(zf.open(member), "utf-8")
+        except Exception:
+            zf.close()
+            raise
+        # the archive handle must live as long as the member stream and
+        # close WITH it (not at GC's leisure)
+        orig_close = wrapper.close
+
+        def close_both():
+            orig_close()
+            zf.close()
+        wrapper.close = close_both
+        return wrapper, fmt_of(member)
+    if os.path.isdir(path):
+        for name in ("ratings.csv", "u.data"):
+            cand = os.path.join(path, name)
+            if os.path.exists(cand):
+                return open(cand, encoding="utf-8"), fmt_of(cand)
+        raise ValueError(f"{path}: no ratings.csv or u.data in directory")
+    return open(path, encoding="utf-8"), fmt_of(path)
+
+
+def movielens_events(path: str):
+    """Yield `rate` events from a real MovieLens dataset, in the exact
+    shape the recommendation template's quickstart ingests — so the day
+    real data is on disk, `pio import --format movielens` + `pio train`
+    produce RMSE curves comparable to published ALS results with no new
+    code. (reference DataSource contract the events feed:
+    examples/scala-parallel-recommendation/custom-prepartor/src/main/
+    scala/DataSource.scala:20-46)"""
+    import datetime as dt
+
+    from predictionio_tpu.data.datamap import DataMap
+
+    f, fmt = _movielens_lines(path)
+    with f:
+        if fmt == "csv":
+            header = f.readline().strip().lower()
+            if not header.startswith("userid,movieid,rating"):
+                raise ValueError(
+                    f"{path}: expected a userId,movieId,rating,timestamp "
+                    f"header, got {header[:60]!r}")
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split("\t" if fmt == "tsv" else ",")
+            uid, mid, rating, ts = parts[0], parts[1], float(parts[2]), \
+                int(parts[3])
+            yield Event(
+                event="rate", entity_type="user", entity_id=uid,
+                target_entity_type="item", target_entity_id=mid,
+                properties=DataMap({"rating": rating}),
+                event_time=dt.datetime.fromtimestamp(
+                    ts, tz=dt.timezone.utc))
+
+
+def import_movielens(app_id: int, input_path: str,
+                     channel_id: Optional[int] = None,
+                     batch_size: int = 10000) -> int:
+    return _insert_batched(movielens_events(input_path), app_id,
+                           channel_id, batch_size)
+
+
 def import_events(app_id: int, input_path: str,
                   channel_id: Optional[int] = None,
                   batch_size: int = 10000, validate: bool = True) -> int:

@@ -98,6 +98,78 @@ class TestExportImport:
         assert e0.properties.get("rating", float) == 3.0
 
 
+class TestMovieLensImport:
+    """`pio import --format movielens` consumes the real dataset files
+    (ML-100K u.data TSV, ML-20M ratings.csv, dirs, .zip archives) with
+    no network assumption."""
+
+    ML100K = "196\t242\t3.0\t881250949\n186\t302\t3.0\t891717742\n"
+    ML20M = ("userId,movieId,rating,timestamp\n"
+             "1,2,3.5,1112486027\n1,29,3.5,1112484676\n2,2,4.0,974820598\n")
+
+    def _import(self, path):
+        from predictionio_tpu.tools.export_import import import_movielens
+        desc = ac.app_new(f"ml_{abs(hash(str(path))) % 10_000}")
+        n = import_movielens(desc.app.id, str(path))
+        return desc.app.id, n
+
+    def test_ml100k_tsv(self, tmp_env, tmp_path):
+        p = tmp_path / "u.data"
+        p.write_text(self.ML100K)
+        app_id, n = self._import(p)
+        assert n == 2
+        ev = Storage.get_events()
+        e = next(iter(ev.find(app_id, entity_id="196",
+                              entity_type="user")))
+        assert e.event == "rate"
+        assert e.target_entity_id == "242"
+        assert e.properties.get("rating", float) == 3.0
+        assert e.event_time.year == 1997  # real ML-100K epoch seconds
+
+    def test_ml20m_csv_and_directory(self, tmp_env, tmp_path):
+        d = tmp_path / "ml-20m"
+        d.mkdir()
+        (d / "ratings.csv").write_text(self.ML20M)
+        app_id, n = self._import(d)  # directory form
+        assert n == 3
+        ev = Storage.get_events()
+        got = {(e.entity_id, e.target_entity_id)
+               for e in ev.find(app_id)}
+        assert ("2", "2") in got and len(got) == 3
+
+    def test_zip_archive(self, tmp_env, tmp_path):
+        import zipfile
+        z = tmp_path / "ml-20m.zip"
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.writestr("ml-20m/ratings.csv", self.ML20M)
+        app_id, n = self._import(z)
+        assert n == 3
+
+    def test_rejects_unknown_csv_header(self, tmp_env, tmp_path):
+        p = tmp_path / "ratings.csv"
+        p.write_text("foo,bar\n1,2\n")
+        from predictionio_tpu.tools.export_import import movielens_events
+        with pytest.raises(ValueError, match="header"):
+            list(movielens_events(str(p)))
+
+    def test_feeds_the_recommendation_datasource(self, tmp_env, tmp_path):
+        """End of the promised chain: imported real-format data is
+        trainable by the recommendation template as-is."""
+        from predictionio_tpu.models import recommendation as R
+        p = tmp_path / "u.data"
+        rows = "".join(f"{u}\t{i}\t{(u * i) % 5 + 1}.0\t88125094{u}\n"
+                       for u in range(1, 5) for i in range(1, 6))
+        p.write_text(rows)
+        desc = ac.app_new("mltrain")
+        from predictionio_tpu.tools.export_import import import_movielens
+        assert import_movielens(desc.app.id, str(p)) == 20
+        ds = R.RecommendationDataSource(
+            R.DataSourceParams(app_name="mltrain"))
+        td = ds.read_training()
+        pd = R.RecommendationPreparator().prepare(td)
+        assert pd.ratings_coo.nnz == 20
+
+
 class TestTrim:
     def test_trim_window_into_fresh_app(self, tmp_env, capsys):
         """pio trim copies only the [start, until) window and refuses a
