@@ -376,8 +376,9 @@ def mllib_shaped_cpu_baseline(full_scale: bool):
     items, and nnz all scaled together so per-entity densities match —
     at the SAME rank (per-rating work is rank-dominated, so ratings/s
     transfers); the reported number turns the assumed
-    SPARK_CPU_BASELINE constant into same-machine arithmetic. ~1 min at
-    rank 200 (sized so it can never dominate the driver's session)."""
+    SPARK_CPU_BASELINE constant into same-machine arithmetic. ~1 min per
+    timed configuration at rank 200, x3 reps (best-of) per core-count —
+    a few minutes total, still a small fraction of a bench session."""
     if full_scale:
         n_users, n_items, nnz, rank = 6_924, 1_337, 1_000_000, 200
     else:
@@ -392,13 +393,19 @@ def mllib_shaped_cpu_baseline(full_scale: bool):
     ncores = len(os.sched_getaffinity(0)) if hasattr(
         os, "sched_getaffinity") else (os.cpu_count() or 1)
 
-    def timed_iteration(n_workers):
-        t0 = time.perf_counter()
-        mllib_half_sweep(ui, ii, vv, n_users, V, U, rank, lam, solve,
-                         n_workers)
-        mllib_half_sweep(ii, ui, vv, n_items, U, V, rank, lam, solve,
-                         n_workers)
-        return time.perf_counter() - t0
+    def timed_iteration(n_workers, reps=3):
+        # best-of-reps: scheduling hiccups on a busy host only ever ADD
+        # time, and the baseline is the north-star denominator — its
+        # fastest observed iteration is the generous (fair) number
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            mllib_half_sweep(ui, ii, vv, n_users, V, U, rank, lam, solve,
+                             n_workers)
+            mllib_half_sweep(ii, ui, vv, n_items, U, V, rank, lam, solve,
+                             n_workers)
+            best = min(best, time.perf_counter() - t0)
+        return best
 
     dt1 = timed_iteration(1)
     out = {"baseline_measured_ratings_per_sec_1core": round(nnz / dt1, 1),
@@ -696,18 +703,33 @@ def bench_ingest(full_scale: bool):
                 bad = [s for s in statuses if s.get("status") != 201]
                 assert not bad, f"batch ingest rejected events: {bad[:3]}"
 
-                t0 = time.perf_counter()
-                for j in range(n_single):
-                    c.post(event(j), path=path)
-                dt_single = time.perf_counter() - t0
+                # median of 3 reps per shape: single timed passes on a
+                # 1-core host swung ~1.4x run-to-run on scheduler noise
+                reps = 3
 
-                t0 = time.perf_counter()
-                for lo in range(0, n_batch_events, MAX_BATCH_SIZE):
-                    c.post([event(j) for j in
-                            range(lo, min(lo + MAX_BATCH_SIZE,
-                                          n_batch_events))],
-                           path="/batch/events.json?accessKey=benchkey")
-                dt_batch = time.perf_counter() - t0
+                def median_rate(run, n_events):
+                    rates = []
+                    for _ in range(reps):
+                        t0 = time.perf_counter()
+                        run()
+                        rates.append(n_events
+                                     / (time.perf_counter() - t0))
+                    return float(np.median(rates))
+
+                def run_singles():
+                    for j in range(n_single):
+                        c.post(event(j), path=path)
+
+                def run_batches():
+                    for lo in range(0, n_batch_events, MAX_BATCH_SIZE):
+                        c.post([event(j) for j in
+                                range(lo, min(lo + MAX_BATCH_SIZE,
+                                              n_batch_events))],
+                               path="/batch/events.json?accessKey="
+                                    "benchkey")
+
+                rate_single = median_rate(run_singles, n_single)
+                rate_batch = median_rate(run_batches, n_batch_events)
                 c.close()
 
                 pool = _PerThreadClients(port)
@@ -718,17 +740,17 @@ def bench_ingest(full_scale: bool):
                 with ThreadPoolExecutor(8) as ex:
                     # warm per-thread connections
                     list(ex.map(post_one, range(64)))
-                    t0 = time.perf_counter()
-                    list(ex.map(post_one, range(n_conc)))
-                    dt_conc = time.perf_counter() - t0
+                    rate_conc = median_rate(
+                        lambda: list(ex.map(post_one, range(n_conc))),
+                        n_conc)
                 pool.close_all()
 
                 out[f"ingest_events_per_sec_single_{backend}"] = round(
-                    n_single / dt_single, 1)
+                    rate_single, 1)
                 out[f"ingest_events_per_sec_batch_{backend}"] = round(
-                    n_batch_events / dt_batch, 1)
+                    rate_batch, 1)
                 out[f"ingest_events_per_sec_concurrent8_{backend}"] = \
-                    round(n_conc / dt_conc, 1)
+                    round(rate_conc, 1)
             finally:
                 if server is not None:
                     server.stop()
