@@ -1,0 +1,56 @@
+"""Suite-speed guards for the bench.py measurement harness: the parity
+job and the pooled MLlib-shaped sweep are artifact-producing code paths
+(MATH_PARITY.json, the north-star denominator) that no other test
+imports. Toy sizes only — the committed artifacts use the real ones."""
+
+import json
+
+import numpy as np
+import pytest
+
+import bench
+
+
+class TestMllibHalfSweep:
+    def test_pooled_sweep_is_bit_identical_to_serial(self):
+        """The thread-pooled baseline writes disjoint entity ranges, so
+        n-core results must equal 1-core results EXACTLY — any drift
+        means the north-star denominator depends on core count."""
+        n_users, n_items, nnz, rank, lam = 300, 120, 9_000, 16, 0.05
+        ui, ii, vv = bench.synthetic_ml20m(n_users, n_items, nnz, seed=3)
+        rng = np.random.default_rng(7)
+        U0 = np.abs(rng.standard_normal((n_users, rank))) / np.sqrt(rank)
+        V = np.abs(rng.standard_normal((n_items, rank))) / np.sqrt(rank)
+        solve = bench.mllib_solver(rank)
+
+        out_serial, out_pooled = U0.copy(), U0.copy()
+        bench.mllib_half_sweep(ui, ii, vv, n_users, V, out_serial,
+                               rank, lam, solve, n_workers=1)
+        bench.mllib_half_sweep(ui, ii, vv, n_users, V, out_pooled,
+                               rank, lam, solve, n_workers=4)
+        assert np.array_equal(out_serial, out_pooled)
+
+
+class TestMathParityHarness:
+    def test_toy_scale_parity_artifact(self, tmp_path):
+        """End-to-end smoke of the --math-parity job: identical data,
+        both trainers, held-out split, artifact written, parity holds.
+        (At toy scale the two paths track each other just as they do at
+        rank 200 — see the committed MATH_PARITY.json for the real run.)
+        """
+        out = tmp_path / "parity.json"
+        rc = bench.math_parity_report(
+            out_path=str(out), iters=2,
+            n_users=400, n_items=150, nnz=20_000, rank=8)
+        d = json.loads(out.read_text())
+        assert d["artifact"] == "rank200_math_parity"
+        assert set(d["results"]) == {"mllib_shaped_float64",
+                                     "als_train_f32_tables",
+                                     "als_train_bf16_tables"}
+        assert d["workload"]["nnz_train"] + d["workload"]["nnz_heldout"] \
+            == 20_000
+        for v in d["results"].values():
+            assert v["heldout_rmse"] > 0
+        # the held-out RMSEs must be in the same ballpark even at toy
+        # scale; rc encodes the tolerance verdict
+        assert rc == 0 and d["parity_ok"] is True

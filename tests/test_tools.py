@@ -152,6 +152,20 @@ class TestMovieLensImport:
         with pytest.raises(ValueError, match="header"):
             list(movielens_events(str(p)))
 
+    def test_cli_import_format_flag(self, tmp_env, tmp_path, capsys):
+        """`pio import --format movielens` end to end through argparse
+        (the wiring the quickstart docs promise)."""
+        from predictionio_tpu.tools.cli import main as cli_main
+        p = tmp_path / "u.data"
+        p.write_text(self.ML100K)
+        desc = ac.app_new("mlcli")
+        rc = cli_main(["import", "--appid", str(desc.app.id),
+                       "--input", str(p), "--format", "movielens"])
+        assert rc == 0
+        assert "Imported 2 events." in capsys.readouterr().out
+        ev = Storage.get_events()
+        assert len(list(ev.find(desc.app.id))) == 2
+
     def test_feeds_the_recommendation_datasource(self, tmp_env, tmp_path):
         """End of the promised chain: imported real-format data is
         trainable by the recommendation template as-is."""
