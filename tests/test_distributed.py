@@ -351,6 +351,17 @@ def test_worker_death_degrades_loudly_not_hang(tmp_path):
         assert ei.value.code == 503
         assert time.time() - t0 < 5
 
+        # the redeploy signal is explicit on the ops surfaces, not just
+        # in query failures (round-5: health surfacing)
+        stats = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{http_port}/stats.json", timeout=10))
+        assert stats["meshCoordinator"]["poisoned"] is True
+        assert stats["meshCoordinator"]["processes"] == 2
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{http_port}/metrics", timeout=10).read()
+        assert b"pio_engine_mesh_poisoned 1" in metrics
+        assert b"pio_engine_mesh_processes 2" in metrics
+
         # the primary still shuts down cleanly (no hang in the
         # worker-release broadcast either)
         req = urllib.request.Request(
