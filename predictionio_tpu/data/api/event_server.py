@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import base64
 import logging
+import os
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -61,6 +63,21 @@ class EventServer:
                 EventServerPluginContext
             plugin_context = EventServerPluginContext.load_from_env()
         self.plugin_context = plugin_context
+        # short-TTL access-key cache: the auth lookup otherwise hits the
+        # metadata store on EVERY request (profiled at ~5% of the single-
+        # event ingest loop; the reference pays the same per-request DAO
+        # round trip — EventServer.scala:81-107). Revocation/creation
+        # takes effect within the TTL; PIO_ACCESSKEY_CACHE_S=0 disables.
+        try:
+            self.auth_cache_ttl_s = float(
+                os.environ.get("PIO_ACCESSKEY_CACHE_S", "3.0"))
+        except ValueError:
+            logger.warning(
+                "PIO_ACCESSKEY_CACHE_S=%r is not a number; using the "
+                "3.0s default",
+                os.environ.get("PIO_ACCESSKEY_CACHE_S"))
+            self.auth_cache_ttl_s = 3.0
+        self._auth_cache: dict = {}
         self.router = self._build_router()
         self.server: Optional[HttpServer] = None
 
@@ -90,7 +107,7 @@ class EventServer:
                     key = None
         if not key:
             raise AuthError(401, "Missing accessKey.")
-        access_key = self.access_keys.get(key)
+        access_key = self._cached_access_key(key)
         if access_key is None:
             raise AuthError(401, "Invalid accessKey.")
         channel_id = None
@@ -102,6 +119,29 @@ class EventServer:
                 raise AuthError(400, "Invalid channel.")
             channel_id = match[0].id
         return access_key, channel_id
+
+    def _cached_access_key(self, key: str):
+        """DAO lookup behind a TTL cache (misses cached too, so invalid
+        keys can't hammer the metadata store). Dict ops are GIL-atomic;
+        a racing refresh only costs a duplicate lookup."""
+        ttl = self.auth_cache_ttl_s
+        if ttl <= 0:
+            return self.access_keys.get(key)
+        now = time.monotonic()
+        hit = self._auth_cache.get(key)
+        if hit is not None and now - hit[1] < ttl:
+            return hit[0]
+        access_key = self.access_keys.get(key)
+        if len(self._auth_cache) >= 1024:
+            # bound growth from junk keys: FIFO-evict one (dict keeps
+            # insertion order) — clearing everything would let a scanner
+            # evict hot valid keys and reinstate the per-request DAO hit
+            try:
+                self._auth_cache.pop(next(iter(self._auth_cache)))
+            except (StopIteration, KeyError):   # concurrent shrink
+                pass
+        self._auth_cache[key] = (access_key, now)
+        return access_key
 
     # -- handlers -----------------------------------------------------------
     def _status(self, req: Request) -> Response:

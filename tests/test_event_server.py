@@ -115,6 +115,47 @@ class TestEventCRUD:
         assert status == 400
 
 
+class TestAuthCache:
+    def test_revocation_honored_within_ttl_semantics(self, server):
+        """The TTL cache trades revocation latency (bounded by the TTL)
+        for skipping a metadata-store hit per request. With the cache
+        active a deleted key keeps working until the TTL lapses; with
+        PIO_ACCESSKEY_CACHE_S=0 semantics (ttl<=0), revocation is
+        immediate."""
+        p = server.config.port
+        from predictionio_tpu.data.storage import Storage
+
+        server.auth_cache_ttl_s = 3.0   # pin: ambient env must not leak
+        status, _ = call(p, "POST", "/events.json?accessKey=testkey",
+                         EVENT)
+        assert status == 201        # primes the cache
+        Storage.get_meta_data_access_keys().delete("testkey")
+        status, _ = call(p, "POST", "/events.json?accessKey=testkey",
+                         EVENT)
+        assert status == 201        # still cached (ttl 3s default)
+        server.auth_cache_ttl_s = 0.0   # operator disabled the cache
+        status, _ = call(p, "POST", "/events.json?accessKey=testkey",
+                         EVENT)
+        assert status == 401        # revocation now immediate
+
+    def test_expiry_picks_up_new_state(self, server):
+        p = server.config.port
+        server.auth_cache_ttl_s = 0.05
+        status, _ = call(p, "POST", "/events.json?accessKey=ghostkey",
+                         EVENT)
+        assert status == 401        # miss is cached too
+        from predictionio_tpu.data.storage import AccessKey, Storage
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.get_by_name("esapp").id
+        Storage.get_meta_data_access_keys().insert(
+            AccessKey("ghostkey", app_id, []))
+        import time as _t
+        _t.sleep(0.06)              # past the TTL
+        status, _ = call(p, "POST", "/events.json?accessKey=ghostkey",
+                         EVENT)
+        assert status == 201
+
+
 class TestFindEvents:
     def seed(self, p):
         for i, (ev, eid, sec) in enumerate([
