@@ -369,18 +369,28 @@ def cmd_template(args) -> int:
 
 
 def cmd_export(args) -> int:
-    from predictionio_tpu.tools.export_import import export_events
-    n = export_events(args.appid, args.output, channel_id=args.channelid)
+    from predictionio_tpu.tools.export_import import (
+        export_events, export_events_parquet)
+    if getattr(args, "format", "json") == "parquet":
+        n = export_events_parquet(args.appid, args.output,
+                                  channel_id=args.channelid)
+    else:
+        n = export_events(args.appid, args.output,
+                          channel_id=args.channelid)
     _print(f"Exported {n} events to {args.output}.")
     return 0
 
 
 def cmd_import(args) -> int:
-    from predictionio_tpu.tools.export_import import (import_events,
-                                                      import_movielens)
-    if getattr(args, "format", "events") == "movielens":
+    from predictionio_tpu.tools.export_import import (
+        import_events, import_events_parquet, import_movielens)
+    fmt = getattr(args, "format", "events")
+    if fmt == "movielens":
         n = import_movielens(args.appid, args.input,
                              channel_id=args.channelid)
+    elif fmt == "parquet":
+        n = import_events_parquet(args.appid, args.input,
+                                  channel_id=args.channelid)
     else:
         n = import_events(args.appid, args.input,
                           channel_id=args.channelid)
@@ -655,15 +665,22 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--appid", type=int, required=True)
     ex.add_argument("--output", required=True)
     ex.add_argument("--channelid", type=int)
+    ex.add_argument("--format", choices=["json", "parquet"],
+                    default="json",
+                    help="json = one wire-format event per line; "
+                         "parquet = columnar (the reference's default "
+                         "format, EventsToFile.scala:35)")
     ex.set_defaults(func=cmd_export)
 
     im = sub.add_parser("import")
     im.add_argument("--appid", type=int, required=True)
     im.add_argument("--input", required=True)
     im.add_argument("--channelid", type=int)
-    im.add_argument("--format", choices=["events", "movielens"],
+    im.add_argument("--format",
+                    choices=["events", "parquet", "movielens"],
                     default="events",
                     help="events = JSON-lines (pio export's output); "
+                         "parquet = pio export --format parquet output; "
                          "movielens = a real ML-100K u.data / "
                          "ML-20M ratings.csv file, directory, or .zip")
     im.set_defaults(func=cmd_import)

@@ -98,6 +98,85 @@ class TestExportImport:
         assert e0.properties.get("rating", float) == 3.0
 
 
+class TestParquetExportImport:
+    def test_parquet_round_trip(self, tmp_env, tmp_path):
+        """pio export --format parquet -> pio import --format parquet
+        preserves every event field including free-form properties,
+        tags, and timezone-aware times (the reference's DEFAULT export
+        format, EventsToFile.scala:35)."""
+        import datetime as dt
+        desc = ac.app_new("pqapp")
+        ev = Storage.get_events()
+        t0 = dt.datetime(2026, 3, 1, 12, 30, 45, 123000,
+                         tzinfo=dt.timezone.utc)
+        for i in range(7):
+            ev.insert(Event(event="rate", entity_type="user",
+                            entity_id=f"u{i}", target_entity_type="item",
+                            target_entity_id=f"i{i}",
+                            properties=DataMap({"rating": float(i),
+                                                "nested": {"a": [1, i]}}),
+                            tags=("t1", f"t{i}"),
+                            event_time=t0 + dt.timedelta(seconds=i)),
+                      desc.app.id)
+        ev.insert(Event(event="$set", entity_type="user",
+                        entity_id="bare"), desc.app.id)  # minimal event
+
+        out = tmp_path / "events.parquet"
+        from predictionio_tpu.tools.cli import main as cli_main
+        assert cli_main(["export", "--appid", str(desc.app.id),
+                         "--output", str(out),
+                         "--format", "parquet"]) == 0
+
+        desc2 = ac.app_new("pqapp2")
+        assert cli_main(["import", "--appid", str(desc2.app.id),
+                         "--input", str(out),
+                         "--format", "parquet"]) == 0
+        got = {e.entity_id: e for e in ev.find(desc2.app.id)}
+        assert len(got) == 8
+        e3 = got["u3"]
+        assert e3.properties.get("rating", float) == 3.0
+        assert e3.properties["nested"] == {"a": [1, 3]}
+        assert set(e3.tags) == {"t1", "t3"}
+        assert e3.event_time == t0 + dt.timedelta(seconds=3)
+        assert e3.event_time.tzinfo is not None
+        assert got["bare"].event == "$set"
+        assert got["bare"].target_entity_id is None
+
+    def test_foreign_parquet_is_validated(self, tmp_env, tmp_path):
+        """A hand-built parquet file gets the same scrutiny as JSON
+        import: reserved/invalid names rejected, null required fields
+        rejected — nothing lands in the store unvalidated."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from predictionio_tpu.tools.export_import import (
+            _parquet_schema, parquet_events)
+
+        def write(path, event, entity_type, entity_id):
+            pq.write_table(pa.table({
+                "eventId": [None], "event": [event],
+                "entityType": [entity_type], "entityId": [entity_id],
+                "targetEntityType": [None], "targetEntityId": [None],
+                "properties": ["{}"], "eventTime": [None],
+                "tags": [[]], "prId": [None], "creationTime": [None],
+            }, schema=_parquet_schema()), path)
+
+        bad_name = tmp_path / "badname.parquet"
+        write(bad_name, "$bogus", "user", "u1")
+        with pytest.raises(Exception, match=r"\$bogus|reserved|invalid"):
+            list(parquet_events(str(bad_name)))
+
+        null_req = tmp_path / "nullreq.parquet"
+        write(null_req, "rate", None, "u1")
+        with pytest.raises(ValueError, match="entityType"):
+            list(parquet_events(str(null_req)))
+
+        ok = tmp_path / "ok.parquet"
+        write(ok, "rate", "user", "u1")
+        evs = list(parquet_events(str(ok)))
+        assert len(evs) == 1
+        assert evs[0].event_time is not None  # defaulted, not None
+
+
 class TestMovieLensImport:
     """`pio import --format movielens` consumes the real dataset files
     (ML-100K u.data TSV, ML-20M ratings.csv, dirs, .zip archives) with
