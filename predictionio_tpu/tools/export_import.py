@@ -114,18 +114,22 @@ def parquet_events(input_path: str, validate: bool = True):
                     raise ValueError(
                         f"parquet event missing required field "
                         f"{req!r}: {row!r}")
+            props = _json.loads(row.get("properties") or "{}")
+            if not isinstance(props, dict):
+                raise ValueError(
+                    "parquet event field 'properties' must be a JSON "
+                    f"object, got {row.get('properties')!r}")
             e = Event(
                 event=row["event"], entity_type=row["entityType"],
                 entity_id=row["entityId"],
-                target_entity_type=row["targetEntityType"],
-                target_entity_id=row["targetEntityId"],
-                properties=DataMap(
-                    _json.loads(row["properties"] or "{}")),
-                event_time=row["eventTime"] or utcnow(),
-                tags=row["tags"] or (),
-                pr_id=row["prId"],
-                creation_time=row["creationTime"] or utcnow(),
-                event_id=row["eventId"])
+                target_entity_type=row.get("targetEntityType"),
+                target_entity_id=row.get("targetEntityId"),
+                properties=DataMap(props),
+                event_time=row.get("eventTime") or utcnow(),
+                tags=row.get("tags") or (),
+                pr_id=row.get("prId"),
+                creation_time=row.get("creationTime") or utcnow(),
+                event_id=row.get("eventId"))
             if validate:
                 EventValidation.validate(e)
             yield e

@@ -1,0 +1,111 @@
+"""Concurrency soak for the Event Server on the C++ nativelog store:
+many threads doing mixed CRUD + queries through real HTTP must neither
+error nor corrupt the store (the threaded-ingestion role of the
+reference's Spray server + HBase store,
+data/src/main/scala/io/prediction/data/api/EventServer.scala:112-460).
+The suite's other event-server tests are serial; races between the
+appender, the reader's shard scans, and delete sweeps only show up
+under true interleaving."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api.event_server import (EventServer,
+                                                    EventServerConfig)
+from predictionio_tpu.data.storage import AccessKey, App, Storage
+
+
+@pytest.fixture
+def nativelog_server(tmp_env, tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE",
+                       "NATIVELOG")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_NATIVELOG_TYPE", "nativelog")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_NATIVELOG_PATH",
+                       str(tmp_path / "soaklog"))
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_NATIVELOG_PARTITIONS", "4")
+    from predictionio_tpu.data.storage import registry
+    registry.clear_cache()
+    app_id = Storage.get_meta_data_apps().insert(App(0, "soakapp"))
+    Storage.get_events().init(app_id)
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("soakkey", app_id, []))
+    s = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+    s.start()
+    yield s, app_id
+    s.stop()
+    registry.clear_cache()
+
+
+def _call(port, method, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=(json.dumps(body).encode() if body is not None else None))
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read() or b"null")
+
+
+def test_concurrent_mixed_crud_is_consistent(nativelog_server):
+    server, app_id = nativelog_server
+    port = server.config.port
+    n_threads, ops_per_thread = 8, 60
+    errors = []
+    kept_ids = [[] for _ in range(n_threads)]
+
+    def work(t):
+        try:
+            deleted_every = 5
+            for i in range(ops_per_thread):
+                ev = {"event": "rate", "entityType": "user",
+                      "entityId": f"t{t}u{i}",
+                      "targetEntityType": "item",
+                      "targetEntityId": f"i{i % 7}",
+                      "properties": {"rating": float(i % 5), "t": t}}
+                st, body = _call(port, "POST",
+                                 "/events.json?accessKey=soakkey", ev)
+                assert st == 201, body
+                eid = body["eventId"]
+                if i % deleted_every == 0:
+                    st, body = _call(
+                        port, "DELETE",
+                        f"/events/{eid}.json?accessKey=soakkey")
+                    assert st == 200, body
+                else:
+                    kept_ids[t].append(eid)
+                if i % 10 == 0:   # interleave reads with the writes
+                    st, found = _call(
+                        port, "GET",
+                        "/events.json?accessKey=soakkey&limit=20"
+                        f"&entityType=user&entityId=t{t}u{i - 1}"
+                        if i else
+                        "/events.json?accessKey=soakkey&limit=5")
+                    assert st == 200
+        except Exception as e:   # pragma: no cover - failure detail
+            errors.append((t, repr(e)))
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    # a hung worker must read as "thread did not finish", not as a
+    # store-count mismatch from it still writing during the checks
+    assert not any(th.is_alive() for th in threads), "worker hung"
+    assert not errors, errors[:3]
+
+    # store-level consistency after the dust settles: every kept id
+    # readable, every deleted id gone, total count exact
+    survivors = [eid for ids in kept_ids for eid in ids]
+    expected = n_threads * ops_per_thread - n_threads * (
+        ops_per_thread // 5)
+    assert len(survivors) == expected
+    ev = Storage.get_events()
+    total = sum(1 for _ in ev.find(app_id))
+    assert total == expected
+    for eid in survivors[::17]:   # spot-check reads through HTTP
+        st, body = _call(port, "GET",
+                         f"/events/{eid}.json?accessKey=soakkey")
+        assert st == 200 and body["event"] == "rate"
