@@ -291,5 +291,9 @@ class EngineFactory:
         raise NotImplementedError
 
     @classmethod
-    def engine_params(cls) -> EngineParams:
+    def engine_params(cls, key: str = "") -> EngineParams:
+        """Programmatic engine parameters; `key` selects among named
+        sets when a factory defines them (`pio train
+        --engine-params-key`, EngineFactory.scala:33 — the reference's
+        default likewise ignores the key and returns defaults)."""
         return EngineParams()

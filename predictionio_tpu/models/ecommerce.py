@@ -374,7 +374,7 @@ class ECommerceEngineFactory(EngineFactory):
             {"": FirstServing})
 
     @classmethod
-    def engine_params(cls) -> EngineParams:
+    def engine_params(cls, key: str = "") -> EngineParams:
         return EngineParams(
             data_source_params=("", DataSourceParams()),
             preparator_params=("", None),

@@ -528,7 +528,7 @@ class RecommendationEngineFactory(EngineFactory):
             {"": FirstServing})
 
     @classmethod
-    def engine_params(cls) -> EngineParams:
+    def engine_params(cls, key: str = "") -> EngineParams:
         return EngineParams(
             data_source_params=("", DataSourceParams()),
             preparator_params=("", PreparatorParams()),

@@ -490,7 +490,7 @@ class SimilarProductEngineFactory(EngineFactory):
             {"": FirstServing})
 
     @classmethod
-    def engine_params(cls) -> EngineParams:
+    def engine_params(cls, key: str = "") -> EngineParams:
         return EngineParams(
             data_source_params=("", DataSourceParams()),
             preparator_params=("", None),

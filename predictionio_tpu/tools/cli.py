@@ -116,6 +116,7 @@ def cmd_train(args) -> int:
         engine_id=args.engine_id or "default",
         engine_version=args.engine_version or "0",
         engine_factory=args.engine_factory,
+        engine_params_key=args.engine_params_key,
         skip_sanity_check=args.skip_sanity_check,
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
@@ -549,18 +550,22 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("status").set_defaults(func=cmd_status)
 
     b = sub.add_parser("build")
-    b.add_argument("--engine-json", default="engine.json")
+    _add_variant_arg(b)
     b.set_defaults(func=cmd_build)
 
     un = sub.add_parser("unregister")
-    un.add_argument("--engine-json", default="engine.json")
+    _add_variant_arg(un)
     un.set_defaults(func=cmd_unregister)
 
     t = sub.add_parser("train")
-    t.add_argument("--engine-json", default="engine.json")
+    _add_variant_arg(t)
     t.add_argument("--engine-id")
     t.add_argument("--engine-version")
     t.add_argument("--engine-factory")
+    t.add_argument("--engine-params-key",
+                   help="train with the factory's named programmatic "
+                        "params instead of the variant JSON "
+                        "(EngineFactory.engine_params(key))")
     t.add_argument("--batch")
     t.add_argument("--skip-sanity-check", action="store_true")
     t.add_argument("--stop-after-read", action="store_true")
@@ -570,14 +575,14 @@ def build_parser() -> argparse.ArgumentParser:
     e = sub.add_parser("eval")
     e.add_argument("evaluation_class")
     e.add_argument("engine_params_generator_class", nargs="?")
-    e.add_argument("--engine-json", default="engine.json")
+    _add_variant_arg(e)
     e.add_argument("--batch")
     e.set_defaults(func=cmd_eval)
 
     d = sub.add_parser("deploy")
     d.add_argument("--ip", default="0.0.0.0")
     d.add_argument("--port", type=int, default=8000)
-    d.add_argument("--engine-json", default="engine.json")
+    _add_variant_arg(d)
     d.add_argument("--engine-id")
     d.add_argument("--engine-version")
     d.add_argument("--engine-instance-id")
@@ -734,6 +739,15 @@ def build_parser() -> argparse.ArgumentParser:
     up.set_defaults(func=cmd_upgrade)
 
     return p
+
+
+def _add_variant_arg(sp):
+    """The engine-variant file flag shared by build/unregister/train/
+    eval/deploy; --variant/-v are the reference's spellings
+    (Console.scala:161)."""
+    sp.add_argument("--engine-json", "--variant", "-v",
+                    dest="engine_json", default="engine.json",
+                    help="engine variant JSON (reference: --variant/-v)")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -81,7 +81,12 @@ def _engine_and_params(config: WorkflowConfig):
             "--engine-factory")
     factory = get_engine_factory(factory_name)
     engine = factory.apply()
-    engine_params = engine.json_to_engine_params(variant)
+    if config.engine_params_key:
+        # named programmatic params from the factory instead of the
+        # variant JSON (CreateWorkflow.scala:216-220)
+        engine_params = factory.engine_params(config.engine_params_key)
+    else:
+        engine_params = engine.json_to_engine_params(variant)
     return variant, factory_name, engine, engine_params
 
 

@@ -18,6 +18,20 @@ from predictionio_tpu.serving import EngineServer, ServerConfig
 from predictionio_tpu.workflow import run_train
 
 
+class KeyedParamsFactory(R.RecommendationEngineFactory):
+    """Module-level (dotted-path resolvable) factory with named
+    programmatic params, for the --engine-params-key contract test."""
+
+    @classmethod
+    def engine_params(cls, key: str = "") -> EngineParams:
+        assert key == "tiny", f"unexpected params key {key!r}"
+        return EngineParams(
+            data_source_params=("", R.DataSourceParams(app_name="wsapp")),
+            preparator_params=("", R.PreparatorParams()),
+            algorithm_params_list=[("als", R.ALSAlgorithmParams(
+                rank=4, num_iterations=2, lam=0.1, seed=2))])
+
+
 def call(port, method, path, body=None):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}{path}", method=method,
@@ -203,3 +217,24 @@ class TestCreateWorkflowMain:
         inst = Storage.get_meta_data_engine_instances().get(iid)
         assert inst.status == "COMPLETED"
         assert inst.engine_id == "recEngine"
+
+    def test_engine_params_key_uses_factory_params(self, seeded_app,
+                                                   tmp_path):
+        """`pio train --engine-params-key` takes params from the
+        factory's programmatic sets, NOT the variant JSON
+        (CreateWorkflow.scala:216-220). The variant here carries a
+        deliberately broken algorithm name, so training only succeeds
+        if the key path really bypassed it."""
+        from predictionio_tpu.workflow import (WorkflowConfig,
+                                               create_workflow_main)
+        variant = {
+            "id": "keyedEngine",
+            "engineFactory":
+                "tests.test_workflow_serving.KeyedParamsFactory",
+            "algorithms": [{"name": "NO_SUCH_ALGO", "params": {}}]}
+        vf = tmp_path / "engine.json"
+        vf.write_text(json.dumps(variant))
+        iid = create_workflow_main(WorkflowConfig(
+            engine_variant=str(vf), engine_params_key="tiny"))
+        inst = Storage.get_meta_data_engine_instances().get(iid)
+        assert inst.status == "COMPLETED"
