@@ -276,15 +276,21 @@ def cg_solve_pallas(A, b, iters: int = 48, tile: int = 16):
 
 def _blocked_cholesky_solve(A, b, panel: int = 8):
     """Batched blocked (right-looking) Cholesky + blocked substitution,
-    written so every slice is static: the Python panel loop unrolls into
-    panel-width rank updates whose trailing syrk is a batched matmul —
-    MXU work — while the per-column factor/substitution steps are cheap
-    [B, M] vector ops. Flop layout per system: ~R^3/3 in trailing matmul
-    updates + 2R^2 substitution, vs CG's ~96 R^2 of cross-sublane VPU
-    matvecs and Schulz's ~72 R^3 of matmuls. Used inside the Pallas tile
-    kernel AND directly (interpret/CPU correctness path).
+    written so every slice is static AND scatter-free: Mosaic's TPU
+    lowering has no scatter, so instead of writing panels back into a
+    full L, the Python panel loop keeps each panel's factors in lists
+    (static slices recover any L block during substitution), per-column
+    updates are where-masks over a traced broadcasted_iota (an eager
+    jnp.arange would be captured as a kernel constant, which pallas_call
+    rejects), and the trailing Schur update recurses on the shrinking
+    submatrix rather than scattering into A. Flop layout per system:
+    ~R^3/3 in trailing matmul updates (MXU) + 2R^2 substitution, vs CG's
+    ~96 R^2 of cross-sublane VPU matvecs and Schulz's ~72 R^3 of
+    matmuls. Used inside the Pallas tile kernel AND directly
+    (interpret/CPU correctness path, GSPMD meshes as 'chol_blocked').
 
     A: [B, R, R] SPD (R % panel == 0 — wrappers pad), b: [B, R]."""
+    import jax
     import jax.numpy as jnp
 
     B, R = b.shape
@@ -295,75 +301,97 @@ def _blocked_cholesky_solve(A, b, panel: int = 8):
     if R % PW:
         # pad to a whole panel with an identity block (decoupled rows
         # solve to 0) — without this, trailing rows would silently never
-        # be factored
+        # be factored. Outside-kernel path only: wrappers pre-pad before
+        # pallas_call, so jnp.pad/jnp.eye never trace inside a kernel.
         pad = PW - R % PW
+        A = (jnp.pad(A, ((0, 0), (0, pad), (0, pad)))
+             + jnp.pad(jnp.eye(pad, dtype=jnp.float32),
+                       ((rank_in, 0), (rank_in, 0)))[None])
+        b = jnp.pad(b, ((0, 0), (0, pad)))
         R = R + pad
-        Ap = jnp.zeros((B, R, R), jnp.float32)
-        Ap = Ap.at[:, :rank_in, :rank_in].set(A)
-        Ap = Ap.at[:, rank_in:, rank_in:].set(
-            jnp.eye(pad, dtype=jnp.float32))
-        A = Ap
-        b = jnp.concatenate([b, jnp.zeros((B, pad), b.dtype)], axis=1)
     nP = R // PW
-    L = jnp.zeros_like(A)
+    # [1, PW] traced column ids — where-masks replace .at[] column sets
+    cids = jax.lax.broadcasted_iota(jnp.int32, (1, PW), 1)
+    L11s, L21s = [], []
+    Atr = A                                    # trailing [B, M, M]
     for p in range(nP):
-        lo, hi = p * PW, (p + 1) * PW
-        A11 = A[:, lo:hi, lo:hi]                       # [B, PW, PW]
+        A11 = Atr[:, :PW, :PW]                 # [B, PW, PW]
         # unblocked factor of the diagonal block (PW static steps)
         L11 = jnp.zeros_like(A11)
         for c in range(PW):
             d = jnp.sqrt(jnp.maximum(A11[:, c, c], 1e-30))
-            col = A11[:, :, c] / d[:, None]            # [B, PW]
-            col = col * (jnp.arange(PW) >= c)          # lower part only
-            L11 = L11.at[:, :, c].set(col)
+            col = A11[:, :, c] / d[:, None]    # [B, PW]
+            col = jnp.where(cids >= c, col, 0.0)   # lower part only
+            L11 = jnp.where((cids == c).reshape(1, 1, PW),
+                            col[:, :, None], L11)
             A11 = A11 - col[:, :, None] * col[:, None, :]
-        L = L.at[:, lo:hi, lo:hi].set(L11)
-        if hi < R:
-            A21 = A[:, hi:, lo:hi]                     # [B, M, PW]
+        L11s.append(L11)
+        if Atr.shape[1] > PW:
+            A21 = Atr[:, PW:, :PW]             # [B, M, PW]
             # L21 L11^T = A21: forward substitution, PW static steps
             L21 = jnp.zeros_like(A21)
             for c in range(PW):
                 acc = A21[:, :, c]
                 for k in range(c):
                     acc = acc - L21[:, :, k] * L11[:, c, k][:, None]
-                L21 = L21.at[:, :, c].set(acc / L11[:, c, c][:, None])
-            L = L.at[:, hi:, lo:hi].set(L21)
+                L21 = jnp.where((cids == c).reshape(1, 1, PW),
+                                (acc / L11[:, c, c][:, None])[:, :, None],
+                                L21)
+            L21s.append(L21)
             # trailing syrk — the MXU step: A22 -= L21 @ L21^T
             upd = jnp.einsum("bmk,bnk->bmn", L21, L21,
                              preferred_element_type=jnp.float32)
-            A = A.at[:, hi:, hi:].add(-upd)
+            Atr = Atr[:, PW:, PW:] - upd
+        else:
+            L21s.append(None)
+
+    def _l_block(p, q):
+        # L[lo_p:hi_p, lo_q:hi_q] for p > q, recovered from panel q's
+        # below-diagonal strip (its row 0 is global row hi_q)
+        o = (p - q - 1) * PW
+        return L21s[q][:, o:o + PW, :]
+
     # blocked forward substitution: L y = b
-    y = jnp.zeros_like(b)
+    ys = []
     for p in range(nP):
-        lo, hi = p * PW, (p + 1) * PW
-        rhs = b[:, lo:hi]
-        if p:
-            rhs = rhs - jnp.einsum("bmk,bk->bm", L[:, lo:hi, :lo],
-                                   y[:, :lo],
+        rhs = b[:, p * PW:(p + 1) * PW]
+        for q in range(p):
+            rhs = rhs - jnp.einsum("bmk,bk->bm", _l_block(p, q), ys[q],
                                    preferred_element_type=jnp.float32)
+        L11 = L11s[p]
         yp = jnp.zeros_like(rhs)
         for c in range(PW):
             acc = rhs[:, c]
             for k in range(c):
-                acc = acc - L[:, lo + c, lo + k] * yp[:, k]
-            yp = yp.at[:, c].set(acc / L[:, lo + c, lo + c])
-        y = y.at[:, lo:hi].set(yp)
+                acc = acc - L11[:, c, k] * yp[:, k]
+            yp = jnp.where(cids == c, (acc / L11[:, c, c])[:, None], yp)
+        ys.append(yp)
     # blocked back substitution: L^T x = y
-    x = jnp.zeros_like(b)
+    xs = [None] * nP
     for p in reversed(range(nP)):
-        lo, hi = p * PW, (p + 1) * PW
-        rhs = y[:, lo:hi]
-        if hi < R:
-            rhs = rhs - jnp.einsum("bkm,bk->bm", L[:, hi:, lo:hi],
-                                   x[:, hi:],
+        rhs = ys[p]
+        for q in range(p + 1, nP):
+            rhs = rhs - jnp.einsum("bkm,bk->bm", _l_block(q, p), xs[q],
                                    preferred_element_type=jnp.float32)
+        L11 = L11s[p]
         xp = jnp.zeros_like(rhs)
         for c in reversed(range(PW)):
             acc = rhs[:, c]
             for k in range(c + 1, PW):
-                acc = acc - L[:, lo + k, lo + c] * xp[:, k]
-            xp = xp.at[:, c].set(acc / L[:, lo + c, lo + c])
-        x = x.at[:, lo:hi].set(xp)
+                acc = acc - L11[:, k, c] * xp[:, k]
+            xp = jnp.where(cids == c, (acc / L11[:, c, c])[:, None], xp)
+        xs[p] = xp
+    # assemble [B, R] from panels with iota-built selector matmuls
+    # (concatenate on a non-lane-aligned minor dim is exactly what
+    # Mosaic dislikes; a [PW, R] one-hot embed is a cheap MXU op and
+    # fully traced)
+    x = jnp.zeros_like(b)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (PW, R), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (PW, R), 1)
+    for p in range(nP):
+        sel = (rows + p * PW == cols).astype(jnp.float32)   # [PW, R]
+        x = x + jnp.einsum("bp,pr->br", xs[p], sel,
+                           preferred_element_type=jnp.float32)
     return x[:, :rank_in]
 
 
