@@ -1,4 +1,6 @@
-"""30-second TPU kernel sanity gate for the measurement session.
+"""TPU kernel sanity gate for the measurement session (~30 s healthy;
+self-bounded to GLOBAL_DEADLINE + one per-pair timeout when the tunnel
+degrades — size any outer timeout above that sum).
 
 The round-4 bucket ladder introduces K values the Pallas solvers have
 never seen on real Mosaic layouts (odd multiples of 8: 24, 40, 56, ...,
@@ -11,13 +13,74 @@ ladder can be hot-patched in-session (worst case: round dual K up to a
 proven multiple) instead of diagnosing a mid-bench Mosaic error.
 
 Run (idle TPU box): python scripts/tpu_kernel_probe.py [rank=200]
-Exit 0 = all (solver, K) pairs pass.
+Exit codes (the session script branches on these):
+  0 — all (solver, K) pairs pass
+  2 — only CANDIDATE solvers failed (chol/schulz ablation rows will
+      fail-soft inside bench.py --ablation; the headline bench, which
+      uses only the production solver, is unaffected — proceed)
+  1 — the PRODUCTION solver failed on some K (fix before benching)
+  3 — a compile/execute hung past the per-pair deadline: the tunnel is
+      wedged, nothing further will answer — abort and re-probe later
+  4 — environment problem (not a TPU backend, import failure, bad
+      argv): fix the box, not the kernels
+  5 — global deadline exceeded with every pair still answering: the
+      tunnel is degraded (treat like a wedge; re-probe later)
 """
 
 import os
 import sys
+import threading
+import time
 
 import numpy as np
+
+# resolve_solver('auto') on a single TPU chip — the solver the headline
+# bench actually runs; chol/schulz are ablation candidates only
+PRODUCTION_SOLVERS = {"cg_pallas"}
+PER_PAIR_TIMEOUT_S = 180.0
+# healthy pairs answer in ~5-20 s; the whole ladder finishes well under
+# this. Checked between bounded ops so worst case is DEADLINE + one
+# PER_PAIR_TIMEOUT — size any outer shell timeout ABOVE that sum
+GLOBAL_DEADLINE_S = 2700.0
+_T0 = time.monotonic()
+
+
+def _hard_exit(code, msg):
+    """Exit without interpreter/JAX teardown: atexit and PJRT client
+    destructors RPC the device, and on the hang paths the device is by
+    definition not answering — sys.exit would trade the specific rc for
+    an outer-timeout rc=124 an hour later."""
+    print(msg, flush=True)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
+
+
+def _check_deadline():
+    if time.monotonic() - _T0 > GLOBAL_DEADLINE_S:
+        _hard_exit(5, f"GLOBAL DEADLINE {GLOBAL_DEADLINE_S:.0f}s "
+                      "exceeded with pairs still answering — tunnel "
+                      "degraded, aborting probe (re-run later)")
+
+
+def _run_bounded(fn, timeout_s):
+    """Run fn in a daemon thread with a join deadline. A wedged tunnel
+    RPC blocks inside C (SIGALRM can't interrupt it), but the main
+    thread can abandon the join and report the hang."""
+    box = {}
+
+    def work():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — reported upstream
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None, None, True
+    return box.get("value"), box.get("error"), False
 
 
 def main(rank: int = 200) -> int:
@@ -31,10 +94,19 @@ def main(rank: int = 200) -> int:
     from predictionio_tpu.ops.ratings import bucket_lengths
     from predictionio_tpu.ops.solve import cholesky_solve, spd_solve
 
-    if jax.default_backend() != "tpu":
+    # first device contact happens here — bound it like everything else
+    backend, exc, hung = _run_bounded(jax.default_backend,
+                                      PER_PAIR_TIMEOUT_S)
+    if hung:
+        _hard_exit(3, f"HANG backend init: no answer in "
+                      f"{PER_PAIR_TIMEOUT_S:.0f}s — tunnel wedged")
+    if exc is not None:
+        print(f"FAIL backend init: {type(exc).__name__}: {exc}")
+        return 4
+    if backend != "tpu":
         print("not a TPU backend — probe is for the real chip; "
               "CPU equivalence is covered by tests/test_solve.py")
-        return 1
+        return 4
 
     ks = [int(k) for k in bucket_lengths(rank * 4) if k <= rank] + [rank]
     solvers = ["cg_pallas", "chol_pallas", "schulz_pallas"]
@@ -42,33 +114,81 @@ def main(rank: int = 200) -> int:
     failures = []
     for k in sorted(set(ks)):
         m = rng.standard_normal((64, k, k)).astype(np.float32)
-        A = jnp.asarray(m @ m.transpose(0, 2, 1)
-                        + 0.5 * k * np.eye(k, dtype=np.float32))
-        b = jnp.asarray(rng.standard_normal((64, k)).astype(np.float32))
-        ref = np.asarray(cholesky_solve(A, b))
+
+        def make_ref(m=m, k=k):
+            # uploads + LAPACK-reference solve go through the device
+            # too — bound them like the probed solves, or a wedge here
+            # would sit silent until the shell's outer timeout
+            A = jnp.asarray(m @ m.transpose(0, 2, 1)
+                            + 0.5 * k * np.eye(k, dtype=np.float32))
+            b = jnp.asarray(
+                rng.standard_normal((64, k)).astype(np.float32))
+            return A, b, np.asarray(cholesky_solve(A, b))
+
+        _check_deadline()
+        made, exc, hung = _run_bounded(make_ref, PER_PAIR_TIMEOUT_S)
+        if hung:
+            _hard_exit(3, f"HANG reference solve K={k}: no answer in "
+                          f"{PER_PAIR_TIMEOUT_S:.0f}s — tunnel wedged, "
+                          "aborting probe (re-run when it answers)")
+        if exc is not None:
+            print(f"FAIL reference solve K={k}: {type(exc).__name__}: "
+                  f"{str(exc)[:200]} — environment/backend problem",
+                  flush=True)
+            return 4
+        A, b, ref = made
         scale = np.maximum(np.abs(ref).max(), 1e-6)
         for s in solvers:
-            try:
-                # cg's iteration budget tracks K; the schulz solvers
-                # keep their production default (18 Newton-Schulz steps)
-                it = k + 8 if s.startswith("cg") else None
-                got = np.asarray(spd_solve(A, b, method=s, iters=it))
-                err = float(np.abs(got - ref).max() / scale)
-                ok = err < 5e-3
-            except Exception as e:  # Mosaic/compile error — the target
+            # cg's iteration budget tracks K; the schulz solvers
+            # keep their production default (18 Newton-Schulz steps)
+            it = k + 8 if s.startswith("cg") else None
+            _check_deadline()
+            got, exc, hung = _run_bounded(
+                lambda: np.asarray(spd_solve(A, b, method=s, iters=it)),
+                PER_PAIR_TIMEOUT_S)
+            if hung:
+                # one wedged RPC blocks the device queue — every later
+                # pair would hang too; bail with the wedge diagnosis
+                _hard_exit(3, f"HANG {s} K={k}: no answer in "
+                              f"{PER_PAIR_TIMEOUT_S:.0f}s — tunnel "
+                              "wedged, aborting probe (re-run when it "
+                              "answers)")
+            if exc is not None:  # Mosaic/compile error — the target
                 err, ok = None, False
-                print(f"FAIL {s} K={k}: {type(e).__name__}: "
-                      f"{str(e)[:200]}", flush=True)
+                print(f"FAIL {s} K={k}: {type(exc).__name__}: "
+                      f"{str(exc)[:200]}", flush=True)
+            else:
+                try:
+                    err = float(np.abs(got - ref).max() / scale)
+                    ok = err < 5e-3
+                except Exception as ce:  # e.g. wrong output shape —
+                    err, ok = None, False  # still a (solver, K) failure
+                    print(f"FAIL {s} K={k}: result comparison "
+                          f"{type(ce).__name__}: {str(ce)[:200]}",
+                          flush=True)
             if not ok:
                 failures.append((s, k, err))
             else:
                 print(f"ok   {s} K={k} relerr={err:.2e}", flush=True)
     if failures:
+        prod = [f for f in failures if f[0] in PRODUCTION_SOLVERS]
         print(f"FAILURES: {failures}")
-        return 1
+        if prod:
+            print(f"production solver failed: {sorted({f[0] for f in prod})}")
+            return 1
+        print("candidate solvers only — headline bench unaffected, "
+              "their ablation rows will fail-soft")
+        return 2
     print("all solver/K pairs pass")
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 200))
+    try:
+        rc = main(int(sys.argv[1]) if len(sys.argv) > 1 else 200)
+    except Exception as e:  # env problem — don't masquerade as rc=1
+        import traceback
+        traceback.print_exc()
+        print(f"probe environment failure: {type(e).__name__}: {e}")
+        rc = 4
+    sys.exit(rc)
