@@ -190,6 +190,7 @@ def bench_als(full_scale: bool):
         n_users, n_items, nnz, rank = 20_000, 4_000, 1_200_000, 32
         iters_timed = 4
 
+    _beat("bench_als: datagen")
     t0 = time.perf_counter()
     ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
     ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
@@ -208,6 +209,7 @@ def bench_als(full_scale: bool):
                     solver=resolve_solver("auto", mesh.n_devices))
 
     # host prep + one-time HBM residency for the solve plans
+    _beat("bench_als: prep/upload")
     t0 = time.perf_counter()
     run = prepare_als_run(mesh, ratings, cfg, seed=cfg.seed)
     user_plan, item_plan = run["user_plan"], run["item_plan"]
@@ -229,11 +231,14 @@ def bench_als(full_scale: bool):
         return time.perf_counter() - t0
 
     # warmup compiles the two sweep programs (one per side)
+    _beat("bench_als: warmup compile")
     warm_s = run_iters(1)
 
     # scaling check: doubled work must take ~2x wall-clock, else the timer
     # is not measuring execution and the run is invalid
+    _beat("bench_als: timed iterations (half)")
     t_half = run_iters(max(1, iters_timed // 2))
+    _beat("bench_als: timed iterations (full)")
     t_full = run_iters(iters_timed)
     best = t_full / iters_timed
     scale_ratio = t_full / t_half / (iters_timed / max(1, iters_timed // 2))
@@ -258,6 +263,14 @@ def bench_als(full_scale: bool):
             f"ratio {scale_ratio:.2f} (want ~1.0) — refusing to report a "
             f"non-physical number")
     ratings_per_sec = ratings.nnz / best
+    # the SELF-VALIDATED train timing enters the salvage partial here —
+    # a wedge during the model fetch / rmse below must not discard it
+    # (and a number that failed validation must never enter it)
+    _beat("bench_als: model fetch + rmse sample",
+          train_s_per_iteration=round(best, 4),
+          ratings_per_sec_per_chip=round(ratings_per_sec, 1),
+          scale_check_ratio=round(scale_ratio, 3),
+          warmup_s=round(warm_s, 3), nnz=ratings.nnz, rank=rank)
 
     model = ALSModel(np.asarray(U)[:n_users], np.asarray(V)[:n_items], rank)
     # sanity: the factorization actually fits the data
@@ -547,6 +560,7 @@ def bench_product_path(full_scale: bool):
         ev.init(app_id)
 
         ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
+        _beat("bench_product_path: populate")
         t0 = time.perf_counter()
         if backend == "nativelog":
             # bulk import straight through the C appender (the analog of
@@ -557,6 +571,8 @@ def bench_product_path(full_scale: bool):
             handles = [ev._handle_of(app_id, None, p)[0] for p in range(P)]
             name_hash = lib.el_hash(b"rate", 4)
             for j, (u, it, v) in enumerate(zip(ui, ii, vv)):
+                if j % 500_000 == 0:  # populate is minutes of host loop
+                    _beat(f"bench_product_path: populate row {j}")
                 ent = b"user\x00u%d" % u
                 tgt = b"item\x00i%d" % it
                 eid = b"e%d" % j
@@ -599,20 +615,27 @@ def bench_product_path(full_scale: bool):
 
         ds = R.RecommendationDataSource(
             R.DataSourceParams(app_name="benchapp"))
+        _beat("bench_product_path: datasource read")
         t0 = time.perf_counter()
         td = ds.read_training()
         read_s = time.perf_counter() - t0
 
         prep = R.RecommendationPreparator()
+        _beat("bench_product_path: prepare")
         t0 = time.perf_counter()
         pd = prep.prepare(td)
         prepare_s = time.perf_counter() - t0
 
         algo = R.ALSAlgorithm(R.ALSAlgorithmParams(
             rank=rank, num_iterations=iters, lam=0.05, seed=1))
+        _beat("bench_product_path: cold train")
         t0 = time.perf_counter()
         algo.train(pd)
         train_s = time.perf_counter() - t0
+        _beat("bench_product_path: warm train",
+              product_read_s=round(read_s, 3),
+              product_prepare_s=round(prepare_s, 3),
+              product_train_s=round(train_s, 3))
 
         # warm re-train: same shapes, compiled programs now cached — the
         # total cost of an operator retrain (plan build + upload + iters).
@@ -1033,15 +1056,79 @@ def device_alive(timeout_s: float = 240.0):
     return result[0] if result else None
 
 
-def _emit_error(msg: str, code: int = 1):
+def _emit_error(msg: str, code: int = 1, partial: dict | None = None):
     """The harness contract is ONE parseable JSON line even on failure;
     flush before os._exit (which skips buffer flushing) so a piped
-    driver actually receives it."""
-    print(json.dumps({
+    driver actually receives it. Completed stages ride along in
+    `partial` — a wedge during the serve sweep must not discard an
+    already-captured train measurement."""
+    out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
         "value": 0, "unit": "ratings/s/chip", "vs_baseline": 0,
-        "error": msg}), flush=True)
+        "error": msg}
+    if partial:
+        out.update(partial)
+        v = partial.get("ratings_per_sec_per_chip")
+        if v:
+            out["value"] = round(v, 1)
+            out["vs_baseline"] = round(
+                v / SPARK_CPU_BASELINE_RATINGS_PER_SEC, 3)
+    print(json.dumps(out), flush=True)
     os._exit(code)
+
+
+# Mid-run wedge watchdog: device_alive() only protects the START of the
+# run, but the tunnel has been observed to answer a probe and wedge
+# minutes later, which would hang the driver's round-end invocation with
+# no JSON line at all. Each top-level stage beats the heart, and the
+# long stages (bench_als, bench_product_path) beat per substage — per
+# compile, per timed-iteration block, per 500k populate rows — so the
+# deadline bounds a single device interaction or host chunk, not a
+# whole multi-minute stage. A stall emits everything measured so far
+# plus the diagnosis. 1500 s comfortably covers the longest legitimate
+# gap between beats (a full-scale XLA compile of the fused iteration,
+# minutes) while bounding the driver's wait.
+_STALL_DEADLINE_S = float(os.environ.get("PIO_BENCH_STALL_S", "1500"))
+_heartbeat = {"t": time.monotonic(), "stage": "init", "partial": {}}
+
+
+def _beat(stage: str, **done):
+    """Mark entry to `stage`; record completed-stage results in
+    `done` so a later stall still reports them."""
+    _heartbeat["t"] = time.monotonic()
+    _heartbeat["stage"] = stage
+    _heartbeat["partial"].update(
+        {k: v for k, v in done.items() if v is not None})
+
+
+def _start_stall_watchdog(emit_json: bool = True,
+                          stall_payload: dict | None = None):
+    """emit_json: the headline bench owes the driver its one-JSON-line
+    contract even on stall. stall_payload: JSON-artifact entry points
+    (--mesh-sweep) keep their file parseable by emitting this dict plus
+    the error and any completed rows. Neither: text-mode (--ablation)
+    just needs a diagnosis line and a nonzero exit."""
+    def watch():
+        while True:
+            time.sleep(15)
+            stalled = time.monotonic() - _heartbeat["t"]
+            if stalled > _STALL_DEADLINE_S:
+                msg = (f"stalled {stalled:.0f}s in stage "
+                       f"'{_heartbeat['stage']}' — tunnel wedged "
+                       "mid-run; completed stages included")
+                if emit_json:
+                    _emit_error(msg, code=2,
+                                partial=_heartbeat["partial"])
+                if stall_payload is not None:
+                    print(json.dumps({**stall_payload,
+                                      **_heartbeat["partial"],
+                                      "error": msg}), flush=True)
+                else:
+                    print(f"STALLED: {msg}", flush=True)
+                sys.stdout.flush()
+                os._exit(2)
+
+    threading.Thread(target=watch, daemon=True).start()
 
 
 def main():
@@ -1068,15 +1155,23 @@ def main():
         sys.stdout.flush()
         os._exit(rc)
     full_scale = backend not in ("cpu",)
+    _start_stall_watchdog()
+    _beat("bench_als", backend=backend, full_scale=full_scale)
     als_stats, model = bench_als(full_scale)
+    _beat("bench_rest_latency",
+          **{k: (round(v, 4) if isinstance(v, float) else v)
+             for k, v in als_stats.items()})
     rest_stats = bench_rest_latency(model)
     rest_stats.update(measure_d2h_floor_ms())
     # micro-batch coalescing-window sweep: the datum for choosing the
     # micro_batch_wait_ms default (serial p50 pays the window when idle,
     # concurrent throughput gains from coalescing — both reported)
+    _beat("serve_sweep",
+          **{k: round(v, 3) for k, v in rest_stats.items()})
     serve_sweep = {}
     if not os.environ.get("PIO_BENCH_SKIP_SERVE_SWEEP"):
         for w in (2.0, 5.0, 10.0):
+            _beat(f"serve_sweep wait={w:g}")
             s = bench_rest_latency(model, n_queries=100, wait_ms=w)
             serve_sweep[f"{w:g}"] = {
                 "p50_ms": round(s["p50_ms"], 3),
@@ -1087,15 +1182,25 @@ def main():
                 "qps_concurrent16_max": round(
                     s["qps_concurrent16_max"], 1),
                 "avg_batch": round(s["serve_avg_batch_size"], 2)}
+            # snapshot completed sweep points — a stall at the next
+            # window must not lose the finished rows
+            _beat(f"serve_sweep wait={w:g} done",
+                  serve_wait_sweep_ms=dict(serve_sweep))
     product_stats = {}
     if not os.environ.get("PIO_BENCH_SKIP_PRODUCT"):
+        _beat("bench_product_path")
         product_stats = bench_product_path(full_scale)
+    _beat("product done", **product_stats)
     baseline_stats = {}
     if not os.environ.get("PIO_BENCH_SKIP_BASELINE"):
+        _beat("mllib_shaped_cpu_baseline")
         baseline_stats = mllib_shaped_cpu_baseline(full_scale)
+    _beat("baseline done", **baseline_stats)
     ingest_stats = {}
     if not os.environ.get("PIO_BENCH_SKIP_INGEST"):
+        _beat("bench_ingest")
         ingest_stats = bench_ingest(full_scale)
+    _beat("assemble_output", **ingest_stats)
     value = als_stats["ratings_per_sec_per_chip"]
     out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
@@ -1218,9 +1323,12 @@ def solver_ablation():
             uploads[chunk] = (A._upload_plan(mesh, user_plan, chunk),
                               A._upload_plan(mesh, item_plan, chunk))
         return uploads[chunk]
+    _start_stall_watchdog(emit_json=False)   # before any device upload
+    _beat("ablation: replicate scalars")
     lam = mesh.put_replicated(np.float32(0.05))
     alpha = mesh.put_replicated(np.float32(1.0))
     for name, kw in configs:
+        _beat(f"ablation: {name}")
         cfg = ALSConfig(rank=rank, iterations=1, lam=0.05, seed=1,
                         compute_dtype=("bfloat16" if full else "float32"),
                         work_budget=(1 << 20), **kw)
@@ -1297,7 +1405,13 @@ def mesh_sweep():
 
     devices = jax.devices()
     rows = []
+    _start_stall_watchdog(
+        emit_json=False,
+        stall_payload={"metric": "als_mesh_weak_scaling",
+                       "backend": jax.default_backend(),
+                       "full_scale": full})
     for n in sorted({1, len(devices)}):
+        _beat(f"mesh_sweep n_devices={n}", rows=list(rows))
         mesh = make_mesh(devices=devices[:n])
         cfg = ALSConfig(rank=rank, iterations=1, lam=0.05, seed=1,
                         compute_dtype=("bfloat16" if full else "float32"),
@@ -1496,4 +1610,7 @@ if __name__ == "__main__":
     try:
         main()
     except Exception as e:  # emit a parseable line even on env failure
-        _emit_error(f"{type(e).__name__}: {e}")
+        # completed-stage results ride along: a raise during the serve
+        # phase must not discard an already-captured train measurement
+        _emit_error(f"{type(e).__name__}: {e}",
+                    partial=_heartbeat["partial"])

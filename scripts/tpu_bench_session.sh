@@ -31,6 +31,7 @@ probe_rc=0
 # so worst case is 2700 + 180 + slack — 3600 is a true backstop
 timeout 3600 python scripts/tpu_kernel_probe.py 200 \
     > "$OUT/kernel_probe.txt" 2>&1 || probe_rc=$?
+echo "$probe_rc" > "$OUT/probe_rc"   # watcher reads the failure class
 if [ "$probe_rc" -eq 2 ] \
         && grep -q "candidate solvers only" "$OUT/kernel_probe.txt"; then
     # sentinel guard: bare rc=2 is also CPython's can't-start status
@@ -48,21 +49,35 @@ elif [ "$probe_rc" -ne 0 ]; then
 fi
 tail -3 "$OUT/kernel_probe.txt"
 echo "== bench (headline + roofline + serve sweep) -> $OUT/bench.json =="
-if ! python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
-    echo "BENCH FAILED (rc != 0) — bench.json is an error line, do NOT"
-    echo "copy it over the round's BENCH_r<N>.json; tail of stderr:"
+# bench.py self-bounds via its stall watchdog (PIO_BENCH_STALL_S, 1500s
+# per stage, partial results emitted on stall) — these are backstops
+bench_rc=0
+timeout 7200 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err" \
+    || bench_rc=$?
+if [ "$bench_rc" -eq 2 ] && grep -q "stalled" "$OUT/bench.json"; then
+    # sentinel guard: bare rc=2 is also CPython's can't-start status
+    echo "BENCH STALLED MID-RUN (rc=2) — bench.json carries the"
+    echo "completed-stage measurements plus an 'error' stall diagnosis."
+    echo "SALVAGE the completed numbers (train row especially) — do not"
+    echo "discard, but do not present it as a full headline run either."
+    rc=1
+elif [ "$bench_rc" -ne 0 ]; then
+    echo "BENCH FAILED (rc=$bench_rc) — bench.json holds a parseable"
+    echo "error line UNLESS the outer timeout killed it (rc=124/137:"
+    echo "file may be empty). Do NOT copy it over the round's"
+    echo "BENCH_r<N>.json; tail of stderr:"
     tail -c 1000 "$OUT/bench.err"
     rc=1
 fi
 tail -c 2000 "$OUT/bench.json"; echo
 echo "== ablation -> $OUT/ablation.txt =="
-if ! python bench.py --ablation > "$OUT/ablation.txt" 2>&1; then
+if ! timeout 7200 python bench.py --ablation > "$OUT/ablation.txt" 2>&1; then
     echo "ABLATION FAILED (rc != 0)"
     rc=1
 fi
 cat "$OUT/ablation.txt"
 echo "== mesh sweep (1 chip vs slice) -> $OUT/mesh_sweep.json =="
-if ! python bench.py --mesh-sweep > "$OUT/mesh_sweep.json" \
+if ! timeout 3600 python bench.py --mesh-sweep > "$OUT/mesh_sweep.json" \
         2> "$OUT/mesh_sweep.err"; then
     echo "MESH SWEEP FAILED (rc != 0; single-chip tunnel still emits the"
     echo "1-device row — a real failure means the device hung)"

@@ -34,9 +34,6 @@ import time
 
 import numpy as np
 
-# resolve_solver('auto') on a single TPU chip — the solver the headline
-# bench actually runs; chol/schulz are ablation candidates only
-PRODUCTION_SOLVERS = {"cg_pallas"}
 PER_PAIR_TIMEOUT_S = 180.0
 # healthy pairs answer in ~5-20 s; the whole ladder finishes well under
 # this. Checked between bounded ops so worst case is DEADLINE + one
@@ -92,7 +89,8 @@ def main(rank: int = 200) -> int:
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from predictionio_tpu.ops.ratings import bucket_lengths
-    from predictionio_tpu.ops.solve import cholesky_solve, spd_solve
+    from predictionio_tpu.ops.solve import (cholesky_solve,
+                                            resolve_solver, spd_solve)
 
     # first device contact happens here — bound it like everything else
     backend, exc, hung = _run_bounded(jax.default_backend,
@@ -110,6 +108,14 @@ def main(rank: int = 200) -> int:
 
     ks = [int(k) for k in bucket_lengths(rank * 4) if k <= rank] + [rank]
     solvers = ["cg_pallas", "chol_pallas", "schulz_pallas"]
+    # what the headline bench actually runs on this box — derived (same
+    # n_devices the bench's mesh will see), not hard-coded, so the
+    # rc=1-vs-2 verdict tracks solver-selection changes (e.g.
+    # chol_pallas winning the ablation and becoming auto, or a
+    # multi-chip slice resolving to the jnp cg form)
+    production_solvers = {resolve_solver("auto", jax.device_count())}
+    if not production_solvers & set(solvers):
+        solvers.insert(0, next(iter(production_solvers)))
     rng = np.random.default_rng(0)
     failures = []
     for k in sorted(set(ks)):
@@ -171,7 +177,7 @@ def main(rank: int = 200) -> int:
             else:
                 print(f"ok   {s} K={k} relerr={err:.2e}", flush=True)
     if failures:
-        prod = [f for f in failures if f[0] in PRODUCTION_SOLVERS]
+        prod = [f for f in failures if f[0] in production_solvers]
         print(f"FAILURES: {failures}")
         if prod:
             print(f"production solver failed: {sorted({f[0] for f in prod})}")
