@@ -1,14 +1,29 @@
 #!/usr/bin/env bash
-# The full measurement session to run, IN ORDER, the moment the TPU
-# tunnel answers — on an IDLE box (no concurrent pytest/build: host
-# contention poisons the numbers; see docs/benchmarks.md).
+# The full measurement session to run the moment the TPU tunnel
+# answers — on an IDLE box (no concurrent pytest/build: host contention
+# poisons the numbers; see docs/benchmarks.md).
 #
 #   bash scripts/tpu_bench_session.sh [outdir]
 #
+# Phase ORDER is sized to the tunnel's observed failure mode (long
+# outages, live windows as short as ~3 minutes — round 5 first
+# contact): the HEADLINE BENCH runs FIRST, because its train number is
+# the four-round-overdue artifact, it self-validates (physicality
+# check), its stall watchdog salvages completed stages if the tunnel
+# wedges mid-run, and the production solver is already
+# hardware-validated at the small ladder K (TPU_PROBE_r05.md) — while
+# the full kernel probe alone can outlast a short window. The probe
+# (full ladder, all solvers), ablation, and mesh sweep follow, each
+# banking XLA compiles into the persistent cache
+# (~/.cache/pio_tpu/xla) so any window they DO complete in makes the
+# next window cheaper.
+#
 # Outputs land unpiped (tail-buffering hides progress otherwise) in
 # <outdir> (default /tmp/tpu_session_<ts>):
-#   bench.json       — headline line (roofline_fraction, serve wait sweep)
+#   bench.json       — headline line (roofline_fraction, serve sweep)
+#   kernel_probe.txt — per-(solver, K) Mosaic validation vs LAPACK
 #   ablation.txt     — solver/chunk/fusion/cholesky configuration matrix
+#   mesh_sweep.json  — 1-chip vs slice weak scaling
 # Afterwards: update docs/benchmarks.md ("Pending on hardware" section)
 # from these files, copy bench.json over the CURRENT round's
 # BENCH_r<N>.json if the driver hasn't, and flip resolve_sweep_chunk /
@@ -23,34 +38,9 @@ if ! timeout 90 python -c "import jax; d=jax.devices(); print(d); import sys; sy
     exit 1
 fi
 rc=0
-echo "== kernel-shape probe (new ladder K values vs Mosaic) =="
-probe_rc=0
-# every device interaction inside the probe self-bounds at 180s (rc=3
-# hard-exit on the first hang, including backend init and the reference
-# solves) and the probe holds itself to a 2700s global deadline (rc=5),
-# so worst case is 2700 + 180 + slack — 3600 is a true backstop
-timeout 3600 python scripts/tpu_kernel_probe.py 200 \
-    > "$OUT/kernel_probe.txt" 2>&1 || probe_rc=$?
-echo "$probe_rc" > "$OUT/probe_rc"   # watcher reads the failure class
-if [ "$probe_rc" -eq 2 ] \
-        && grep -q "candidate solvers only" "$OUT/kernel_probe.txt"; then
-    # sentinel guard: bare rc=2 is also CPython's can't-start status
-    echo "probe: CANDIDATE solver(s) failed — their ablation rows will"
-    echo "fail-soft; the headline bench (production solver) proceeds:"
-    grep "^FAIL" "$OUT/kernel_probe.txt" | head -5
-elif [ "$probe_rc" -ne 0 ]; then
-    echo "KERNEL PROBE FAILED (rc=$probe_rc) — production solver broke"
-    echo "(rc=1), tunnel wedged mid-probe (rc=3), environment problem"
-    echo "(rc=4), tunnel degraded past the global deadline (rc=5), or"
-    echo "outer-timeout backstop (rc=124); fix/re-probe BEFORE burning"
-    echo "bench time:"
-    tail -20 "$OUT/kernel_probe.txt"
-    exit 1
-fi
-tail -3 "$OUT/kernel_probe.txt"
 echo "== bench (headline + roofline + serve sweep) -> $OUT/bench.json =="
 # bench.py self-bounds via its stall watchdog (PIO_BENCH_STALL_S, 1500s
-# per stage, partial results emitted on stall) — these are backstops
+# per substage, partial results emitted on stall) — these are backstops
 bench_rc=0
 timeout 7200 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err" \
     || bench_rc=$?
@@ -70,6 +60,33 @@ elif [ "$bench_rc" -ne 0 ]; then
     rc=1
 fi
 tail -c 2000 "$OUT/bench.json"; echo
+echo "== kernel-shape probe (full ladder vs Mosaic) =="
+probe_rc=0
+# every device interaction inside the probe self-bounds at 180s (rc=3
+# hard-exit on the first hang, including backend init and the reference
+# solves) and the probe holds itself to a 2700s global deadline (rc=5),
+# so worst case is 2700 + 180 + slack — 3600 is a true backstop
+timeout 3600 python scripts/tpu_kernel_probe.py 200 \
+    > "$OUT/kernel_probe.txt" 2>&1 || probe_rc=$?
+echo "$probe_rc" > "$OUT/probe_rc"   # watcher reads the failure class
+tail -3 "$OUT/kernel_probe.txt"
+if [ "$probe_rc" -eq 2 ] \
+        && grep -q "candidate solvers only" "$OUT/kernel_probe.txt"; then
+    # sentinel guard: bare rc=2 is also CPython's can't-start status
+    echo "probe: CANDIDATE solver(s) failed — their ablation rows will"
+    echo "fail-soft; continuing to the ablation:"
+    grep "^FAIL" "$OUT/kernel_probe.txt" | head -5
+elif [ "$probe_rc" -ne 0 ]; then
+    echo "KERNEL PROBE FAILED (rc=$probe_rc) — production solver broke"
+    echo "(rc=1), tunnel wedged mid-probe (rc=3), environment problem"
+    echo "(rc=4), tunnel degraded past the global deadline (rc=5), or"
+    echo "outer-timeout backstop (rc=124). The headline bench above"
+    echo "already ran; skipping ablation + mesh sweep (a wedged tunnel"
+    echo "will not answer them):"
+    tail -10 "$OUT/kernel_probe.txt"
+    echo "== done (probe-gated): $OUT (rc=1) =="
+    exit 1
+fi
 echo "== ablation -> $OUT/ablation.txt =="
 if ! timeout 7200 python bench.py --ablation > "$OUT/ablation.txt" 2>&1; then
     echo "ABLATION FAILED (rc != 0)"

@@ -34,6 +34,45 @@ while true; do
         bash scripts/tpu_bench_session.sh "$SESS" \
             > "$SESS.console.log" 2>&1 || rc=$?
         rm -f "$FLAG"
+        # bank tpu-stamped headline jsons in the repo root, even from a
+        # failed/stalled session: a salvaged train row from a short
+        # window is the artifact four rounds waited for (builder
+        # reviews + commits it; the copy itself is not a git write).
+        # Tiers keep 'latest' meaning 'clean': error-free runs ->
+        # _latest; stalls with a real train value -> _partial; value-0
+        # stubs are not banked; a probe that later failed the
+        # production solver (rc=1) quarantines the capture as _suspect
+        # since its numbers came from a kernel that failed validation
+        if [ -f "$SESS/bench.json" ] \
+                && grep -q '"backend": "tpu"' "$SESS/bench.json"; then
+            tier=$(python - "$SESS/bench.json" <<'PYEOF'
+import json, sys
+try:
+    d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+except Exception:
+    print("skip"); raise SystemExit
+if d.get("error") and not d.get("value"):
+    print("skip")
+elif d.get("error"):
+    print("partial")
+else:
+    print("latest")
+PYEOF
+)
+            sess_probe_rc=$(cat "$SESS/probe_rc" 2>/dev/null || echo "")
+            case "$tier" in
+                latest|partial)
+                    if [ "$sess_probe_rc" = "1" ]; then tier=suspect; fi
+                    cp "$SESS/bench.json" "TPU_BENCH_CAPTURE_$tier.json"
+                    log "tpu-stamped bench.json ($tier) banked -> TPU_BENCH_CAPTURE_$tier.json"
+                    ;;
+                *)  # 'skip', or a failed tier substitution (empty)
+                    log "tpu-stamped bench.json not banked (value-0" \
+                        "stub, unparseable json, or tier-check" \
+                        "failure: '$tier') — see $SESS"
+                    ;;
+            esac
+        fi
         if [ "$rc" -eq 0 ]; then
             log "session SUCCEEDED -> $SESS"
             echo "$SESS" > "$WATCH/SUCCESS"
@@ -41,6 +80,7 @@ while true; do
         fi
         log "session failed rc=$rc (tail of $SESS.console.log follows)"
         tail -5 "$SESS.console.log" >> "$WATCH/watch.log"
+        sess_probe_rc=$(cat "$SESS/probe_rc" 2>/dev/null || echo "")
         # a broken production solver (probe rc=1) is deterministic code
         # breakage — retrying hot-loops the tunnel's scarce uptime.
         # rc=4 ("environment") stays in the retry loop: a tunnel that
@@ -48,8 +88,7 @@ while true; do
         # exception -> rc=4, and abandoning the watch on a flaky window
         # would defeat its purpose; the attempt cap bounds true env
         # breakage instead
-        probe_rc=$(cat "$SESS/probe_rc" 2>/dev/null || echo "")
-        if [ "$probe_rc" = "1" ]; then
+        if [ "$sess_probe_rc" = "1" ]; then
             log "deterministic failure (probe rc=1: production solver"
             log "broken) — stopping; fix the code, restart the watcher"
             echo "$SESS" > "$WATCH/DETERMINISTIC_FAILURE"
