@@ -1089,6 +1089,7 @@ def _emit_error(msg: str, code: int = 1, partial: dict | None = None):
 # gap between beats (a full-scale XLA compile of the fused iteration,
 # minutes) while bounding the driver's wait.
 _STALL_DEADLINE_S = float(os.environ.get("PIO_BENCH_STALL_S", "1500"))
+_STALL_POLL_S = 15.0
 _heartbeat = {"t": time.monotonic(), "stage": "init", "partial": {}}
 
 
@@ -1110,7 +1111,7 @@ def _start_stall_watchdog(emit_json: bool = True,
     just needs a diagnosis line and a nonzero exit."""
     def watch():
         while True:
-            time.sleep(15)
+            time.sleep(_STALL_POLL_S)
             stalled = time.monotonic() - _heartbeat["t"]
             if stalled > _STALL_DEADLINE_S:
                 msg = (f"stalled {stalled:.0f}s in stage "

@@ -4,6 +4,8 @@ job and the pooled MLlib-shaped sweep are artifact-producing code paths
 imports. Toy sizes only — the committed artifacts use the real ones."""
 
 import json
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -54,3 +56,67 @@ class TestMathParityHarness:
         # the held-out RMSEs must be in the same ballpark even at toy
         # scale; rc encodes the tolerance verdict
         assert rc == 0 and d["parity_ok"] is True
+
+
+class TestStallSalvage:
+    """The mid-run wedge watchdog must preserve completed-stage
+    measurements (the train row especially) in its one-JSON-line
+    emission — a tunnel that wedges during the serve phase must not
+    discard an already-captured train number."""
+
+    def test_beat_records_and_filters_none(self):
+        bench._heartbeat["partial"].clear()
+        bench._beat("s1", a=1.5, b=None, c="x")
+        assert bench._heartbeat["stage"] == "s1"
+        assert bench._heartbeat["partial"] == {"a": 1.5, "c": "x"}
+        bench._beat("s2", d=2)
+        assert bench._heartbeat["partial"] == {"a": 1.5, "c": "x",
+                                               "d": 2}
+        bench._heartbeat["partial"].clear()
+
+    def test_emit_error_promotes_salvaged_train_value(self):
+        """_emit_error os._exit()s, so drive it in a subprocess: with a
+        salvaged ratings_per_sec_per_chip in the partial, value and
+        vs_baseline must reflect the real measurement, not 0."""
+        code = (
+            "import bench\n"
+            "bench._emit_error('boom', code=3, partial={"
+            "'ratings_per_sec_per_chip': 5e6, 'backend': 'tpu'})\n")
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 3
+        d = json.loads(p.stdout.strip().splitlines()[-1])
+        assert d["error"] == "boom"
+        assert d["value"] == 5e6
+        assert d["backend"] == "tpu"
+        assert d["vs_baseline"] == pytest.approx(
+            5e6 / bench.SPARK_CPU_BASELINE_RATINGS_PER_SEC, rel=1e-6)
+
+    def test_emit_error_without_partial_reports_zero(self):
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import bench\nbench._emit_error('dead')\n"],
+            capture_output=True, text=True, timeout=120)
+        assert p.returncode == 1
+        d = json.loads(p.stdout.strip().splitlines()[-1])
+        assert d["value"] == 0 and d["error"] == "dead"
+
+    def test_stall_watchdog_fires_and_salvages(self):
+        """End-to-end: a bench whose first device stage hangs past the
+        deadline must exit 2 with a JSON line carrying the stall stage
+        and any prior beats (exercised CPU-side via a tiny deadline and
+        a sleeping stage)."""
+        code = (
+            "import time, bench\n"
+            "bench._STALL_DEADLINE_S = 0.2\n"
+            "bench._STALL_POLL_S = 0.1\n"
+            "bench._beat('unit: completed', done_metric=7.25)\n"
+            "bench._beat('unit: hanging stage')\n"
+            "bench._start_stall_watchdog()\n"
+            "time.sleep(60)\n")
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 2
+        d = json.loads(p.stdout.strip().splitlines()[-1])
+        assert "unit: hanging stage" in d["error"]
+        assert d["done_metric"] == 7.25
