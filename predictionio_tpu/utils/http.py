@@ -23,6 +23,51 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 
+class Headers(dict):
+    """Case-insensitive header mapping (RFC 9110 §5.1: field names are
+    case-insensitive; a client sending ``authorization:`` must match a
+    handler's ``.get("Authorization")``). Keys are stored lower-cased
+    and every access path folds its probe key, so mutation and copying
+    preserve the invariant."""
+
+    def __init__(self, items=()):
+        if hasattr(items, "items"):
+            items = items.items()
+        super().__init__((k.lower(), v) for k, v in items)
+
+    def get(self, key, default=None):
+        return super().get(key.lower(), default)
+
+    def __getitem__(self, key):
+        return super().__getitem__(key.lower())
+
+    def __contains__(self, key):
+        return super().__contains__(key.lower())
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key.lower(), value)
+
+    def __delitem__(self, key):
+        super().__delitem__(key.lower())
+
+    def pop(self, key, *default):
+        return super().pop(key.lower(), *default)
+
+    def setdefault(self, key, default=None):
+        return super().setdefault(key.lower(), default)
+
+    def update(self, items=(), **kw):
+        if hasattr(items, "items"):
+            items = items.items()
+        for k, v in items:
+            self[k] = v
+        for k, v in kw.items():
+            self[k] = v
+
+    def copy(self):
+        return Headers(self)
+
+
 @dataclass
 class Request:
     method: str
@@ -149,7 +194,7 @@ class HttpServer:
                 body = self.rfile.read(length) if length else b""
                 req = Request(method=self.command, path=parsed.path,
                               params=params,
-                              headers={k: v for k, v in self.headers.items()},
+                              headers=Headers(self.headers.items()),
                               body=body)
                 try:
                     resp = router.dispatch(req)

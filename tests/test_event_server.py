@@ -82,6 +82,11 @@ class TestEventCRUD:
         status, _ = call(p, "POST", "/events.json", EVENT,
                          {"Authorization": f"Basic {auth}"})
         assert status == 201
+        # header names are case-insensitive (RFC 9110 §5.1): a client
+        # sending lowercase `authorization:` must authenticate too
+        status, _ = call(p, "POST", "/events.json", EVENT,
+                         {"authorization": f"Basic {auth}"})
+        assert status == 201
 
     def test_event_whitelist(self, server):
         p = server.config.port

@@ -655,3 +655,33 @@ class TestStopLatch:
             assert b"ok" in body
         finally:
             s.stop()
+
+
+class TestHeaders:
+    """Case-insensitive header mapping invariants (RFC 9110 §5.1) —
+    every access path must fold the probe key, including mutation and
+    copying, so a future handler editing req.headers can't end up with
+    a mapping that passes reads and fails writes."""
+
+    def test_reads_fold_case(self):
+        from predictionio_tpu.utils.http import Headers
+        h = Headers({"Authorization": "Basic x", "TE": "trailers"})
+        assert h.get("authorization") == "Basic x"
+        assert h["te"] == "trailers"
+        assert "AUTHORIZATION" in h
+        assert Headers([("A", 1)]).get("a") == 1  # pair-iterable form
+
+    def test_mutation_and_copy_preserve_invariant(self):
+        from predictionio_tpu.utils.http import Headers
+        h = Headers({"Authorization": "Basic x"})
+        assert h.pop("AUTHORIZATION") == "Basic x"
+        assert "authorization" not in h
+        h["X-Foo"] = "y"
+        assert h.get("x-foo") == "y"
+        h.update({"Content-Type": "a"}, Accept="b")
+        assert h["content-type"] == "a" and h.get("ACCEPT") == "b"
+        c = h.copy()
+        assert isinstance(c, Headers) and c.get("X-FOO") == "y"
+        del h["x-foo"]
+        assert "X-Foo" not in h
+        assert h.setdefault("Vary", "z") == "z" and h.get("vary") == "z"
