@@ -135,7 +135,19 @@ def _dual_system_solve(M, y, K: int, solver: str):
     roundoff — capping below K would silently under-solve the larger
     power-of-two buckets); tiny systems skip the Pallas kernel, whose
     per-tile overhead dominates below 32."""
+    import jax
+    import jax.numpy as jnp
+
     from predictionio_tpu.ops.solve import spd_solve
+    if solver == "diag_nosolve":
+        # perf diagnostic, NOT a solver (wrong math by design): skip the
+        # solve but keep M alive — the dual Gram einsum is the
+        # traffic/flops being measured. The optimization_barrier stops
+        # XLA's algebraic simplifier from folding sum-of-einsum into a
+        # cheaper contraction that never materializes the Gram. Covers
+        # every dual call site (explicit Woodbury and implicit eig-SMW).
+        M_live = jax.lax.optimization_barrier(M)
+        return y + M_live.sum(axis=2) * jnp.float32(1e-12)
     method = "cg" if (K < 32 and solver == "cg_pallas") else solver
     return spd_solve(M, y, method=method, iters=K + 8)
 
@@ -170,6 +182,15 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
     eye = jnp.eye(rank, dtype=jnp.float32)
     n = mask.sum(axis=-1)                            # ratings per entity
     reg = lam * jnp.maximum(n, 1.0) if nratings_reg else jnp.full_like(n, lam)
+
+    if solver == "diag_gather":
+        # perf diagnostic, NOT a solver (wrong math by design): gather +
+        # one light K*R einsum + scatter, i.e. the sweep minus the Gram
+        # and minus the solve. Ablation rows subtract it from
+        # diag_nosolve / full rows to locate the iteration time.
+        x = jnp.einsum("bk,bkr->br", mask.astype(cd), Vc,
+                       preferred_element_type=jnp.float32)
+        return _scatter_rows(factors_out, rows, x)
 
     if dual_solve == "auto" and not implicit and K < rank:
         # dual/Woodbury: with M = mask-weighted factor rows [K, R],
@@ -240,8 +261,14 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
         b = jnp.einsum("bk,bkr->br", (val * mask).astype(cd), Vc,
                        preferred_element_type=jnp.float32)
     A = A + reg[:, None, None] * eye
-    x = spd_solve(A, b, method=solver, iters=solver_iters,
-                  compute_dtype=compute_dtype)
+    if solver == "diag_nosolve":
+        # perf diagnostic: keep A alive against algebraic simplification
+        # (see the _dual_system_solve note)
+        x = b + jax.lax.optimization_barrier(A).sum(axis=2) \
+            * jnp.float32(1e-12)
+    else:
+        x = spd_solve(A, b, method=solver, iters=solver_iters,
+                      compute_dtype=compute_dtype)
     return _scatter_rows(factors_out, rows, x)
 
 

@@ -345,6 +345,31 @@ def test_sweep_chunk_and_fused_iteration_match_baseline(implicit):
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_diag_solvers_run_and_are_finite():
+    """The ablation's stage-split diagnostics (solver='diag_gather' /
+    'diag_nosolve') are wrong-math perf probes: they must trace through
+    the production sweep machinery (dual and primal branches, chunked
+    scan) and produce finite factor tables, never NaN/inf — that is all
+    the ablation needs from them (bench.py solver_ablation)."""
+    rng = np.random.default_rng(17)
+    n_u, n_i, nnz = 400, 120, 6000
+    ui = rng.integers(0, n_u, nnz)
+    ii = rng.integers(0, n_i, nnz)
+    vv = rng.uniform(1, 5, nnz).astype(np.float32)
+    r = RatingsCOO(ui, ii, vv, n_u, n_i)
+    for solver in ("diag_gather", "diag_nosolve"):
+        # rank above and below the bucket Ks exercises both the dual
+        # (K < rank) and primal branches; implicit covers the eig-SMW
+        # dual call site too
+        for rank, implicit in ((4, False), (16, False), (16, True)):
+            m = als_train(r, ALSConfig(rank=rank, iterations=1, lam=0.05,
+                                       seed=2, work_budget=512,
+                                       sweep_chunk=2, solver=solver,
+                                       implicit_prefs=implicit))
+            assert np.isfinite(m.user_factors).all()
+            assert np.isfinite(m.item_factors).all()
+
+
 def test_train_telemetry_phases():
     """als_train(telemetry=) reports every phase with sane values and
     does not perturb the result (bench.py's product-path split)."""
