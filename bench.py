@@ -1272,6 +1272,12 @@ def solver_ablation():
             # once chunking amortizes the solver's per-call fixed cost,
             # the f32 factor-row gathers are the roofline numerator
             # (45.5 GB/iter at full scale) — bf16 tables halve it
+            # if the ~20-30 ms/solver-call fixed cost is Pallas launch
+            # overhead (prime suspect for the 24x roofline gap: ~60
+            # calls/iter across the ladder's distinct Ks), XLA-native CG
+            # dodges it at the cost of slower matvecs
+            ("cg (XLA) + dual + chunk4",
+             dict(solver="cg", dual_solve="auto", sweep_chunk=4)),
             ("cg_pallas + dual + chunk4 + bf16 tables",
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
                   factor_dtype="bfloat16")),
