@@ -1286,6 +1286,15 @@ def solver_ablation():
                   fuse_iteration=True)),
             ("cg_pallas + dual + chunk8",
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=8)),
+            # does dual-solve time scale with CG depth or is it per-call
+            # fixed? SPEED measurement only: tests/test_als.py checks
+            # RMSE-equivalence at a milder regime (rank 32, ~20% of the
+            # budget) — at rank 200 the cap trims K+8<=208 to 16 (~8%),
+            # so full-scale accuracy must be re-measured before any
+            # default flip
+            ("cg_pallas + dual + chunk4 + dualcap16",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
+                  dual_iters_cap=16)),
             ("schulz_pallas + dual + chunk4",
              dict(solver="schulz_pallas", dual_solve="auto",
                   sweep_chunk=4)),

@@ -68,6 +68,12 @@ class ALSConfig:
     # K<rank buckets are unaffected (the dual route solves a better-
     # conditioned K-dim system exactly), but large-count entities ride
     # the primal solver — raise this (or set solver='cholesky') there.
+    dual_iters_cap: Optional[int] = None  # cap on the dual CG budget
+    # None = K+8 per bucket (finite-termination bound + roundoff margin).
+    # CG converges far earlier on these well-conditioned K-dim systems;
+    # if solve time scales with the iteration count (rather than being
+    # per-call fixed), capping trades a bounded residual for wall-clock.
+    # Measured by the ablation's dualcap row before any default change.
     dual_solve: str = "auto"  # 'auto' | 'never'
     # Woodbury/dual formulation for ALS buckets whose padded segment
     # length K < rank — exact algebra replacing the rank-dim solve with a
@@ -99,6 +105,15 @@ class ALSConfig:
     # iteration, letting XLA overlap the item-side gather DMAs with the
     # tail of the user-side solves and dropping a dispatch boundary.
 
+    def __post_init__(self):
+        if self.dual_iters_cap is not None and self.dual_iters_cap < 1:
+            # reject at construction: a 0 cap would otherwise surface
+            # only when (and if) some bucket takes the dual route, mid-
+            # training from inside a jitted trace — or never, falling
+            # into spd_solve's `iters or 48` unset-default
+            raise ValueError("dual_iters_cap must be >= 1, got "
+                             f"{self.dual_iters_cap}")
+
 
 def default_compute_dtype() -> str:
     """bf16 Gram einsums on TPU (MXU-native, f32 accumulation), f32 on
@@ -128,13 +143,15 @@ class ALSModel:
 # Device kernels
 # ---------------------------------------------------------------------------
 
-def _dual_system_solve(M, y, K: int, solver: str):
+def _dual_system_solve(M, y, K: int, solver: str,
+                       iters_cap: Optional[int] = None):
     """Solve the K-dim dual/Woodbury system: the shared policy for both
     explicit and implicit dual branches. K+8 iterations (CG's exact-
     arithmetic finite termination is <= K; the margin absorbs f32
-    roundoff — capping below K would silently under-solve the larger
-    power-of-two buckets); tiny systems skip the Pallas kernel, whose
-    per-tile overhead dominates below 32."""
+    roundoff — capping below K silently under-solves the larger
+    buckets unless the caller opts in via `iters_cap`, whose accuracy
+    cost is ALSConfig.dual_iters_cap's to document); tiny systems skip
+    the Pallas kernel, whose per-tile overhead dominates below 32."""
     import jax
     import jax.numpy as jnp
 
@@ -149,7 +166,12 @@ def _dual_system_solve(M, y, K: int, solver: str):
         M_live = jax.lax.optimization_barrier(M)
         return y + M_live.sum(axis=2) * jnp.float32(1e-12)
     method = "cg" if (K < 32 and solver == "cg_pallas") else solver
-    return spd_solve(M, y, method=method, iters=K + 8)
+    if iters_cap is not None and iters_cap < 1:
+        # 0 would fall into spd_solve's `iters or 48` unset-default and
+        # run MORE iterations than uncapped — reject it loudly
+        raise ValueError(f"dual_iters_cap must be >= 1, got {iters_cap}")
+    iters = K + 8 if iters_cap is None else min(K + 8, iters_cap)
+    return spd_solve(M, y, method=method, iters=iters)
 
 
 def _scatter_rows(factors_out, rows, x):
@@ -164,7 +186,8 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                  lam, alpha, *, nratings_reg: bool, implicit: bool,
                  rank: int, compute_dtype: str, solver: str,
                  dual_solve: str = "auto",
-                 solver_iters: Optional[int] = None):
+                 solver_iters: Optional[int] = None,
+                 dual_iters_cap: Optional[int] = None):
     """Solve one [B, K] batch of normal equations and scatter results into
     factors_out. Traced inside `_solve_sweep`'s scan body — gather ->
     einsum -> solve -> scatter fuse into one XLA program. Explicit batches
@@ -201,7 +224,8 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
                         preferred_element_type=jnp.float32)
         Ad = Ad + reg[:, None, None] * jnp.eye(K, dtype=jnp.float32)
         y = (val * mask)
-        z = _dual_system_solve(Ad, y, K, solver)
+        z = _dual_system_solve(Ad, y, K, solver,
+                               iters_cap=dual_iters_cap)
         x = jnp.einsum("bkr,bk->br", Vm, z.astype(cd),
                        preferred_element_type=jnp.float32)
         return _scatter_rows(factors_out, rows, x)
@@ -244,7 +268,8 @@ def _solve_batch(factors_out, counter_factors, gram, rows, idx, val, mask,
             t = jnp.einsum("bks,bs->bk", Vq.astype(cd),
                            bq_d.astype(cd),
                            preferred_element_type=jnp.float32)  # V B^-1 b
-            z = _dual_system_solve(M, dhalf * t, K, solver)
+            z = _dual_system_solve(M, dhalf * t, K, solver,
+                                   iters_cap=dual_iters_cap)
             s = jnp.einsum("bks,bk->bs", Vq.astype(cd),
                            (dhalf * z).astype(cd),
                            preferred_element_type=jnp.float32)
@@ -276,7 +301,8 @@ def _solve_sweep_impl(factors_out, counter_factors, gram, groups, lam,
                       alpha, *, nratings_reg: bool, implicit: bool,
                       rank: int, compute_dtype: str, solver: str,
                       dual_solve: str = "auto",
-                      solver_iters: Optional[int] = None):
+                      solver_iters: Optional[int] = None,
+                      dual_iters_cap: Optional[int] = None):
     import jax
 
     def body(f, batch):
@@ -286,7 +312,8 @@ def _solve_sweep_impl(factors_out, counter_factors, gram, groups, lam,
                          implicit=implicit, rank=rank,
                          compute_dtype=compute_dtype, solver=solver,
                          dual_solve=dual_solve,
-                         solver_iters=solver_iters)
+                         solver_iters=solver_iters,
+                         dual_iters_cap=dual_iters_cap)
         return f, None
 
     for group in groups:
@@ -297,12 +324,14 @@ def _solve_sweep_impl(factors_out, counter_factors, gram, groups, lam,
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
-                     "solver", "dual_solve", "solver_iters"),
+                     "solver", "dual_solve", "solver_iters",
+                     "dual_iters_cap"),
     donate_argnums=(0,))
 def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
                  nratings_reg: bool, implicit: bool, rank: int,
                  compute_dtype: str, solver: str, dual_solve: str = "auto",
-                 solver_iters: Optional[int] = None):
+                 solver_iters: Optional[int] = None,
+                 dual_iters_cap: Optional[int] = None):
     """One half-iteration in ONE dispatch: `groups` is a tuple of stacked
     same-shape batch groups (rows [N,B], idx/val/mask [N,B,K]); each group
     is consumed by a `lax.scan` over its leading dim, carrying the donated
@@ -314,20 +343,21 @@ def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
         factors_out, counter_factors, gram, groups, lam, alpha,
         nratings_reg=nratings_reg, implicit=implicit, rank=rank,
         compute_dtype=compute_dtype, solver=solver, dual_solve=dual_solve,
-        solver_iters=solver_iters)
+        solver_iters=solver_iters, dual_iters_cap=dual_iters_cap)
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
-                     "solver", "dual_solve", "solver_iters", "n_users",
-                     "n_items"),
+                     "solver", "dual_solve", "solver_iters",
+                     "dual_iters_cap", "n_users", "n_items"),
     donate_argnums=(0, 1))
 def _solve_iteration(U, V, user_groups, item_groups, lam, alpha, *,
                      nratings_reg: bool, implicit: bool, rank: int,
                      compute_dtype: str, solver: str,
                      dual_solve: str = "auto",
                      solver_iters: Optional[int] = None,
+                     dual_iters_cap: Optional[int] = None,
                      n_users: int = 0, n_items: int = 0):
     """One FULL iteration (user sweep then item sweep, plus the implicit
     Grams) traced as a single program: the half-sweeps are data-dependent
@@ -339,12 +369,14 @@ def _solve_iteration(U, V, user_groups, item_groups, lam, alpha, *,
     U = _solve_sweep_impl(
         U, V, gram_v, user_groups, lam, alpha, nratings_reg=nratings_reg,
         implicit=implicit, rank=rank, compute_dtype=compute_dtype,
-        solver=solver, dual_solve=dual_solve, solver_iters=solver_iters)
+        solver=solver, dual_solve=dual_solve, solver_iters=solver_iters,
+        dual_iters_cap=dual_iters_cap)
     gram_u = gram_of(U[:n_users]) if implicit else None
     V = _solve_sweep_impl(
         V, U, gram_u, item_groups, lam, alpha, nratings_reg=nratings_reg,
         implicit=implicit, rank=rank, compute_dtype=compute_dtype,
-        solver=solver, dual_solve=dual_solve, solver_iters=solver_iters)
+        solver=solver, dual_solve=dual_solve, solver_iters=solver_iters,
+        dual_iters_cap=dual_iters_cap)
     return U, V
 
 
@@ -452,7 +484,8 @@ def _run_side(device_groups, factors, counter_factors, cfg: ALSConfig,
         nratings_reg=(cfg.lambda_scaling == "nratings"),
         implicit=cfg.implicit_prefs, rank=cfg.rank,
         compute_dtype=cfg.compute_dtype, solver=cfg.solver,
-        dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters)
+        dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters,
+        dual_iters_cap=cfg.dual_iters_cap)
 
 
 def als_train(ratings: RatingsCOO, cfg: ALSConfig,
@@ -534,6 +567,7 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
                 implicit=cfg.implicit_prefs, rank=cfg.rank,
                 compute_dtype=cfg.compute_dtype, solver=cfg.solver,
                 dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters,
+                dual_iters_cap=cfg.dual_iters_cap,
                 n_users=ratings.n_users, n_items=ratings.n_items)
     else:
         for it in range(cfg.iterations):
