@@ -1295,6 +1295,16 @@ def solver_ablation():
             ("cg_pallas + dual + chunk4 + dualcap16",
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
                   dual_iters_cap=16)),
+            # larger solve batches = fewer solver calls (B*K budget per
+            # batch; 4x budget ~ 1/4 the calls) — the other axis of
+            # per-call amortization, orthogonal to chunk. Costs a fresh
+            # plan+upload, banked separately in `uploads`
+            ("cg_pallas + dual + chunk4 + budget4M",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
+                  work_budget=(1 << 22))),
+            ("cg_pallas + dual + budget4M",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  work_budget=(1 << 22))),
             ("schulz_pallas + dual + chunk4",
              dict(solver="schulz_pallas", dual_solve="auto",
                   sweep_chunk=4)),
@@ -1342,19 +1352,26 @@ def solver_ablation():
             ("DIAG gather+gram (no solve)",
              dict(solver="diag_nosolve", dual_solve="auto",
                   sweep_chunk=4)),
+            # exercises the per-budget plan/upload machinery in smoke
+            ("cg + dual + budget/4",
+             dict(solver="cg", dual_solve="auto",
+                  work_budget=(1 << 18))),
         ]
     ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
     ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
     mesh = current_mesh()
-    user_plan = plan_for_users(ratings, work_budget=1 << 20)
-    item_plan = plan_for_items(ratings, work_budget=1 << 20)
-    uploads = {}   # chunk -> (user_batches, item_batches); plans reused
+    plans = {}     # work_budget -> (user_plan, item_plan)
+    uploads = {}   # (chunk, work_budget) -> (user_batches, item_batches)
 
-    def batches_for(chunk):
-        if chunk not in uploads:
-            uploads[chunk] = (A._upload_plan(mesh, user_plan, chunk),
-                              A._upload_plan(mesh, item_plan, chunk))
-        return uploads[chunk]
+    def batches_for(chunk, budget):
+        if budget not in plans:
+            plans[budget] = (plan_for_users(ratings, work_budget=budget),
+                             plan_for_items(ratings, work_budget=budget))
+        if (chunk, budget) not in uploads:
+            up, ip = plans[budget]
+            uploads[(chunk, budget)] = (A._upload_plan(mesh, up, chunk),
+                                        A._upload_plan(mesh, ip, chunk))
+        return uploads[(chunk, budget)]
     _start_stall_watchdog(emit_json=False)   # before any device upload
     _beat("ablation: replicate scalars")
     lam = mesh.put_replicated(np.float32(0.05))
@@ -1363,8 +1380,9 @@ def solver_ablation():
         _beat(f"ablation: {name}")
         cfg = ALSConfig(rank=rank, iterations=1, lam=0.05, seed=1,
                         compute_dtype=("bfloat16" if full else "float32"),
-                        work_budget=(1 << 20), **kw)
-        user_batches, item_batches = batches_for(cfg.sweep_chunk or 1)
+                        **{"work_budget": (1 << 20), **kw})
+        user_batches, item_batches = batches_for(cfg.sweep_chunk or 1,
+                                                 cfg.work_budget)
         fdt = cfg.factor_dtype
         import jax.numpy as jnp
         dt = jnp.bfloat16 if fdt == "bfloat16" else np.float32
@@ -1384,6 +1402,7 @@ def solver_ablation():
                     compute_dtype=cfg.compute_dtype, solver=cfg.solver,
                     dual_solve=cfg.dual_solve,
                     solver_iters=cfg.solver_iters,
+                    dual_iters_cap=cfg.dual_iters_cap,
                     n_users=n_users, n_items=n_items)
             # the conditional keeps the explicit timed path free of even
             # the factor-slice dispatch the gram computation needs
