@@ -134,17 +134,21 @@ if ! timeout 90 python -c "import jax; d=jax.devices(); print(d); import sys; sy
 fi
 
 if headline_banked; then
+    # ablation first (the optimization data), then a headline refresh
+    # (second artifact = run-to-run variance evidence) while the window
+    # is most likely still alive; probe and the single-chip mesh sweep
+    # (which degrades to a headline duplicate on a 1-chip tunnel) last
     echo "== headline artifact already banked: ablation-first order =="
     run_ablation
-    run_mesh_sweep
+    run_bench
     run_probe
     if [ "$probe_rc" -ne 0 ]; then
-        # a wedged/degraded tunnel will not answer a headline refresh —
-        # don't chain up to 2h of stall-watchdog timeouts after it
-        echo "== done (headline refresh skipped, probe rc!=0): $OUT (rc=1) =="
+        # a wedged/degraded tunnel will not answer the mesh sweep —
+        # don't chain stall-watchdog timeouts after it
+        echo "== done (mesh sweep skipped, probe rc!=0): $OUT (rc=1) =="
         exit 1
     fi
-    run_bench
+    run_mesh_sweep
 else
     run_bench
     run_probe
