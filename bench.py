@@ -493,12 +493,24 @@ def math_parity_report(out_path="MATH_PARITY.json", iters=6,
         "train_s": round(time.perf_counter() - t0, 1)}
 
     ratings_tr = RatingsCOO(ui_tr, ii_tr, vv_tr, n_users, n_items)
-    for label, factor_dtype in (("als_train_f32_tables", "float32"),
-                                ("als_train_bf16_tables", "bfloat16")):
+    variants = (
+        ("als_train_f32_tables", {}),
+        ("als_train_bf16_tables", {"factor_dtype": "bfloat16"}),
+        # accuracy side of the ablation's dualcap16 speed row, at the
+        # full rank-200 regime (cap = ~8% of the K+8 budget). solver
+        # 'cg' explicitly: the CPU default resolves to cholesky, which
+        # ignores iteration budgets and would test nothing. The cap
+        # scales down at toy rank so the suite's smoke run still BINDS
+        # it (at rank 8 a flat 16 >= every K+8 budget and a regressed
+        # cap would go unnoticed); at rank >= 32 this is exactly 16
+        ("als_train_dualcap16_cg",
+         {"solver": "cg", "dual_iters_cap": min(16, max(1, rank // 2))}),
+    )
+    for label, extra_cfg in variants:
         t0 = time.perf_counter()
         model = als_train(ratings_tr, ALSConfig(
             rank=rank, iterations=iters, lam=lam, seed=1,
-            work_budget=(1 << 20), factor_dtype=factor_dtype))
+            work_budget=(1 << 20), **extra_cfg))
         results[label] = {
             "heldout_rmse": round(heldout_rmse(
                 np.asarray(model.user_factors, dtype=np.float64),

@@ -48,7 +48,8 @@ class TestMathParityHarness:
         assert d["artifact"] == "rank200_math_parity"
         assert set(d["results"]) == {"mllib_shaped_float64",
                                      "als_train_f32_tables",
-                                     "als_train_bf16_tables"}
+                                     "als_train_bf16_tables",
+                                     "als_train_dualcap16_cg"}
         assert d["workload"]["nnz_train"] + d["workload"]["nnz_heldout"] \
             == 20_000
         for v in d["results"].values():
