@@ -1377,8 +1377,15 @@ def solver_ablation():
 
     def batches_for(chunk, budget):
         if budget not in plans:
-            plans[budget] = (plan_for_users(ratings, work_budget=budget),
-                             plan_for_items(ratings, work_budget=budget))
+            # batch_multiple keeps B divisible by the data axis — without
+            # it the upload's batch-dim sharding rejects odd-B batches on
+            # any mesh with dp > 1
+            dp = mesh.data_parallelism
+            plans[budget] = (
+                plan_for_users(ratings, work_budget=budget,
+                               batch_multiple=dp),
+                plan_for_items(ratings, work_budget=budget,
+                               batch_multiple=dp))
         if (chunk, budget) not in uploads:
             up, ip = plans[budget]
             uploads[(chunk, budget)] = (A._upload_plan(mesh, up, chunk),
