@@ -156,9 +156,11 @@ def prepare_als_run(mesh, ratings, cfg, seed: int = 1,
     from predictionio_tpu.ops.ratings import plan_for_items, plan_for_users
 
     user_plan = plan_for_users(ratings, work_budget=cfg.work_budget,
-                               batch_multiple=batch_multiple)
+                               batch_multiple=batch_multiple,
+                               bucket_ratio=cfg.bucket_ratio)
     item_plan = plan_for_items(ratings, work_budget=cfg.work_budget,
-                               batch_multiple=batch_multiple)
+                               batch_multiple=batch_multiple,
+                               bucket_ratio=cfg.bucket_ratio)
     chunk = A.resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
     return {
         "user_plan": user_plan, "item_plan": item_plan,
@@ -1317,6 +1319,19 @@ def solver_ablation():
             ("cg_pallas + dual + budget4M",
              dict(solver="cg_pallas", dual_solve="auto",
                   work_budget=(1 << 22))),
+            # ladder coarseness: at full scale the ladder size IS the
+            # solver-call count (~125/iter at 1.125 — every K its own
+            # uniquely-shaped batch); ratio 1.5/2.0 cut calls ~3x/5x at
+            # the cost of padding (gather bytes + Gram flops). Round 2
+            # measured coarser=worse at chunk=1 in the old code; these
+            # re-measure on current code where calls, not bytes, are
+            # the suspect
+            ("cg_pallas + dual + ratio1.5",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  bucket_ratio=1.5)),
+            ("cg_pallas + dual + ratio2.0",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  bucket_ratio=2.0)),
             ("schulz_pallas + dual + chunk4",
              dict(solver="schulz_pallas", dual_solve="auto",
                   sweep_chunk=4)),
@@ -1368,29 +1383,33 @@ def solver_ablation():
             ("cg + dual + budget/4",
              dict(solver="cg", dual_solve="auto",
                   work_budget=(1 << 18))),
+            # exercises the per-ratio plan machinery in smoke
+            ("cg + dual + ratio2.0",
+             dict(solver="cg", dual_solve="auto", bucket_ratio=2.0)),
         ]
     ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
     ratings = RatingsCOO(ui, ii, vv, n_users, n_items)
     mesh = current_mesh()
-    plans = {}     # work_budget -> (user_plan, item_plan)
-    uploads = {}   # (chunk, work_budget) -> (user_batches, item_batches)
+    plans = {}     # (budget, ratio) -> (user_plan, item_plan)
+    uploads = {}   # (chunk, budget, ratio) -> (user_batches, item_batches)
 
-    def batches_for(chunk, budget):
-        if budget not in plans:
+    def batches_for(chunk, budget, ratio):
+        if (budget, ratio) not in plans:
             # batch_multiple keeps B divisible by the data axis — without
             # it the upload's batch-dim sharding rejects odd-B batches on
             # any mesh with dp > 1
             dp = mesh.data_parallelism
-            plans[budget] = (
+            plans[(budget, ratio)] = (
                 plan_for_users(ratings, work_budget=budget,
-                               batch_multiple=dp),
+                               batch_multiple=dp, bucket_ratio=ratio),
                 plan_for_items(ratings, work_budget=budget,
-                               batch_multiple=dp))
-        if (chunk, budget) not in uploads:
-            up, ip = plans[budget]
-            uploads[(chunk, budget)] = (A._upload_plan(mesh, up, chunk),
-                                        A._upload_plan(mesh, ip, chunk))
-        return uploads[(chunk, budget)]
+                               batch_multiple=dp, bucket_ratio=ratio))
+        key = (chunk, budget, ratio)
+        if key not in uploads:
+            up, ip = plans[(budget, ratio)]
+            uploads[key] = (A._upload_plan(mesh, up, chunk),
+                            A._upload_plan(mesh, ip, chunk))
+        return uploads[key]
     _start_stall_watchdog(emit_json=False)   # before any device upload
     _beat("ablation: replicate scalars")
     lam = mesh.put_replicated(np.float32(0.05))
@@ -1401,7 +1420,8 @@ def solver_ablation():
                         compute_dtype=("bfloat16" if full else "float32"),
                         **{"work_budget": (1 << 20), **kw})
         user_batches, item_batches = batches_for(cfg.sweep_chunk or 1,
-                                                 cfg.work_budget)
+                                                 cfg.work_budget,
+                                                 cfg.bucket_ratio)
         fdt = cfg.factor_dtype
         import jax.numpy as jnp
         dt = jnp.bfloat16 if fdt == "bfloat16" else np.float32
@@ -1554,8 +1574,10 @@ def full_scale_cpu_report(out_path="FULLSCALE_CPU.json"):
                     solver=resolve_solver("auto", mesh.n_devices))
 
     t0 = time.perf_counter()
-    user_plan = plan_for_users(ratings, work_budget=cfg.work_budget)
-    item_plan = plan_for_items(ratings, work_budget=cfg.work_budget)
+    user_plan = plan_for_users(ratings, work_budget=cfg.work_budget,
+                               bucket_ratio=cfg.bucket_ratio)
+    item_plan = plan_for_items(ratings, work_budget=cfg.work_budget,
+                               bucket_ratio=cfg.bucket_ratio)
     plan_s = time.perf_counter() - t0
 
     host_plan_bytes = sum(

@@ -100,6 +100,13 @@ class ALSConfig:
     # table), so merging changes no math. Bounded by the normal-matrix
     # memory per step (chunk * B * S^2 * 4B). 0 = auto: 4 on single-device
     # TPU, 1 elsewhere.
+    bucket_ratio: float = 1.125
+    # Geometric step of the segment-length ladder (ops/ratings.py
+    # bucket_lengths). At ML-20M scale nearly every ladder K is its own
+    # uniquely-shaped batch, so the ladder size IS the solver-call count
+    # per sweep (~125/iteration at 1.125); a coarser ratio trades padding
+    # (more gather bytes + Gram flops) for fewer calls. The ablation's
+    # ratio rows measure the tradeoff on hardware before any flip.
     fuse_iteration: bool = False
     # Trace both half-sweeps (and the implicit Grams) into ONE program per
     # iteration, letting XLA overlap the item-side gather DMAs with the
@@ -113,6 +120,13 @@ class ALSConfig:
             # into spd_solve's `iters or 48` unset-default
             raise ValueError("dual_iters_cap must be >= 1, got "
                              f"{self.dual_iters_cap}")
+        if not self.bucket_ratio > 1.0:
+            # ratio <= 1 degrades the geometric walk to the linear,
+            # maximally fine ladder (bucket_lengths always advances by
+            # at least one alignment step) — never what a caller wants,
+            # so reject it rather than silently maximize program count
+            raise ValueError("bucket_ratio must be > 1.0, got "
+                             f"{self.bucket_ratio}")
 
 
 def default_compute_dtype() -> str:
@@ -512,9 +526,11 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
             cfg, solver=resolve_solver(cfg.solver, mesh.n_devices))
     dp = mesh.data_parallelism
     user_plan = plan_for_users(ratings, work_budget=cfg.work_budget,
-                               batch_multiple=dp)
+                               batch_multiple=dp,
+                               bucket_ratio=cfg.bucket_ratio)
     item_plan = plan_for_items(ratings, work_budget=cfg.work_budget,
-                               batch_multiple=dp)
+                               batch_multiple=dp,
+                               bucket_ratio=cfg.bucket_ratio)
     logger.info(
         "ALS: %d users, %d items, %d ratings; %d user batches %s "
         "(pad %.2fx), %d item batches %s (pad %.2fx)",

@@ -370,6 +370,30 @@ def test_diag_solvers_run_and_are_finite():
             assert np.isfinite(m.item_factors).all()
 
 
+def test_bucket_ratio_coarse_matches_default():
+    """bucket_ratio only changes the padded segment-length ladder —
+    masked padding positions contribute exact zeros, so a coarse ladder
+    must train to the same factors as the default within float
+    reassociation tolerance (the ablation's ratio rows measure the
+    speed/padding tradeoff; this pins that the math is unchanged)."""
+    rng = np.random.default_rng(29)
+    n_u, n_i, nnz = 500, 150, 8000
+    ui = rng.integers(0, n_u, nnz)
+    ii = rng.integers(0, n_i, nnz)
+    vv = rng.uniform(1, 5, nnz).astype(np.float32)
+    r = RatingsCOO(ui, ii, vv, n_u, n_i)
+    kw = dict(rank=8, iterations=3, lam=0.05, seed=2, work_budget=512)
+    base = als_train(r, ALSConfig(**kw))
+    for ratio in (1.5, 2.0):
+        m = als_train(r, ALSConfig(bucket_ratio=ratio, **kw))
+        np.testing.assert_allclose(m.user_factors, base.user_factors,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(m.item_factors, base.item_factors,
+                                   rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="bucket_ratio"):
+        ALSConfig(bucket_ratio=1.0, **kw)
+
+
 def test_dual_iters_cap_converges_like_uncapped():
     """dual_iters_cap trades the K+8 finite-termination budget for
     wall-clock; capping to ~20% of the budget (8 of up to K+8=39 at
