@@ -1283,15 +1283,39 @@ def solver_ablation():
             ("DIAG gather+gram (no solve)",
              dict(solver="diag_nosolve", dual_solve="auto",
                   sweep_chunk=4)),
-            # once chunking amortizes the solver's per-call fixed cost,
-            # the f32 factor-row gathers are the roofline numerator
-            # (45.5 GB/iter at full scale) — bf16 tables halve it
+            # ladder coarseness: at full scale the ladder size IS the
+            # solver-call count (FULLSCALE_CPU.json: 47+78 uniquely-
+            # shaped batches = 125 solver calls/iter at 1.125); ratio
+            # 1.5/2.0 cut calls ~3x/5x at the cost of padding (gather
+            # bytes + Gram flops). Round 2 measured coarser=worse in the
+            # old per-batch-dispatch code; these re-measure on current
+            # code where calls, not bytes, are the suspect
+            ("cg_pallas + dual + ratio2.0",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  bucket_ratio=2.0)),
+            ("cg_pallas + dual + ratio1.5",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  bucket_ratio=1.5)),
+            # does dual-solve time scale with CG depth or is it per-call
+            # fixed? SPEED measurement only here; accuracy at the full
+            # rank-200 regime is pre-cleared (MATH_PARITY.json
+            # als_train_dualcap16_cg: heldout RMSE identical to uncapped)
+            ("cg_pallas + dual + chunk4 + dualcap16",
+             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
+                  dual_iters_cap=16)),
+            # the combined candidate default if the two singles above
+            # both win
+            ("cg_pallas + dual + ratio2.0 + dualcap16",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  bucket_ratio=2.0, dual_iters_cap=16)),
             # if the ~20-30 ms/solver-call fixed cost is Pallas launch
-            # overhead (prime suspect for the 24x roofline gap: ~60
-            # calls/iter across the ladder's distinct Ks), XLA-native CG
-            # dodges it at the cost of slower matvecs
+            # overhead, XLA-native CG dodges it at the cost of slower
+            # matvecs
             ("cg (XLA) + dual + chunk4",
              dict(solver="cg", dual_solve="auto", sweep_chunk=4)),
+            # once per-call costs are amortized, the f32 factor-row
+            # gathers are the roofline numerator (45.5 GB/iter) — bf16
+            # tables halve it
             ("cg_pallas + dual + chunk4 + bf16 tables",
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
                   factor_dtype="bfloat16")),
@@ -1300,38 +1324,15 @@ def solver_ablation():
                   fuse_iteration=True)),
             ("cg_pallas + dual + chunk8",
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=8)),
-            # does dual-solve time scale with CG depth or is it per-call
-            # fixed? SPEED measurement only: tests/test_als.py checks
-            # RMSE-equivalence at a milder regime (rank 32, ~20% of the
-            # budget) — at rank 200 the cap trims K+8<=208 to 16 (~8%),
-            # so full-scale accuracy must be re-measured before any
-            # default flip
-            ("cg_pallas + dual + chunk4 + dualcap16",
-             dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
-                  dual_iters_cap=16)),
-            # larger solve batches = fewer solver calls (B*K budget per
-            # batch; 4x budget ~ 1/4 the calls) — the other axis of
-            # per-call amortization, orthogonal to chunk. Costs a fresh
-            # plan+upload, banked separately in `uploads`
+            # larger solve batches amortize per-call cost only where a
+            # bucket actually split (a handful at budget 1M) — expected
+            # marginal; kept to close the hypothesis
             ("cg_pallas + dual + chunk4 + budget4M",
              dict(solver="cg_pallas", dual_solve="auto", sweep_chunk=4,
                   work_budget=(1 << 22))),
             ("cg_pallas + dual + budget4M",
              dict(solver="cg_pallas", dual_solve="auto",
                   work_budget=(1 << 22))),
-            # ladder coarseness: at full scale the ladder size IS the
-            # solver-call count (~125/iter at 1.125 — every K its own
-            # uniquely-shaped batch); ratio 1.5/2.0 cut calls ~3x/5x at
-            # the cost of padding (gather bytes + Gram flops). Round 2
-            # measured coarser=worse at chunk=1 in the old code; these
-            # re-measure on current code where calls, not bytes, are
-            # the suspect
-            ("cg_pallas + dual + ratio1.5",
-             dict(solver="cg_pallas", dual_solve="auto",
-                  bucket_ratio=1.5)),
-            ("cg_pallas + dual + ratio2.0",
-             dict(solver="cg_pallas", dual_solve="auto",
-                  bucket_ratio=2.0)),
             ("schulz_pallas + dual + chunk4",
              dict(solver="schulz_pallas", dual_solve="auto",
                   sweep_chunk=4)),
