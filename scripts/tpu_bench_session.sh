@@ -49,28 +49,38 @@ PYEOF
 }
 
 run_bench() {
-    echo "== bench (headline + roofline + serve sweep) -> $OUT/bench.json =="
+    # "refresh" mode (banked-order sessions): skip the CPU-bound
+    # baseline + ingest phases — they are tunnel-independent and
+    # already measured — and write to bench_refresh.json so the lean
+    # line never shadows a banked full artifact
+    local mode=${1:-full} outfile=bench.json skips=()
+    if [ "$mode" = "refresh" ]; then
+        outfile=bench_refresh.json
+        skips=(PIO_BENCH_SKIP_BASELINE=1 PIO_BENCH_SKIP_INGEST=1)
+    fi
+    echo "== bench ($mode: headline + roofline + serve sweep) -> $OUT/$outfile =="
     # bench.py self-bounds via its stall watchdog (PIO_BENCH_STALL_S,
     # 1500s per substage, partial results on stall) — these are backstops
     local bench_rc=0
-    timeout 7200 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err" \
+    timeout 7200 env "${skips[@]}" python bench.py \
+        > "$OUT/$outfile" 2> "$OUT/bench.err" \
         || bench_rc=$?
-    if [ "$bench_rc" -eq 2 ] && grep -q "stalled" "$OUT/bench.json"; then
+    if [ "$bench_rc" -eq 2 ] && grep -q "stalled" "$OUT/$outfile"; then
         # sentinel guard: bare rc=2 is also CPython's can't-start status
-        echo "BENCH STALLED MID-RUN (rc=2) — bench.json carries the"
+        echo "BENCH STALLED MID-RUN (rc=2) — $outfile carries the"
         echo "completed-stage measurements plus an 'error' stall diagnosis."
         echo "SALVAGE the completed numbers (train row especially) — do not"
         echo "discard, but do not present it as a full headline run either."
         rc=1
     elif [ "$bench_rc" -ne 0 ]; then
-        echo "BENCH FAILED (rc=$bench_rc) — bench.json holds a parseable"
+        echo "BENCH FAILED (rc=$bench_rc) — $outfile holds a parseable"
         echo "error line UNLESS the outer timeout killed it (rc=124/137:"
         echo "file may be empty). Do NOT copy it over the round's"
         echo "BENCH_r<N>.json; tail of stderr:"
         tail -c 1000 "$OUT/bench.err"
         rc=1
     fi
-    tail -c 2000 "$OUT/bench.json"; echo
+    tail -c 2000 "$OUT/$outfile"; echo
 }
 
 # Probe rc semantics (scripts/tpu_kernel_probe.py): 0 ok; 1 production
@@ -140,7 +150,7 @@ if headline_banked; then
     # (which degrades to a headline duplicate on a 1-chip tunnel) last
     echo "== headline artifact already banked: ablation-first order =="
     run_ablation
-    run_bench
+    run_bench refresh
     run_probe
     if [ "$probe_rc" -ne 0 ]; then
         # a wedged/degraded tunnel will not answer the mesh sweep —
