@@ -1296,6 +1296,20 @@ def solver_ablation():
             ("cg_pallas + dual + ratio1.5",
              dict(solver="cg_pallas", dual_solve="auto",
                   bucket_ratio=1.5)),
+            # ratio x budget: work_budget splits cap the step reduction
+            # (ratio2.0 alone is 67 steps because coarse buckets split;
+            # with a 4M budget the host-side plan counts are 48 steps at
+            # 1.5 / 35 at 2.0 vs the default plan's 125)
+            ("cg_pallas + dual + ratio2.0 + budget4M",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  bucket_ratio=2.0, work_budget=(1 << 22))),
+            ("cg_pallas + dual + ratio1.5 + budget4M",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  bucket_ratio=1.5, work_budget=(1 << 22))),
+            ("cg_pallas + dual + ratio2.0 + budget4M + dualcap16",
+             dict(solver="cg_pallas", dual_solve="auto",
+                  bucket_ratio=2.0, work_budget=(1 << 22),
+                  dual_iters_cap=16)),
             # does dual-solve time scale with CG depth or is it per-call
             # fixed? SPEED measurement only here; accuracy at the full
             # rank-200 regime is pre-cleared (MATH_PARITY.json
