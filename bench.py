@@ -1240,12 +1240,13 @@ def main():
         out["serve_wait_sweep_ms"] = serve_sweep
     if os.environ.get("PIO_BENCH_CPU_FALLBACK"):
         out["note"] = (
-            "TPU tunnel unreachable; CPU smoke-mode fallback "
-            "(full_scale=false, NOT a chip measurement). The TPU "
-            "measurement plan is staged: scripts/tpu_bench_session.sh "
-            "runs this bench + --ablation (sweep_chunk/fused-iteration/"
-            "chol_pallas rows) on an idle box as soon as the tunnel "
-            "answers; see the 'Pending on hardware' section of "
+            "TPU tunnel unreachable for THIS run; CPU smoke-mode "
+            "fallback (full_scale=false, NOT a chip measurement). A "
+            "valid full-scale TPU artifact exists from the 2026-07-31 "
+            "live window: TPU_BENCH_CAPTURE_latest.json (backend=tpu, "
+            "1.3584 s/iteration, self-validated) — cite that, not this "
+            "line. scripts/tpu_watch_and_bench.sh re-runs the full "
+            "session (ablation-first) on the next live window; see "
             "docs/benchmarks.md.")
     print(json.dumps(out))
 
