@@ -502,9 +502,13 @@ def math_parity_report(out_path="MATH_PARITY.json", iters=6,
         # full rank-200 regime (cap = ~8% of the K+8 budget). solver
         # 'cg' explicitly: the CPU default resolves to cholesky, which
         # ignores iteration budgets and would test nothing. The cap
-        # scales down at toy rank so the suite's smoke run still BINDS
-        # it (at rank 8 a flat 16 >= every K+8 budget and a regressed
-        # cap would go unnoticed); at rank >= 32 this is exactly 16
+        # scales down at toy rank so the suite's smoke run still binds
+        # it — PROVIDED the smoke rank is >= 16: the dual route needs
+        # K < rank and the bucket ladder's minimum K is 8, so at rank 8
+        # the Woodbury branch never fires and the cap is only plumbing-
+        # tested (tests/test_bench_harness.py runs rank 16: the K=8
+        # bucket takes the dual route with budget K+8=16 > cap 8);
+        # at rank >= 32 this is exactly 16
         ("als_train_dualcap16_cg",
          {"solver": "cg", "dual_iters_cap": min(16, max(1, rank // 2))}),
     )
@@ -1049,6 +1053,94 @@ def measure_d2h_floor_ms() -> dict:
     return out
 
 
+def _bench_root() -> str:
+    """Repo root for banked-artifact scans and the fallback side file
+    (PIO_BENCH_ROOT overrides for tests)."""
+    return os.environ.get("PIO_BENCH_ROOT",
+                          os.path.dirname(os.path.abspath(__file__)))
+
+
+def _artifact_dict(path: str):
+    """Parse one banked-artifact file into a flat result dict, or None.
+    Accepts bench.py's own one-line JSON, multi-line pretty JSON, and the
+    driver's wrapper shape ({"n", "cmd", "rc", "tail", "parsed"})."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+        if not text:
+            return None
+        try:
+            d = json.loads(text)
+        except json.JSONDecodeError:
+            d = json.loads(text.splitlines()[-1])
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(d, dict) and isinstance(d.get("parsed"), dict):
+        d = d["parsed"]   # driver wrapper
+    return d if isinstance(d, dict) else None
+
+
+def banked_tpu_artifact(root: str | None = None):
+    """Newest VALID full-scale TPU artifact in the repo root — backend
+    'tpu', full_scale, no error, nonzero value (the same validity rule
+    scripts/tpu_bench_session.sh applies). Scans BENCH_r*.json newest
+    first, then TPU_BENCH_CAPTURE_latest.json. Returns (path, dict) or
+    None."""
+    import glob
+    import re
+    root = root or _bench_root()
+
+    def round_no(path):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    # numeric round order (lexicographic would park r99 above r100)
+    candidates = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                        key=round_no, reverse=True)
+    candidates.append(os.path.join(root, "TPU_BENCH_CAPTURE_latest.json"))
+    for p in candidates:
+        d = _artifact_dict(p)
+        if (d and d.get("backend") == "tpu" and d.get("full_scale")
+                and not d.get("error") and d.get("value")):
+            return p, d
+    return None
+
+
+def fallback_note(root: str | None = None) -> str:
+    """The CPU-fallback labeling, resolved against what is ACTUALLY
+    banked at run time (a hardcoded artifact name/number goes stale the
+    moment a newer TPU capture lands)."""
+    banked = banked_tpu_artifact(root)
+    note = ("TPU tunnel unreachable for THIS run; CPU smoke-mode "
+            "fallback (full_scale=false, NOT a chip measurement). ")
+    if banked:
+        path, d = banked
+        spi = d.get("train_s_per_iteration")
+        note += (f"A valid full-scale TPU artifact is banked: "
+                 f"{os.path.basename(path)} (backend=tpu"
+                 + (f", {spi} s/iteration" if spi else "")
+                 + ") — cite that, not this line. ")
+    else:
+        note += ("No valid banked TPU artifact found; see "
+                 "docs/operations.md for artifact validity rules. ")
+    note += ("scripts/tpu_watch_and_bench.sh re-runs the full session "
+             "(ablation-first) on the next live window; see "
+             "docs/benchmarks.md.")
+    return note
+
+
+def divert_fallback_output(out: dict, root: str | None = None) -> str:
+    """Write a CPU-fallback result to a SIDE file so no driver or
+    operator step ever replaces a banked TPU BENCH_r*.json with it
+    (round-5 failure mode: the round artifact became a labeled CPU
+    fallback). Returns the side-file path."""
+    root = root or _bench_root()
+    path = os.path.join(root, "BENCH_cpu_fallback.json")
+    with open(path, "w") as f:
+        f.write(json.dumps(out) + "\n")
+    return path
+
+
 def device_alive(timeout_s: float = 240.0):
     """Watchdog: the tunneled chip can hang indefinitely (observed: even
     an 8-float device_put blocks forever when the tunnel is down). Probe
@@ -1239,15 +1331,14 @@ def main():
     if serve_sweep:
         out["serve_wait_sweep_ms"] = serve_sweep
     if os.environ.get("PIO_BENCH_CPU_FALLBACK"):
-        out["note"] = (
-            "TPU tunnel unreachable for THIS run; CPU smoke-mode "
-            "fallback (full_scale=false, NOT a chip measurement). A "
-            "valid full-scale TPU artifact exists from the 2026-07-31 "
-            "live window: TPU_BENCH_CAPTURE_latest.json (backend=tpu, "
-            "1.3584 s/iteration, self-validated) — cite that, not this "
-            "line. scripts/tpu_watch_and_bench.sh re-runs the full "
-            "session (ablation-first) on the next live window; see "
-            "docs/benchmarks.md.")
+        out["note"] = fallback_note()
+        try:
+            # side file, never a BENCH_r*.json: a banked TPU artifact
+            # must survive any number of dead-tunnel fallback runs
+            # byte-identical
+            out["divertedTo"] = divert_fallback_output(out)
+        except OSError:
+            pass   # read-only checkout: stdout still carries the line
     print(json.dumps(out))
 
 
@@ -1435,9 +1526,14 @@ def solver_ablation():
         cfg = ALSConfig(rank=rank, iterations=1, lam=0.05, seed=1,
                         compute_dtype=("bfloat16" if full else "float32"),
                         **{"work_budget": (1 << 20), **kw})
-        user_batches, item_batches = batches_for(cfg.sweep_chunk or 1,
-                                                 cfg.work_budget,
-                                                 cfg.bucket_ratio)
+        # resolve chunk exactly as als_train would (auto -> 4 on a
+        # single-device TPU): rows that omit sweep_chunk must still
+        # measure the PRODUCTION chunking, else every ratio/budget/
+        # candidate row silently conflates its lever with a chunk=1
+        # downgrade vs the chunk4 baseline row
+        user_batches, item_batches = batches_for(
+            A.resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices),
+            cfg.work_budget, cfg.bucket_ratio)
         fdt = cfg.factor_dtype
         import jax.numpy as jnp
         dt = jnp.bfloat16 if fdt == "bfloat16" else np.float32

@@ -174,30 +174,62 @@ class EntityIdIxMap:
 
     def to_indices_array(self, ids: np.ndarray) -> np.ndarray:
         """Vectorized id->index for numpy id arrays (unknowns -> -1):
-        binary search against the inverse table when the map is in sorted
-        order (the ``build``/``build_with_indices`` default), dict probes
-        otherwise."""
+        binary search against a lazily-built sorted view of the key
+        table. Works at full speed for grown (append-ordered, unsorted)
+        maps too — the online fold-in path translates the whole corpus
+        through this every tick, so a per-row dict-probe fallback would
+        cost ~20M Python probes per side at ML-20M scale."""
         arr = np.asarray(ids)
         if arr.dtype == object:
             arr = arr.astype(str)
         keys = self._ids.astype(str)
         if len(keys) == 0 or arr.size == 0:
             return np.full(arr.shape, -1, dtype=np.int32)
-        sorted_ok = getattr(self, "_sorted_ok", None)
-        if sorted_ok is None:
-            sorted_ok = bool(np.all(keys[:-1] <= keys[1:])) \
-                if len(keys) > 1 else True
-            self._sorted_ok = sorted_ok
-        if not sorted_ok:
-            return self.to_indices(arr.tolist())
-        pos = np.searchsorted(keys, arr)
-        pos_safe = np.clip(pos, 0, len(keys) - 1)
-        hit = keys[pos_safe] == arr
-        return np.where(hit, pos_safe, -1).astype(np.int32)
+        cache = getattr(self, "_sorted_view", None)
+        if cache is None or len(cache[0]) != len(keys):
+            order = np.argsort(keys)
+            cache = (keys[order], order.astype(np.int32))
+            self._sorted_view = cache
+        sorted_keys, order = cache
+        pos = np.searchsorted(sorted_keys, arr)
+        pos_safe = np.clip(pos, 0, len(sorted_keys) - 1)
+        hit = sorted_keys[pos_safe] == arr
+        return np.where(hit, order[pos_safe], -1).astype(np.int32)
 
     @property
     def bimap(self) -> BiMap:
         return self._bimap
+
+    # -- online growth (fold-in path) ---------------------------------------
+    def grow(self, new_ids: Iterable[str]
+             ) -> "tuple[EntityIdIxMap, np.ndarray]":
+        """Append unseen ids AFTER the existing vocabulary, preserving every
+        existing dense index — the invariant the online fold-in path depends
+        on: factor-table row i must keep meaning the same entity across
+        model versions, so grown tables are old tables plus appended rows.
+
+        Returns (grown_map, appended_indices) where ``appended_indices`` are
+        the dense indices assigned to the ids that were actually new, in
+        first-occurrence order of ``new_ids``. Already-known ids are
+        ignored. When nothing is new, returns (self, empty).
+
+        Note the grown map is generally NOT in sorted order anymore;
+        ``to_indices_array`` detects that and falls back to dict probes."""
+        fresh: List[str] = []
+        seen = set()
+        for e in new_ids:
+            e = str(e)
+            if e not in self._bimap and e not in seen:
+                seen.add(e)
+                fresh.append(e)
+        if not fresh:
+            return self, np.empty(0, dtype=np.int32)
+        base = len(self._bimap)
+        fwd = dict(self._bimap.items())
+        for i, e in enumerate(fresh):
+            fwd[e] = base + i
+        grown = EntityIdIxMap(BiMap(fwd))
+        return grown, np.arange(base, base + len(fresh), dtype=np.int32)
 
 
 class EntityMap(Generic[V]):

@@ -335,6 +335,88 @@ class ALSAlgorithm(P2LAlgorithm):
         return top_scores_to_result(model.item_ix, s, i,
                                     properties_of=props_of)
 
+    # -- online updates (ISSUE 1: predictionio_tpu/online) -----------------
+    def fold_in(self, model: RecommendationModel, td: TrainingData,
+                touched_users, touched_items,
+                preparator_params: Optional[PreparatorParams] = None
+                ) -> Tuple[RecommendationModel, dict]:
+        """Absorb fresh events without a retrain: grow the vocabularies
+        with unseen touched entities (existing dense indices — and the
+        deployed factor rows behind them — never move), then re-solve
+        ONLY the touched user/item rows against the current data
+        (online/fold_in.fold_in_coo; explicit ALS-WR normal equations,
+        the same math `train` runs per sweep).
+
+        ``td`` must be the CURRENT training data (the scheduler re-reads
+        it through the data source): the touched rows' solves are
+        least-squares over exactly what they are given, so a partial
+        history would bias them toward the fresh slice.
+        ``preparator_params`` replays the deployed Preparator's data
+        policy (dedup mode, exclude_items_file) — the fold cannot run
+        prepare() itself because prepare rebuilds vocabularies and would
+        shuffle the deployed dense indices. Returns (new_model, report)
+        where report carries the post-fold training loss the scheduler's
+        drift gate consumes."""
+        from predictionio_tpu.online.fold_in import (FoldInConfig,
+                                                     fold_in_coo)
+        from predictionio_tpu.ops.als import als_rmse
+        p = self.params
+        prep = preparator_params or PreparatorParams()
+        rd = td.ratings
+        if prep.exclude_items_file:
+            with open(prep.exclude_items_file) as f:
+                no_train = sorted({line.strip() for line in f
+                                   if line.strip()})
+            if no_train:
+                rd = rd.select(~np.isin(rd.items, no_train))
+                touched_items = [i for i in touched_items
+                                 if str(i) not in set(no_train)]
+        # grow only entities that actually have ratings: a property-only
+        # $set for an unseen user/item must NOT mint a zero factor row
+        # (an unknown user answers cold-start-empty, which is honest;
+        # a zero row would answer all-zero scores)
+        present_u = set(np.unique(rd.users).astype(str))
+        present_i = set(np.unique(rd.items).astype(str))
+        user_ix, _ = model.user_ix.grow(
+            u for u in map(str, touched_users) if u in present_u)
+        item_ix, _ = model.item_ix.grow(
+            i for i in map(str, touched_items) if i in present_i)
+        ui = user_ix.to_indices_array(rd.users)
+        ii = item_ix.to_indices_array(rd.items)
+        keep = (ui >= 0) & (ii >= 0)
+        ui, ii, vals = dedup_ratings(ui[keep], ii[keep], rd.vals[keep],
+                                     rd.ts[keep], prep.dedup)
+        coo = RatingsCOO(ui, ii, vals, len(user_ix), len(item_ix))
+        tu = user_ix.to_indices([str(u) for u in touched_users])
+        ti = item_ix.to_indices([str(i) for i in touched_items])
+        from predictionio_tpu.ops.als import default_compute_dtype
+        cfg = FoldInConfig(
+            lam=p.lam, sweeps=2,
+            compute_dtype=p.compute_dtype or default_compute_dtype(),
+            sweep_chunk=p.sweep_chunk)
+        new_als, stats = fold_in_coo(model.als, coo, tu[tu >= 0],
+                                     ti[ti >= 0], cfg)
+        item_properties = model.item_properties
+        if item_properties is not None and len(item_ix) > len(item_properties):
+            # new items: carry fresh $set properties when the data source
+            # read them, else None (no filter metadata yet)
+            items = td.items or {}
+            item_properties = list(item_properties) + [
+                items.get(item_ix.id_of(ix))
+                for ix in range(len(item_properties), len(item_ix))]
+        cats, years = RecommendationModel.derive_filters(item_properties)
+        new_model = RecommendationModel(
+            new_als, user_ix, item_ix, item_properties=item_properties,
+            item_categories=cats, item_years=years)
+        report = {
+            "algorithm": type(self).__name__,
+            "loss": als_rmse(new_als, coo),
+            "userRows": stats.n_user_rows, "itemRows": stats.n_item_rows,
+            "newUsers": stats.n_new_users, "newItems": stats.n_new_items,
+            "wallS": stats.wall_s,
+        }
+        return new_model, report
+
     def batch_predict(self, model, queries):
         """Evaluation/serving path: one batched device top-k for all known
         users (vs the reference's per-query driver loop). Queries carrying

@@ -252,6 +252,13 @@ class SimilarProductModel(ItemMetadataModel):
     """productFeatures + id maps + item metadata (ALSAlgorithm.scala
     ALSModel)."""
     item_factors_normalized: np.ndarray   # [I, R] L2-normalized rows
+    # online-update state (ISSUE 1): the serve path needs only the
+    # normalized item table, but folding a fresh view into a deployed
+    # model needs the raw factors AND the user side the implicit
+    # normal equations solve against. Optional so old pickles load.
+    item_factors_raw: Optional[np.ndarray] = None   # [I, R]
+    user_factors: Optional[np.ndarray] = None       # [U, R]
+    user_ix: Optional[EntityIdIxMap] = None
 
 
 class ALSAlgorithm(P2LAlgorithm):
@@ -294,7 +301,80 @@ class ALSAlgorithm(P2LAlgorithm):
                           telemetry=self.last_train_telemetry)
         return SimilarProductModel(
             item_factors_normalized=normalize_rows(model.item_factors),
+            item_factors_raw=model.item_factors,
+            user_factors=model.user_factors, user_ix=user_ix,
             **ItemMetadataModel.metadata_kwargs(td.items, item_ix))
+
+    # -- online updates (ISSUE 1: predictionio_tpu/online) -----------------
+    def _fold_users_present(self, td: TrainingData) -> set:
+        """Users with event data — the only ones user-vocab growth may
+        mint rows for (a $set-only user stays cold-start)."""
+        if not len(td.view_events):
+            return set()
+        return set(np.unique(td.view_events.users).astype(str))
+
+    def _fold_ratings(self, td: TrainingData, user_ix: EntityIdIxMap,
+                      item_ix: EntityIdIxMap) -> RatingsCOO:
+        """Fresh ratings against FIXED (grown) vocabularies — the fold-in
+        analog of `_build_ratings`, which builds vocabularies itself and
+        would shuffle the deployed dense indices."""
+        views = td.view_events
+        ui = user_ix.to_indices_array(views.users)
+        ii = item_ix.to_indices_array(views.items)
+        keep = (ui >= 0) & (ii >= 0)
+        ui, ii, counts = dedup_ratings(ui[keep], ii[keep],
+                                       views.vals[keep], policy="sum")
+        return RatingsCOO(ui, ii, counts, len(user_ix), len(item_ix))
+
+    def fold_in(self, model: SimilarProductModel, td: TrainingData,
+                touched_users, touched_items, preparator_params=None
+                ) -> Tuple[SimilarProductModel, dict]:
+        """Implicit (Hu-Koren) fold-in: re-solve only the touched user and
+        item rows of the view-count factorization and refresh the
+        normalized serve table — a freshly $set + viewed item becomes
+        similar-product-recommendable without a retrain. Models persisted
+        before online support (no raw factor state) raise."""
+        if model.item_factors_raw is None or model.user_factors is None \
+                or model.user_ix is None:
+            raise ValueError(
+                "model lacks online-update state; retrain once with this "
+                "build before attaching the delta scheduler")
+        from predictionio_tpu.online.fold_in import (FoldInConfig,
+                                                     fold_in_coo)
+        from predictionio_tpu.ops.als import ALSModel, als_rmse, \
+            default_compute_dtype
+        p = self.params
+        # users grow only with event data; items grow when viewed OR $set
+        # (train's item vocabulary likewise covers all $set items)
+        present_u = self._fold_users_present(td)
+        user_ix, _ = model.user_ix.grow(
+            u for u in map(str, touched_users) if u in present_u)
+        item_ix, _ = model.item_ix.grow(str(i) for i in touched_items)
+        coo = self._fold_ratings(td, user_ix, item_ix)
+        tu = user_ix.to_indices([str(u) for u in touched_users])
+        ti = item_ix.to_indices([str(i) for i in touched_items])
+        cfg = FoldInConfig(
+            lam=p.lam, alpha=p.alpha, implicit_prefs=True, sweeps=2,
+            compute_dtype=p.compute_dtype or default_compute_dtype(),
+            sweep_chunk=p.sweep_chunk)
+        als = ALSModel(user_factors=model.user_factors,
+                       item_factors=model.item_factors_raw,
+                       rank=model.item_factors_raw.shape[1])
+        new_als, stats = fold_in_coo(als, coo, tu[tu >= 0], ti[ti >= 0],
+                                     cfg)
+        new_model = SimilarProductModel(
+            item_factors_normalized=normalize_rows(new_als.item_factors),
+            item_factors_raw=new_als.item_factors,
+            user_factors=new_als.user_factors, user_ix=user_ix,
+            **ItemMetadataModel.metadata_kwargs(td.items, item_ix))
+        report = {
+            "algorithm": type(self).__name__,
+            "loss": als_rmse(new_als, coo),
+            "userRows": stats.n_user_rows, "itemRows": stats.n_item_rows,
+            "newUsers": stats.n_new_users, "newItems": stats.n_new_items,
+            "wallS": stats.wall_s,
+        }
+        return new_model, report
 
     @staticmethod
     def _build_mask(model: SimilarProductModel, query: Query,
@@ -385,6 +465,23 @@ class LikeAlgorithm(ALSAlgorithm):
                                      policy="latest")
         return user_ix, item_ix, RatingsCOO(ui, ii, vals,
                                             len(user_ix), len(item_ix))
+
+    def _fold_users_present(self, td: TrainingData) -> set:
+        if td.like_events is None or not len(td.like_events):
+            return set()
+        return set(np.unique(td.like_events.users).astype(str))
+
+    def _fold_ratings(self, td: TrainingData, user_ix: EntityIdIxMap,
+                      item_ix: EntityIdIxMap) -> RatingsCOO:
+        likes = td.like_events
+        if likes is None or not len(likes):
+            raise ValueError("No like/dislike events to fold in")
+        ui = user_ix.to_indices_array(likes.users)
+        ii = item_ix.to_indices_array(likes.items)
+        keep = (ui >= 0) & (ii >= 0)
+        ui, ii, vals = dedup_ratings(ui[keep], ii[keep], likes.vals[keep],
+                                     likes.ts[keep], policy="latest")
+        return RatingsCOO(ui, ii, vals, len(user_ix), len(item_ix))
 
 
 @dataclass(frozen=True)

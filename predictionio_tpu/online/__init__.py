@@ -1,0 +1,31 @@
+"""Online model updates: the event->model loop without full retrains.
+
+Three pieces (ISSUE 1 tentpole; ALX arxiv 2112.02194 fold-in shape,
+DrJAX arxiv 2403.07128 streaming-aggregation motivation):
+
+  - ``fold_in``    — batched one-sided normal-equation solves for only the
+                     user/item rows touched by fresh events, reusing the
+                     bucketed batched solvers of ``ops/solve.py`` (explicit
+                     ALS-WR and implicit Hu-Koren paths).
+  - ``scheduler``  — a delta-training loop that tails the event store,
+                     accumulates per-entity deltas with the
+                     ``data/aggregator.py`` monoid machinery, triggers
+                     fold-in on staleness/count thresholds, and escalates
+                     to a full retrain when drift exceeds a bound.
+  - ``registry``   — a model-version registry layered on
+                     ``core/persistence.py`` so folded models publish as
+                     new COMPLETED engine instances the existing
+                     ``/reload`` hot-swap path picks up atomically.
+"""
+
+from predictionio_tpu.online.fold_in import (FoldInConfig, FoldInStats,
+                                             fold_in_coo, solve_rows)
+from predictionio_tpu.online.registry import ModelVersionRegistry
+from predictionio_tpu.online.scheduler import (DeltaTrainingScheduler,
+                                               EntityDelta, SchedulerConfig)
+
+__all__ = [
+    "FoldInConfig", "FoldInStats", "fold_in_coo", "solve_rows",
+    "ModelVersionRegistry",
+    "DeltaTrainingScheduler", "EntityDelta", "SchedulerConfig",
+]

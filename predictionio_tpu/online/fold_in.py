@@ -1,0 +1,222 @@
+"""Fold-in kernels: solve only the touched factor rows of a deployed model.
+
+The ALX observation (PAPERS.md, arxiv 2112.02194): the per-user least-
+squares step of ALS — solve (V_S^T C V_S + lam*n*I) x = V_S^T C r with the
+counterpart table FIXED — is exactly the bucketed batched-solve shape the
+training sweep already runs, so absorbing fresh events costs one
+mini-sweep over the touched entities instead of a full retrain. This
+module reuses the whole training stack for that mini-sweep: the
+ragged->fixed bucketing of ``ops/ratings.build_solve_plan``, the stacked
+device upload of ``ops/als._upload_plan``, the single-dispatch scan sweep
+``ops/als._solve_sweep`` and its backend-resolved solvers
+(``ops/solve.spd_solve`` — LAPACK cholesky on CPU, the VMEM-resident CG
+Pallas kernel on TPU).
+
+Math parity with the training sweep is by construction — both paths call
+the identical ``_solve_batch`` kernel:
+
+  explicit  — ALS-WR: x = argmin sum_S (r - x.v)^2 + lam * n |x|^2
+              (per-entity regularizer lam * n ratings, MLlib 1.3).
+  implicit  — Hu-Koren: (G + V_S^T (C_S - I) V_S + lam*n*I) x = V_S^T C_S p
+              with G = V^T V over the FULL counterpart table, computed once
+              per one-sided solve (the eig-SMW dual route applies
+              unchanged). Each side's solve within a sweep reads a
+              counterpart table the PREVIOUS side just updated, so the
+              Gram — and the counterpart upload — are per-solve costs by
+              necessity, not caching misses; keeping the carried tables
+              device-resident across sides is the noted future
+              optimization for tunnel-latency deployments.
+
+Exactness caveat: a folded row is the exact least-squares solution GIVEN
+the current counterpart factors; counterpart rows not in the touched set
+keep their deployed values, so the folded model is one Gauss-Seidel
+half-step from the retrain fixed point, not the fixed point itself. The
+scheduler's drift bound (fold-in loss vs anchor loss) decides when that
+gap has grown enough to warrant a real retrain.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.ops.als import (ALSConfig, ALSModel, _gram, _gram_eig,
+                                      _run_side, _upload_plan,
+                                      default_compute_dtype,
+                                      resolve_sweep_chunk)
+from predictionio_tpu.ops.ratings import RatingsCOO, build_solve_plan
+from predictionio_tpu.ops.solve import resolve_solver
+from predictionio_tpu.parallel.mesh import MeshContext, current_mesh, \
+    host_fetch
+
+
+@dataclass(frozen=True)
+class FoldInConfig:
+    """Hyperparameters of the touched-row solves. Defaults mirror
+    ``ops/als.ALSConfig`` so a fold-in against a model trained with
+    default params reproduces the training math exactly."""
+    lam: float = 0.01
+    implicit_prefs: bool = False
+    alpha: float = 1.0
+    lambda_scaling: str = "nratings"   # 'nratings' (ALS-WR) | 'constant'
+    solver: str = "auto"               # ops/solve.spd_solve methods
+    compute_dtype: Optional[str] = None  # None = bf16 on TPU, f32 on CPU
+    work_budget: int = 1 << 20
+    sweep_chunk: int = 0
+    bucket_ratio: float = 1.125
+    dual_solve: str = "auto"
+    solver_iters: Optional[int] = None
+    dual_iters_cap: Optional[int] = None
+    # one sweep = user side then item side. 2 sweeps let a brand-new
+    # (user, item) PAIR bootstrap: the first user-side solve sees only
+    # zero rows for a brand-new item, so its solution is refined once the
+    # item side has produced a real row.
+    sweeps: int = 1
+
+
+@dataclass
+class FoldInStats:
+    """What one fold-in call touched (exported by the serving counters)."""
+    n_user_rows: int = 0
+    n_item_rows: int = 0
+    n_new_users: int = 0
+    n_new_items: int = 0
+    nnz_user_side: int = 0
+    nnz_item_side: int = 0
+    sweeps: int = 0
+    wall_s: float = 0.0
+
+
+def _als_config(cfg: FoldInConfig, rank: int, solver: str) -> ALSConfig:
+    return ALSConfig(
+        rank=rank, iterations=1, lam=cfg.lam,
+        implicit_prefs=cfg.implicit_prefs, alpha=cfg.alpha,
+        lambda_scaling=cfg.lambda_scaling, solver=solver,
+        compute_dtype=cfg.compute_dtype or default_compute_dtype(),
+        work_budget=cfg.work_budget, sweep_chunk=cfg.sweep_chunk,
+        bucket_ratio=cfg.bucket_ratio, dual_solve=cfg.dual_solve,
+        solver_iters=cfg.solver_iters, dual_iters_cap=cfg.dual_iters_cap)
+
+
+def solve_rows(counter_factors: np.ndarray,
+               owner_compact: np.ndarray,
+               counter_idx: np.ndarray,
+               values: np.ndarray,
+               n_rows: int,
+               cfg: FoldInConfig,
+               mesh: Optional[MeshContext] = None) -> np.ndarray:
+    """One-sided normal-equation solve for ``n_rows`` entities.
+
+    ``owner_compact`` [nnz] holds compacted 0..n_rows-1 owner ids,
+    ``counter_idx``/``values`` the counterpart index and rating of each
+    entry. Returns the solved [n_rows, rank] float32 rows; rows with no
+    entries come back zero (callers keep the deployed row for those).
+
+    The whole call is the training half-sweep in miniature: bucketed
+    plan -> stacked upload -> one scan-sweep dispatch -> host fetch.
+    """
+    mesh = mesh or current_mesh()
+    counter_factors = np.ascontiguousarray(counter_factors,
+                                           dtype=np.float32)
+    rank = counter_factors.shape[1]
+    solver = resolve_solver(cfg.solver, mesh.n_devices)
+    plan = build_solve_plan(
+        np.asarray(owner_compact, dtype=np.int64),
+        np.asarray(counter_idx, dtype=np.int32),
+        np.asarray(values, dtype=np.float32),
+        n_rows, work_budget=cfg.work_budget,
+        batch_multiple=mesh.data_parallelism,
+        bucket_ratio=cfg.bucket_ratio)
+    if not plan.batches:
+        return np.zeros((n_rows, rank), dtype=np.float32)
+    chunk = resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
+    groups = _upload_plan(mesh, plan, chunk)
+    # +1 dummy tail row: the scatter target for batch padding (rows = -1)
+    out_dev = mesh.put_replicated(
+        np.zeros((n_rows + 1, rank), dtype=np.float32))
+    counter_dev = mesh.put_replicated(counter_factors)
+    als_cfg = _als_config(cfg, rank, solver)
+    gram = None
+    if cfg.implicit_prefs:
+        gram_of = _gram_eig if cfg.dual_solve == "auto" else _gram
+        gram = gram_of(counter_dev)
+    solved = _run_side(groups, out_dev, counter_dev, als_cfg, gram)
+    return np.asarray(host_fetch(solved)[:n_rows], dtype=np.float32)
+
+
+def _grown_table(table: np.ndarray, n_new: int) -> np.ndarray:
+    """Old rows keep their indices; appended rows start at zero (a zero
+    factor row scores 0 everywhere — inert until its first solve)."""
+    rank = table.shape[1]
+    out = np.zeros((n_new, rank), dtype=np.float32)
+    out[:table.shape[0]] = table
+    return out
+
+
+def _side(owner_idx: np.ndarray, counter_idx: np.ndarray,
+          values: np.ndarray, touched: np.ndarray,
+          counter_factors: np.ndarray, out_table: np.ndarray,
+          cfg: FoldInConfig, mesh: Optional[MeshContext]) -> Tuple[int, int]:
+    """Solve the ``touched`` rows of one side in place in ``out_table``.
+    Returns (rows_solved, nnz_consumed)."""
+    if touched.size == 0:
+        return 0, 0
+    sel = np.isin(owner_idx, touched)
+    nnz = int(np.count_nonzero(sel))
+    if nnz == 0:
+        return 0, 0
+    compact = np.searchsorted(touched, owner_idx[sel])
+    solved = solve_rows(counter_factors, compact, counter_idx[sel],
+                        values[sel], touched.size, cfg, mesh)
+    # only scatter rows that actually had data: a touched entity whose
+    # entries all vanished (e.g. deleted events) keeps its deployed row
+    # rather than being zeroed
+    has_data = np.bincount(compact, minlength=touched.size) > 0
+    out_table[touched[has_data]] = solved[has_data]
+    return int(np.count_nonzero(has_data)), nnz
+
+
+def fold_in_coo(als: ALSModel, coo: RatingsCOO,
+                touched_users: Sequence[int],
+                touched_items: Sequence[int],
+                cfg: FoldInConfig,
+                mesh: Optional[MeshContext] = None
+                ) -> Tuple[ALSModel, FoldInStats]:
+    """Fold fresh data into a trained model: re-solve only the touched
+    user/item rows against ``coo`` (the CURRENT deduped dataset, whose
+    touched rows/columns must be complete — the solve is least-squares
+    over whatever it is given, so partial histories produce rows biased
+    to the fresh slice).
+
+    ``coo.n_users``/``coo.n_items`` may exceed the model's (grown
+    vocabularies): new rows are appended zero-initialized and solved when
+    touched, so existing dense indices — and the deployed factor rows
+    behind them — never move.
+    """
+    t0 = time.perf_counter()
+    rank = als.rank
+    n_users = max(coo.n_users, als.n_users)
+    n_items = max(coo.n_items, als.n_items)
+    U = _grown_table(als.user_factors, n_users)
+    V = _grown_table(als.item_factors, n_items)
+    tu = np.unique(np.asarray(touched_users, dtype=np.int64))
+    ti = np.unique(np.asarray(touched_items, dtype=np.int64))
+    stats = FoldInStats(
+        n_new_users=n_users - als.n_users,
+        n_new_items=n_items - als.n_items)
+    sweeps = max(1, int(cfg.sweeps))
+    for _ in range(sweeps):
+        nu, zu = _side(coo.user_idx, coo.item_idx, coo.rating, tu, V, U,
+                       cfg, mesh)
+        ni, zi = _side(coo.item_idx, coo.user_idx, coo.rating, ti, U, V,
+                       cfg, mesh)
+        stats.n_user_rows += nu
+        stats.n_item_rows += ni
+        stats.nnz_user_side += zu
+        stats.nnz_item_side += zi
+        stats.sweeps += 1
+    stats.wall_s = time.perf_counter() - t0
+    return ALSModel(user_factors=U, item_factors=V, rank=rank), stats

@@ -1,0 +1,98 @@
+"""Model-version registry: publish folded models as first-class versions.
+
+Layered on the existing persistence stack (``core/persistence.py`` decides
+automatic-pickle vs manifest vs retrain per algorithm; ``Models``/
+``EngineInstances`` DAOs store the blobs and the lineage): each publish
+clones the serving engine instance into a NEW row tagged as an online
+update, serializes the updated models through the same
+``make_serializable_models`` path a training run uses, and marks it
+COMPLETED — which makes the EXISTING hot-swap machinery
+(``get_latest_completed`` + ``/reload``) pick it up with no new wire
+protocol. Version history is ordinary engine-instance history: every
+fold-in survives restarts, `pio status` shows it, and rolling back is
+"deploy the previous instance id".
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+from typing import Any, List, Optional, Sequence
+
+from predictionio_tpu.data.storage.base import EngineInstance, Model
+from predictionio_tpu.data.storage.registry import Storage
+
+logger = logging.getLogger(__name__)
+
+# batch tag marking instances produced by the online path (vs `pio train`)
+ONLINE_BATCH_TAG = "online-fold-in"
+
+
+class ModelVersionRegistry:
+    """Versioned model publish/list/rollback over the metadata DAOs."""
+
+    def __init__(self, instances=None, models=None):
+        self._instances = instances
+        self._models = models
+
+    @property
+    def instances(self):
+        return self._instances or Storage.get_meta_data_engine_instances()
+
+    @property
+    def models(self):
+        return self._models or Storage.get_model_data_models()
+
+    def publish(self, engine, engine_params, base_instance: EngineInstance,
+                models: Sequence[Any], meta: Optional[dict] = None) -> str:
+        """Persist ``models`` as a new COMPLETED version derived from
+        ``base_instance``. Returns the new instance id.
+
+        The models go through the engine's standard serialization pipeline
+        (PersistentModel manifests included), so a folded mesh-sharded
+        model checkpoints exactly like a trained one."""
+        now = _dt.datetime.now(_dt.timezone.utc)
+        lineage = dict(meta or {})
+        lineage["baseInstance"] = base_instance.id
+        instance = base_instance.with_(
+            id="", status="INIT", start_time=now, end_time=now,
+            batch=f"{ONLINE_BATCH_TAG}:{json.dumps(lineage, sort_keys=True)}")
+        instance_id = self.instances.insert(instance)
+        instance = self.instances.get(instance_id)
+        try:
+            from predictionio_tpu.core.engine import TrainResult
+            result = TrainResult(
+                models=list(models),
+                algorithms=engine.make_algorithms(engine_params))
+            serializable = engine.make_serializable_models(
+                result, instance_id, engine_params)
+            blob = engine.serialize_models(serializable)
+            self.models.insert(Model(instance_id, blob))
+        except Exception:
+            # mirror run_train's failure bookkeeping: never leave an
+            # INIT row behind (the scheduler retries every tick, and an
+            # orphan per retry would pollute instance history forever)
+            self.instances.update(instance.with_(
+                status="ABORTED",
+                end_time=_dt.datetime.now(_dt.timezone.utc)))
+            raise
+        self.instances.update(instance.with_(
+            status="COMPLETED",
+            end_time=_dt.datetime.now(_dt.timezone.utc)))
+        logger.info("Published online model version %s (base %s)",
+                    instance_id, base_instance.id)
+        return instance_id
+
+    def versions(self, engine_id: str, engine_version: str,
+                 engine_variant: str) -> List[EngineInstance]:
+        """COMPLETED instances for one engine, newest first — training runs
+        and online versions interleaved in publish order."""
+        return self.instances.get_completed(engine_id, engine_version,
+                                            engine_variant)
+
+    def online_versions(self, engine_id: str, engine_version: str,
+                        engine_variant: str) -> List[EngineInstance]:
+        return [i for i in self.versions(engine_id, engine_version,
+                                         engine_variant)
+                if i.batch.startswith(ONLINE_BATCH_TAG)]

@@ -335,49 +335,77 @@ def _solve_sweep_impl(factors_out, counter_factors, gram, groups, lam,
     return factors_out
 
 
-@functools.partial(
-    __import__("jax").jit,
-    static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
-                     "solver", "dual_solve", "solver_iters",
-                     "dual_iters_cap"),
-    donate_argnums=(0,))
-def _solve_sweep(factors_out, counter_factors, gram, groups, lam, alpha, *,
-                 nratings_reg: bool, implicit: bool, rank: int,
-                 compute_dtype: str, solver: str, dual_solve: str = "auto",
-                 solver_iters: Optional[int] = None,
-                 dual_iters_cap: Optional[int] = None):
-    """One half-iteration in ONE dispatch: `groups` is a tuple of stacked
-    same-shape batch groups (rows [N,B], idx/val/mask [N,B,K]); each group
-    is consumed by a `lax.scan` over its leading dim, carrying the donated
-    factor table through every scatter. Collapses the previous ~45
-    dispatches per half-sweep (each with fresh host scalars over a ~65 ms
-    tunnel round-trip) to a single device program, and the per-bucket
-    compile count to one program per plan signature."""
-    return _solve_sweep_impl(
-        factors_out, counter_factors, gram, groups, lam, alpha,
-        nratings_reg=nratings_reg, implicit=implicit, rank=rank,
-        compute_dtype=compute_dtype, solver=solver, dual_solve=dual_solve,
-        solver_iters=solver_iters, dual_iters_cap=dual_iters_cap)
+def _donation_safe() -> bool:
+    """Donating the carried factor table saves an HBM copy per sweep on
+    accelerators, but on multi-device CPU (the 8-fake-device test mesh)
+    older jaxlib releases corrupt the allocator under donated multi-shard
+    buffers (observed: 'corrupted double-linked list' segfaults mid-
+    suite on jaxlib 0.4.x). Donation is purely a memory optimization, so
+    restrict it to non-CPU backends."""
+    import jax
+    return jax.default_backend() != "cpu"
 
 
-@functools.partial(
-    __import__("jax").jit,
-    static_argnames=("nratings_reg", "implicit", "rank", "compute_dtype",
-                     "solver", "dual_solve", "solver_iters",
-                     "dual_iters_cap", "n_users", "n_items"),
-    donate_argnums=(0, 1))
-def _solve_iteration(U, V, user_groups, item_groups, lam, alpha, *,
-                     nratings_reg: bool, implicit: bool, rank: int,
-                     compute_dtype: str, solver: str,
-                     dual_solve: str = "auto",
-                     solver_iters: Optional[int] = None,
-                     dual_iters_cap: Optional[int] = None,
-                     n_users: int = 0, n_items: int = 0):
-    """One FULL iteration (user sweep then item sweep, plus the implicit
-    Grams) traced as a single program: the half-sweeps are data-dependent
-    (the item sweep reads the just-updated U), but fusing them lets XLA
-    prefetch the item side's gather DMAs behind the tail of the user
-    side's solves and drops a host dispatch boundary per iteration."""
+_SWEEP_STATICS = ("nratings_reg", "implicit", "rank", "compute_dtype",
+                  "solver", "dual_solve", "solver_iters", "dual_iters_cap")
+_ITER_STATICS = _SWEEP_STATICS + ("n_users", "n_items")
+_jitted = {}
+
+
+def _jitted_sweep():
+    key = ("sweep", _donation_safe())
+    fn = _jitted.get(key)
+    if fn is None:
+        import jax
+        fn = jax.jit(_solve_sweep_impl, static_argnames=_SWEEP_STATICS,
+                     donate_argnums=(0,) if key[1] else ())
+        _jitted[key] = fn
+    return fn
+
+
+def _jitted_iteration():
+    key = ("iteration", _donation_safe())
+    fn = _jitted.get(key)
+    if fn is None:
+        import jax
+        fn = jax.jit(_solve_iteration_impl, static_argnames=_ITER_STATICS,
+                     donate_argnums=(0, 1) if key[1] else ())
+        _jitted[key] = fn
+    return fn
+
+
+class _JitProxy:
+    """Defers jit construction to call time (donation depends on the
+    backend, unknown at import) while keeping the jitted-function surface
+    (`lower`, `trace`, ...) callers like the collective-stats tests use."""
+
+    def __init__(self, factory):
+        self._factory = factory
+
+    def __call__(self, *a, **kw):
+        return self._factory()(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._factory(), name)
+
+
+#: One half-iteration in ONE dispatch: `groups` is a tuple of stacked
+#: same-shape batch groups (rows [N,B], idx/val/mask [N,B,K]); each group
+#: is consumed by a `lax.scan` over its leading dim, carrying the (on
+#: accelerators, donated) factor table through every scatter. Collapses
+#: the previous ~45 dispatches per half-sweep (each with fresh host
+#: scalars over a ~65 ms tunnel round-trip) to a single device program,
+#: and the per-bucket compile count to one program per plan signature.
+_solve_sweep = _JitProxy(_jitted_sweep)
+
+
+def _solve_iteration_impl(U, V, user_groups, item_groups, lam, alpha, *,
+                          nratings_reg: bool, implicit: bool, rank: int,
+                          compute_dtype: str, solver: str,
+                          dual_solve: str = "auto",
+                          solver_iters: Optional[int] = None,
+                          dual_iters_cap: Optional[int] = None,
+                          n_users: int = 0, n_items: int = 0):
     gram_of = _gram_eig_impl if dual_solve == "auto" else _gram_impl
     gram_v = gram_of(V[:n_items]) if implicit else None
     U = _solve_sweep_impl(
@@ -392,6 +420,14 @@ def _solve_iteration(U, V, user_groups, item_groups, lam, alpha, *,
         solver=solver, dual_solve=dual_solve, solver_iters=solver_iters,
         dual_iters_cap=dual_iters_cap)
     return U, V
+
+
+#: One FULL iteration (user sweep then item sweep, plus the implicit
+#: Grams) traced as a single program: the half-sweeps are data-dependent
+#: (the item sweep reads the just-updated U), but fusing them lets XLA
+#: prefetch the item side's gather DMAs behind the tail of the user
+#: side's solves and drops a host dispatch boundary per iteration.
+_solve_iteration = _JitProxy(_jitted_iteration)
 
 
 def _gram_impl(factors):

@@ -29,7 +29,12 @@ def sharded_top_k(item_factors_sharded, query_vec, k: int,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    try:
+        from jax import shard_map
+        _vma_kw = {"check_vma": False}
+    except ImportError:   # jax < 0.5 spelling (and check_rep keyword)
+        from jax.experimental.shard_map import shard_map
+        _vma_kw = {"check_rep": False}
     from jax.sharding import PartitionSpec as P
 
     mesh = mesh or current_mesh()
@@ -47,7 +52,7 @@ def sharded_top_k(item_factors_sharded, query_vec, k: int,
         shard_map, mesh=mesh.mesh,
         in_specs=(P("model", None), P(), P("model")),
         out_specs=(P(), P()),
-        check_vma=False)
+        **_vma_kw)
     def _local_then_global(v_shard, q, mask_shard):
         scores = jnp.einsum("ir,r->i", v_shard, q,
                             preferred_element_type=jnp.float32)

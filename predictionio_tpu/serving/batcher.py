@@ -71,6 +71,25 @@ class MicroBatcher:
         # saturated load; (batches - immediateBatches) is the number of
         # dispatches that actually waited for a straggler
         self.n_immediate = 0
+        # WHY each dispatch closed its batch — the attribution data for a
+        # realized avg batch below micro_batch under concurrent load
+        # (e.g. the pinned serve_avg_batch_size=8.0 at micro_batch=16):
+        #   exitFullBatch   — hit max_batch (device-bound; raising
+        #                     micro_batch could coalesce more)
+        #   exitDrainGate   — queue empty and inflight <= batch: the
+        #                     CLIENT POOL was the limit (every submitted-
+        #                     unanswered query is already in this batch —
+        #                     with N closed-loop clients the steady-state
+        #                     batch is at most N no matter the window)
+        #   exitWindow      — the hold expired waiting on a counted
+        #                     straggler (max_wait_ms / latency budget
+        #                     bound; raising the window could help)
+        self.n_exit_full = 0
+        self.n_exit_drain_gate = 0
+        self.n_exit_window = 0
+        # sum of inflight observed at dispatch: avg inflight is the
+        # effective concurrent-client count the batcher actually saw
+        self.inflight_at_dispatch_sum = 0
         # queries submitted and not yet answered — the adaptive window's
         # signal: hold only while the batch is smaller than this
         self._inflight = 0
@@ -91,7 +110,12 @@ class MicroBatcher:
         return {"batches": nb, "batchedQueries": nq,
                 "avgBatchSize": (nq / nb if nb else 0.0),
                 "maxBatchSize": mx,
-                "immediateBatches": self.n_immediate}
+                "immediateBatches": self.n_immediate,
+                "exitFullBatch": self.n_exit_full,
+                "exitDrainGate": self.n_exit_drain_gate,
+                "exitWindow": self.n_exit_window,
+                "avgInflightAtDispatch": (
+                    self.inflight_at_dispatch_sum / nb if nb else 0.0)}
 
     def submit(self, query) -> Any:
         """Blocking: enqueue and wait for the batched result."""
@@ -127,6 +151,7 @@ class MicroBatcher:
             # with zero window cost. max_wait bounds the hold in case a
             # counted straggler stalls before reaching the queue.
             held = False
+            exit_reason = "full"   # loop falls through => max_batch hit
             deadline = time.perf_counter() + self.max_wait_s
             if self.latency_budget_s is not None:
                 # cap the oldest query's time in the coalescing stage
@@ -139,18 +164,28 @@ class MicroBatcher:
                 except queue.Empty:
                     pass
                 if self._inflight <= len(batch):
+                    exit_reason = "drain_gate"
                     break          # nobody else known in flight
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
+                    exit_reason = "window"
                     break
                 held = True
                 try:
                     batch.append(self._q.get(timeout=remaining))
                 except queue.Empty:
+                    exit_reason = "window"
                     break
             self.n_batches += 1
             self.n_queries += len(batch)
             self.max_batch_seen = max(self.max_batch_seen, len(batch))
+            self.inflight_at_dispatch_sum += self._inflight
+            if exit_reason == "full":
+                self.n_exit_full += 1
+            elif exit_reason == "drain_gate":
+                self.n_exit_drain_gate += 1
+            else:
+                self.n_exit_window += 1
             if not held:
                 self.n_immediate += 1
             try:

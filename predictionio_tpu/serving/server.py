@@ -116,6 +116,13 @@ class EngineServer:
         # in /stats.json); 4096 samples bounds memory and keeps the
         # percentiles a rolling view of recent traffic
         self._lat_ring = collections.deque(maxlen=4096)
+        # online-update counters (ISSUE 1 hot-swap observability): every
+        # model replacement after the initial load counts as a swap —
+        # /reload instance swaps and in-process fold-in swaps alike
+        self.swap_count = 0
+        self.fold_in_count = 0
+        self.fold_in_events = 0
+        self.model_version: Optional[str] = None
         self.start_time = utcnow()
         self.server: Optional[HttpServer] = None
         self.batcher = None
@@ -173,13 +180,40 @@ class EngineServer:
             persisted = self.engine.deserialize_models(model.models)
             result = self.engine.prepare_deploy(
                 self.engine_params, persisted, instance.id)
+            was_loaded = bool(self.algorithms)
             self.engine_instance = instance
             self.algorithms = result.algorithms
             self.models = result.models
             self.serving = self.engine.make_serving(self.engine_params)
+            self.model_version = instance.id
+            if was_loaded:
+                self.swap_count += 1  # /reload hot-swap, not first load
             logger.info("Engine instance %s loaded (%d algorithm(s))",
                         instance.id, len(self.algorithms))
         return self
+
+    def swap_models(self, models, version: Optional[str] = None,
+                    fold_in_events: int = 0):
+        """Atomic in-process hot-swap (the fold-in publish path): replace
+        the whole model list under the serving lock so no query ever sees
+        a mixed-version set. The query paths snapshot (algorithms, models,
+        serving) under the same lock, and fold-in produces NEW model
+        objects rather than mutating deployed ones — both halves of the
+        no-torn-read guarantee."""
+        models = list(models)
+        if len(models) != len(self.algorithms):
+            raise ValueError(
+                f"swap_models got {len(models)} models for "
+                f"{len(self.algorithms)} algorithms")
+        with self._lock:
+            self.models = models
+            self.swap_count += 1
+            self.fold_in_count += 1
+            self.fold_in_events += int(fold_in_events)
+            if version is not None:
+                self.model_version = version
+        logger.info("Hot-swapped models (swap #%d, version %s)",
+                    self.swap_count, version or "<in-process>")
 
     # -- query path (ServerActor.myRoute /queries.json, :490-641) ----------
     def handle_query(self, query_dict: dict) -> dict:
@@ -408,6 +442,13 @@ class EngineServer:
                 "avgPredictSec": self.predict_seconds / n if n else 0.0,
                 "microBatch": self.config.micro_batch,
                 "startTime": self.start_time.isoformat(),
+                # online-update observability (ISSUE 1): how many times
+                # the serving models were hot-swapped, how many fold-ins
+                # landed, and which version answers queries right now
+                "modelSwaps": self.swap_count,
+                "foldIns": self.fold_in_count,
+                "foldInEvents": self.fold_in_events,
+                "modelVersion": self.model_version,
             }
             pct = self._ring_percentiles()
             if pct is not None:
@@ -454,6 +495,15 @@ class EngineServer:
                 ("pio_engine_predict_seconds_total", "counter",
                  "Cumulative device/predict time",
                  [(None, self.predict_seconds)]),
+                ("pio_engine_model_swaps_total", "counter",
+                 "Hot model swaps since start (reloads + fold-ins)",
+                 [(None, self.swap_count)]),
+                ("pio_engine_fold_ins_total", "counter",
+                 "Online fold-in swaps since start",
+                 [(None, self.fold_in_count)]),
+                ("pio_engine_fold_in_events_total", "counter",
+                 "Events absorbed by online fold-ins",
+                 [(None, self.fold_in_events)]),
             ]
             pct = self._ring_percentiles()
             if pct is not None:
@@ -474,6 +524,18 @@ class EngineServer:
                  [(None, b["immediateBatches"])]),
                 ("pio_engine_max_batch_size", "gauge",
                  "Largest coalesced batch", [(None, b["maxBatchSize"])]),
+                ("pio_engine_batch_exits_total", "counter",
+                 "Why each dispatch closed its batch (attributes a "
+                 "sub-micro_batch realized batch size: drain_gate = "
+                 "client pool was the limit, window = straggler hold "
+                 "expired, full = max_batch hit)",
+                 [({"reason": "full"}, b["exitFullBatch"]),
+                  ({"reason": "drain_gate"}, b["exitDrainGate"]),
+                  ({"reason": "window"}, b["exitWindow"])]),
+                ("pio_engine_avg_inflight_at_dispatch", "gauge",
+                 "Mean submitted-unanswered queries at dispatch (the "
+                 "effective concurrent-client count)",
+                 [(None, round(b["avgInflightAtDispatch"], 3))]),
             ]
         if self.coordinator is not None:
             h = self.coordinator.health()

@@ -235,6 +235,80 @@ def cmd_deploy(args) -> int:
     return _serve_foreground(server, "engine server")
 
 
+def cmd_update(args) -> int:
+    """`pio update [--follow]` — attach the delta-training scheduler to a
+    deployed engine (ISSUE 1 L6): tail the event store, fold fresh events
+    into the served model, publish each folded version through the
+    model-version registry, and POST /reload so the deployed server
+    hot-swaps it. One-shot by default (a single forced tick); --follow
+    loops until SIGINT."""
+    import json as _json
+    import time
+    from predictionio_tpu.online import (DeltaTrainingScheduler,
+                                         ModelVersionRegistry,
+                                         SchedulerConfig)
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+
+    # resolve engine + latest model exactly like deploy does, without
+    # starting an HTTP frontend (EngineServer is the loader)
+    loader = EngineServer(ServerConfig(
+        ip="127.0.0.1", port=0,
+        engine_id=args.engine_id or "default",
+        engine_version=args.engine_version or "0",
+        engine_variant=args.engine_json,
+        micro_batch=0))
+    loader.load()
+    _, ds_params = loader.engine_params.data_source_params
+    app_name = args.app_name or getattr(ds_params, "app_name", None)
+    if not app_name:
+        _print("No app name: pass --app-name or set it in the variant's "
+               "datasource params.")
+        return 1
+    config = SchedulerConfig(
+        app_name=app_name,
+        channel_name=getattr(ds_params, "channel_name", None),
+        max_deltas=args.max_deltas,
+        max_staleness_s=args.max_staleness,
+        drift_ratio=args.drift_ratio,
+        poll_interval_s=args.interval)
+    reload_url = (f"http://{args.engine_ip}:{args.engine_port}/reload"
+                  if args.engine_port else None)
+    sched = DeltaTrainingScheduler(
+        engine=loader.engine, engine_params=loader.engine_params,
+        instance=loader.engine_instance, algorithms=loader.algorithms,
+        models=loader.models, config=config,
+        registry=ModelVersionRegistry(), reload_url=reload_url)
+    if not args.follow:
+        report = sched.tick(force=True)
+        _print(_json.dumps(report or {"message": "no fresh events"}))
+        return 0
+    _print(f"Following app {app_name!r} (fold at {config.max_deltas} "
+           f"deltas or {config.max_staleness_s:g}s staleness; ^C stops).")
+    import logging as _logging
+    try:
+        while True:
+            try:
+                report = sched.tick()
+            except Exception:
+                # transient tick failure (storage hiccup, solve error):
+                # fold_in already restored its deltas for retry — the
+                # follower must keep following, not die with a traceback
+                _logging.getLogger(__name__).exception(
+                    "update tick failed; retrying next interval")
+                report = None
+            if report:
+                _print(_json.dumps(report))
+            if sched.retrain_requested:
+                _print("Drift bound exceeded — run `pio train` + "
+                       "redeploy, then restart `pio update --follow`.")
+                return 2
+            time.sleep(config.poll_interval_s)
+    except KeyboardInterrupt:
+        _print("Stopped.")
+        _print(_json.dumps(sched.stats()))
+        return 0
+
+
 def cmd_undeploy(args) -> int:
     """(Console undeploy — POST /stop to the deployed server)"""
     url = f"http://{args.ip}:{args.port}/stop"
@@ -598,6 +672,35 @@ def build_parser() -> argparse.ArgumentParser:
     u.add_argument("--ip", default="127.0.0.1")
     u.add_argument("--port", type=int, default=8000)
     u.set_defaults(func=cmd_undeploy)
+
+    upd = sub.add_parser(
+        "update", help="online model updates: tail the event store, fold "
+        "fresh events into the deployed model, publish versions, and "
+        "/reload the serving process (ISSUE 1 delta-training)")
+    _add_variant_arg(upd)
+    upd.add_argument("--engine-id")
+    upd.add_argument("--engine-version")
+    upd.add_argument("--app-name",
+                     help="event app (default: the variant's datasource "
+                          "app_name)")
+    upd.add_argument("--engine-ip", default="127.0.0.1",
+                     help="deployed engine server to POST /reload to")
+    upd.add_argument("--engine-port", type=int, default=8000,
+                     help="deployed engine server port (0 = publish "
+                          "only, no reload)")
+    upd.add_argument("--follow", action="store_true",
+                     help="keep tailing until ^C (default: one forced "
+                          "fold-in tick)")
+    upd.add_argument("--interval", type=float, default=2.0,
+                     help="--follow poll cadence seconds")
+    upd.add_argument("--max-deltas", type=int, default=256,
+                     help="fold in after this many fresh events")
+    upd.add_argument("--max-staleness", type=float, default=30.0,
+                     help="... or once the oldest delta is this old (s)")
+    upd.add_argument("--drift-ratio", type=float, default=1.5,
+                     help="fold loss / anchor loss bound that escalates "
+                          "to a full retrain")
+    upd.set_defaults(func=cmd_update)
 
     ev = sub.add_parser("eventserver")
     ev.add_argument("--ip", default="0.0.0.0")

@@ -21,7 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # jax >= 0.5 spelling; on older jax the XLA_FLAGS fallback above
+    # (set before the first jax import) already provides the 8 devices
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 import pytest  # noqa: E402
 

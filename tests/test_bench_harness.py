@@ -39,11 +39,16 @@ class TestMathParityHarness:
         both trainers, held-out split, artifact written, parity holds.
         (At toy scale the two paths track each other just as they do at
         rank 200 — see the committed MATH_PARITY.json for the real run.)
-        """
+
+        rank must be >= 16 so the dualcap variant's scaled-down cap
+        (rank // 2 = 8) actually BINDS a dual-route solve: the Woodbury
+        branch needs K < rank and the bucket ladder's minimum K is 8, so
+        at the old rank 8 the dual route never fired and a regressed cap
+        passed unnoticed (ADVICE round-5 item 1)."""
         out = tmp_path / "parity.json"
         rc = bench.math_parity_report(
             out_path=str(out), iters=2,
-            n_users=400, n_items=150, nnz=20_000, rank=8)
+            n_users=400, n_items=150, nnz=20_000, rank=16)
         d = json.loads(out.read_text())
         assert d["artifact"] == "rank200_math_parity"
         assert set(d["results"]) == {"mllib_shaped_float64",
@@ -57,6 +62,80 @@ class TestMathParityHarness:
         # the held-out RMSEs must be in the same ballpark even at toy
         # scale; rc encodes the tolerance verdict
         assert rc == 0 and d["parity_ok"] is True
+
+
+class TestFallbackArtifactGuard:
+    """A dead-tunnel CPU-fallback run must NEVER replace a banked TPU
+    BENCH_r*.json (round-5 failure: the round artifact became a labeled
+    CPU fallback) — fallback output goes to a side file, and the note
+    cites whatever is ACTUALLY banked at run time instead of a
+    hardcoded artifact name/number."""
+
+    TPU_ARTIFACT = {
+        "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
+        "value": 14723561.6, "unit": "ratings/s/chip",
+        "backend": "tpu", "full_scale": True,
+        "train_s_per_iteration": 1.3584}
+
+    def _bank(self, root, name="BENCH_r06.json", d=None):
+        p = root / name
+        p.write_text(json.dumps(d or self.TPU_ARTIFACT) + "\n")
+        return p
+
+    def test_banked_scan_finds_valid_tpu_artifact(self, tmp_path):
+        self._bank(tmp_path)
+        # decoys that must NOT be picked: CPU fallback, errored run,
+        # driver wrapper with no parsed dict
+        (tmp_path / "BENCH_r07.json").write_text(json.dumps(
+            {"backend": "cpu", "full_scale": False, "value": 1.0}))
+        (tmp_path / "BENCH_r08.json").write_text(json.dumps(
+            {"backend": "tpu", "full_scale": True, "value": 2.0,
+             "error": "stalled"}))
+        (tmp_path / "BENCH_r09.json").write_text(json.dumps(
+            {"n": 9, "cmd": "python bench.py", "rc": 0,
+             "tail": "...", "parsed": None}))
+        path, d = bench.banked_tpu_artifact(str(tmp_path))
+        assert path.endswith("BENCH_r06.json")
+        assert d["train_s_per_iteration"] == 1.3584
+
+    def test_banked_scan_reads_driver_wrapper_parsed(self, tmp_path):
+        self._bank(tmp_path, "BENCH_r03.json",
+                   {"n": 3, "cmd": "python bench.py", "rc": 0, "tail": "",
+                    "parsed": self.TPU_ARTIFACT})
+        path, d = bench.banked_tpu_artifact(str(tmp_path))
+        assert path.endswith("BENCH_r03.json") and d["backend"] == "tpu"
+
+    def test_fallback_note_resolves_banked_artifact_at_runtime(
+            self, tmp_path):
+        note_empty = bench.fallback_note(str(tmp_path))
+        assert "No valid banked TPU artifact" in note_empty
+        assert "docs/operations.md" in note_empty
+        self._bank(tmp_path, "BENCH_r11.json",
+                   dict(self.TPU_ARTIFACT, train_s_per_iteration=0.97))
+        note = bench.fallback_note(str(tmp_path))
+        # cites the CURRENT banked artifact, not a stale hardcoded one
+        assert "BENCH_r11.json" in note and "0.97" in note
+        assert "1.3584" not in note
+
+    def test_dead_tunnel_leaves_banked_tpu_artifact_byte_identical(
+            self, tmp_path, monkeypatch):
+        """The acceptance regression: the fallback emission path writes
+        only the side file; an existing valid TPU BENCH_r*.json stays
+        byte-identical."""
+        banked = self._bank(tmp_path)
+        before = banked.read_bytes()
+        monkeypatch.setenv("PIO_BENCH_ROOT", str(tmp_path))
+        out = {"metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
+               "value": 123.4, "backend": "cpu", "full_scale": False,
+               "note": bench.fallback_note()}
+        side = bench.divert_fallback_output(out)
+        assert banked.read_bytes() == before
+        assert side.endswith("BENCH_cpu_fallback.json")
+        d = json.loads((tmp_path / "BENCH_cpu_fallback.json").read_text())
+        assert d["backend"] == "cpu" and "BENCH_r06.json" in d["note"]
+        # the side artifact itself never qualifies as banked-TPU
+        path, _ = bench.banked_tpu_artifact(str(tmp_path))
+        assert path.endswith("BENCH_r06.json")
 
 
 class TestStallSalvage:

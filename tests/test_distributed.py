@@ -13,10 +13,15 @@ import pytest
 PROG = textwrap.dedent("""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4").strip()
 sys.path.insert(0, %(repo)r)
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass  # jax < 0.5: XLA_FLAGS above already provides the devices
 from predictionio_tpu.parallel.mesh import init_distributed, make_mesh
 from predictionio_tpu.parallel.dataset import sharded_from_process_local
 import numpy as np
@@ -35,10 +40,15 @@ print(f"OK proc {pid}")
 ALS_PROG = textwrap.dedent("""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4").strip()
 sys.path.insert(0, %(repo)r)
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass  # jax < 0.5: XLA_FLAGS above already provides the devices
 from predictionio_tpu.parallel.mesh import init_distributed, make_mesh
 import numpy as np
 init_distributed()
@@ -67,10 +77,15 @@ print(f"OK proc {pid}")
 SERVE_PROG = textwrap.dedent("""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4").strip()
 sys.path.insert(0, %(repo)r)
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass  # jax < 0.5: XLA_FLAGS above already provides the devices
 from predictionio_tpu.parallel.mesh import init_distributed, make_mesh
 import numpy as np
 init_distributed()
@@ -96,10 +111,15 @@ print(f"OK proc {pid}")
 HTTP_SERVE_PROG = textwrap.dedent("""
 import os, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4").strip()
 sys.path.insert(0, %(repo)r)
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass  # jax < 0.5: XLA_FLAGS above already provides the devices
 import numpy as np
 from predictionio_tpu.parallel.mesh import init_distributed, make_mesh, \\
     use_mesh
