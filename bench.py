@@ -796,6 +796,14 @@ def bench_ingest(full_scale: bool):
                     rate_batch, 1)
                 out[f"ingest_events_per_sec_concurrent8_{backend}"] = \
                     round(rate_conc, 1)
+                # registry-derived write-latency percentiles (ISSUE 2):
+                # per-server histogram, so per-backend isolation is free
+                wh = server.metrics.get("pio_event_write_seconds")
+                if wh is not None and wh.count:
+                    out[f"ingest_write_p50_ms_{backend}"] = round(
+                        (wh.percentile(50) or 0.0) * 1000, 4)
+                    out[f"ingest_write_p99_ms_{backend}"] = round(
+                        (wh.percentile(99) or 0.0) * 1000, 4)
             finally:
                 if server is not None:
                     server.stop()
@@ -856,6 +864,11 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
         # warmup (first call compiles the serve kernel on-device)
         for u in users[:10]:
             client.post({"user": str(int(u)), "num": 10}, timeout=600)
+        # registry-histogram window marker: percentiles derived below
+        # must cover the TIMED traffic only, not the compile-dominated
+        # warmup observations already in the cumulative buckets
+        q_hist = server.metrics.get("pio_engine_query_seconds")
+        q_hist_pre = q_hist.bucket_counts()
         lat = []
         for u in users:
             t0 = time.perf_counter()
@@ -894,20 +907,37 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
         d_q = (stats.get("batchedQueries", 0)
                - pre.get("batchedQueries", 0))
         d_b = stats.get("batches", 0) - pre.get("batches", 0)
-        return {"p50_ms": float(np.percentile(lat, 50) * 1000),
-                "p95_ms": float(np.percentile(lat, 95) * 1000),
-                "p99_ms": float(np.percentile(lat, 99) * 1000),
-                "qps_serial": float(1.0 / lat.mean()),
-                "qps_concurrent16": float(np.median(qps_reps)),
-                "qps_concurrent16_min": float(min(qps_reps)),
-                "qps_concurrent16_max": float(max(qps_reps)),
-                "server_avg_total_ms": stats["avgServingSec"] * 1000,
-                "server_avg_predict_ms": stats["avgPredictSec"] * 1000,
-                # realized coalescing DURING the timed bursts — the
-                # datum for tuning micro_batch_wait_ms
-                "serve_avg_batch_size": (d_q / d_b if d_b else 0.0),
-                "serve_max_batch_size": float(
-                    stats.get("maxBatchSize", 0))}
+        out = {"p50_ms": float(np.percentile(lat, 50) * 1000),
+               "p95_ms": float(np.percentile(lat, 95) * 1000),
+               "p99_ms": float(np.percentile(lat, 99) * 1000),
+               "qps_serial": float(1.0 / lat.mean()),
+               "qps_concurrent16": float(np.median(qps_reps)),
+               "qps_concurrent16_min": float(min(qps_reps)),
+               "qps_concurrent16_max": float(max(qps_reps)),
+               "server_avg_total_ms": stats["avgServingSec"] * 1000,
+               "server_avg_predict_ms": stats["avgPredictSec"] * 1000,
+               # realized coalescing DURING the timed bursts — the
+               # datum for tuning micro_batch_wait_ms
+               "serve_avg_batch_size": (d_q / d_b if d_b else 0.0),
+               "serve_max_batch_size": float(
+                   stats.get("maxBatchSize", 0))}
+        # registry-derived per-phase percentiles (ISSUE 2): the same
+        # bucketed histograms /metrics scrapes, in place of further
+        # ad-hoc min/mean keys. Additive — the schema above is stable.
+        # Windowed from the post-warmup marker so the compile-dominated
+        # warmup queries (first serve kernel + every batch shape) don't
+        # masquerade as steady-state tail latency.
+        for q, suffix in ((50, "p50_ms"), (99, "p99_ms")):
+            v = q_hist.percentile_since(q_hist_pre, q)
+            if v is not None:
+                out[f"serve_hist_{suffix}"] = float(v * 1000)
+        wait_hist = getattr(server.batcher, "wait_hist", None)
+        if wait_hist is not None and wait_hist.count:
+            for q, suffix in ((50, "p50_ms"), (99, "p99_ms")):
+                v = wait_hist.percentile(q)
+                if v is not None:
+                    out[f"batch_wait_hist_{suffix}"] = float(v * 1000)
+        return out
     finally:
         client.close()
         server.stop()

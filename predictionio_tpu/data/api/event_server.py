@@ -31,6 +31,8 @@ from predictionio_tpu.data.event import (Event, EventValidation,
                                          parse_event_time)
 from predictionio_tpu.data.storage.base import ABSENT
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import (MetricsRegistry, TRACER, get_registry,
+                                  traces_response)
 from predictionio_tpu.utils.http import HttpServer, Request, Response, Router
 
 logger = logging.getLogger(__name__)
@@ -78,8 +80,51 @@ class EventServer:
                 os.environ.get("PIO_ACCESSKEY_CACHE_S"))
             self.auth_cache_ttl_s = 3.0
         self._auth_cache: dict = {}
+        # ISSUE 2: this server's metrics registry (chained onto the
+        # process-wide one). The window counters keep Stats as their
+        # single source of truth and are sampled via func collectors;
+        # the event-write latency distribution is a native histogram.
+        from predictionio_tpu.obs import jaxmon
+        jaxmon.install()
+        self.metrics = MetricsRegistry(parent=get_registry())
+        self._h_write = self.metrics.histogram(
+            "pio_event_write_seconds",
+            "Event-store write latency per accepted event")
+        self._window_pin = None
+        self._register_metrics()
         self.router = self._build_router()
         self.server: Optional[HttpServer] = None
+
+    def _register_metrics(self):
+        m = self.metrics
+
+        def window(field):
+            return self._window_snapshot()["currentWindow"][field]
+
+        m.gauge_func(
+            "pio_event_window_start_seconds",
+            "Start of the current counter window (unix time)",
+            lambda: self._window_snapshot()["startTime"])
+        m.gauge_func(
+            "pio_event_window_events",
+            "Events accepted in the current window, by event name",
+            lambda: [({"event": k}, v) for k, v in
+                     sorted(window("byEvent").items())] or [(None, 0)])
+        m.gauge_func(
+            "pio_event_window_statuses",
+            "Responses in the current window, by HTTP status",
+            lambda: [({"status": k}, v) for k, v in
+                     sorted(window("byStatus").items())] or [(None, 0)])
+
+    def _window_snapshot(self) -> dict:
+        """One Stats snapshot shared by the three window collectors
+        within a single /metrics render: _metrics pins it for the
+        render's duration so an hourly rotation landing mid-scrape
+        can't pair the fresh window's start time with the old window's
+        counts. Outside a render (direct collect()), falls through to
+        a live read."""
+        pinned = getattr(self, "_window_pin", None)
+        return pinned if pinned is not None else self.stats.to_dict(None)
 
     # DAOs resolved lazily so env/registry changes are respected
     @property
@@ -153,22 +198,43 @@ class EventServer:
                 403, f"{event_name} events are not allowed")
 
     def _create_event(self, req: Request) -> Response:
-        access_key, channel_id = self._authenticate(req)
-        d = req.json()
-        if not isinstance(d, dict):
-            raise ValueError("request body must be a JSON object")
-        event = Event.from_dict(d)
-        self._check_event_allowed(access_key, event.event)
-        EventValidation.validate(event)
-        # inputblocker plugins may veto (EventServer.scala:239)
-        self.plugin_context.check_input(
-            {"appId": access_key.appid, "channelId": channel_id,
-             "event": d})
-        event_id = self.events.insert(event, access_key.appid, channel_id)
-        if self.config.stats:
-            self.stats.update(access_key.appid, event.event,
-                              event.entity_type, 201)
-        return Response(201, {"eventId": event_id})
+        # ingress mints the trace: the storage write lands here, and
+        # the scheduler's tail read later links the fold tick that
+        # absorbs this event back to this trace (end-to-end causality
+        # on /traces.json). The response carries the trace id for log
+        # correlation.
+        with TRACER.trace("event_ingest") as tr:
+            access_key, channel_id = self._authenticate(req)
+            d = req.json()
+            if not isinstance(d, dict):
+                raise ValueError("request body must be a JSON object")
+            event = Event.from_dict(d)
+            tr.root.attrs["event"] = event.event
+            self._check_event_allowed(access_key, event.event)
+            EventValidation.validate(event)
+            # inputblocker plugins may veto (EventServer.scala:239)
+            self.plugin_context.check_input(
+                {"appId": access_key.appid, "channelId": channel_id,
+                 "event": d})
+            event_id = self._insert_traced(event, access_key.appid,
+                                           channel_id)
+            if self.config.stats:
+                self.stats.update(access_key.appid, event.event,
+                                  event.entity_type, 201)
+            return Response(201, {"eventId": event_id,
+                                  "traceId": tr.trace_id})
+
+    def _insert_traced(self, event, app_id, channel_id):
+        """Storage write under a span + the write-latency histogram,
+        registering event_id -> trace_id for fold-tick linking."""
+        with TRACER.span("storage_write") as sp:
+            t0 = time.perf_counter()
+            event_id = self.events.insert(event, app_id, channel_id)
+            self._h_write.observe(time.perf_counter() - t0)
+            if sp is not None:
+                sp.attrs["eventId"] = event_id
+        TRACER.register_event(event_id, TRACER.current_trace_id())
+        return event_id
 
     def _batch_create(self, req: Request) -> Response:
         access_key, channel_id = self._authenticate(req)
@@ -180,21 +246,23 @@ class EventServer:
                 "message": f"Batch request must have less than or equal to "
                            f"{MAX_BATCH_SIZE} events"})
         results = []
-        for d in items:
-            try:
-                event = Event.from_dict(d)
-                self._check_event_allowed(access_key, event.event)
-                EventValidation.validate(event)
-                event_id = self.events.insert(event, access_key.appid,
-                                              channel_id)
-                results.append({"status": 201, "eventId": event_id})
-                if self.config.stats:
-                    self.stats.update(access_key.appid, event.event,
-                                      event.entity_type, 201)
-            except AuthError as e:
-                results.append({"status": e.status, "message": e.message})
-            except Exception as e:
-                results.append({"status": 400, "message": str(e)})
+        with TRACER.trace("event_batch", events=len(items)):
+            for d in items:
+                try:
+                    event = Event.from_dict(d)
+                    self._check_event_allowed(access_key, event.event)
+                    EventValidation.validate(event)
+                    event_id = self._insert_traced(
+                        event, access_key.appid, channel_id)
+                    results.append({"status": 201, "eventId": event_id})
+                    if self.config.stats:
+                        self.stats.update(access_key.appid, event.event,
+                                          event.entity_type, 201)
+                except AuthError as e:
+                    results.append({"status": e.status,
+                                    "message": e.message})
+                except Exception as e:
+                    results.append({"status": 400, "message": str(e)})
         return Response(200, results)
 
     def _get_event(self, req: Request) -> Response:
@@ -291,34 +359,34 @@ class EventServer:
         return Response(200, self.stats.to_dict(access_key.appid))
 
     def _metrics(self, req: Request) -> Response:
-        """Prometheus text exposition (beyond-parity). Unauthenticated —
-        scrapers don't carry access keys — and therefore AGGREGATE only
-        (event counts across all apps, no per-app split; the keyed
-        /stats.json keeps the per-app view). 404 unless --stats, like
-        /stats.json."""
+        """Prometheus text exposition, rendered solely by the shared
+        metrics registry (ISSUE 2). Unauthenticated — scrapers don't
+        carry access keys — and therefore AGGREGATE only (event counts
+        across all apps, no per-app split; the keyed /stats.json keeps
+        the per-app view). 404 unless --stats, like /stats.json."""
         if not self.config.stats:
             return Response(404, {
                 "message": "To expose metrics, launch Event Server with "
                            "--stats argument."})
-        from predictionio_tpu.utils.prometheus import (CONTENT_TYPE,
-                                                        render_metrics)
-        d = self.stats.to_dict(None)
-        cur = d["currentWindow"]
-        m = [
-            ("pio_event_window_start_seconds", "gauge",
-             "Start of the current counter window (unix time)",
-             [(None, d["startTime"])]),
-            ("pio_event_window_events", "gauge",
-             "Events accepted in the current window, by event name",
-             [({"event": k}, v) for k, v in
-              sorted(cur["byEvent"].items())] or [(None, 0)]),
-            ("pio_event_window_statuses", "gauge",
-             "Responses in the current window, by HTTP status",
-             [({"status": k}, v) for k, v in
-              sorted(cur["byStatus"].items())] or [(None, 0)]),
-        ]
-        return Response(200, render_metrics(m),
-                        content_type=CONTENT_TYPE)
+        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+        self._window_pin = self.stats.to_dict(None)
+        try:
+            body = self.metrics.render()
+        finally:
+            self._window_pin = None
+        return Response(200, body, content_type=CONTENT_TYPE)
+
+    def _traces(self, req: Request) -> Response:
+        """GET /traces.json — recent span trees from the process-wide
+        tracer (?n=, ?kind=, ?sort=slowest). Gated like /metrics:
+        unauthenticated, and ingest traces carry per-event detail
+        (event ids/names, write timings), so a server launched without
+        --stats exposes nothing."""
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To expose traces, launch Event Server with "
+                           "--stats argument."})
+        return Response(200, traces_response(req.params))
 
     def _webhook_json(self, req: Request) -> Response:
         access_key, channel_id = self._authenticate(req)
@@ -373,6 +441,7 @@ class EventServer:
         r.add("DELETE", "/events/<id>.json", guarded(self._delete_event))
         r.add("GET", "/stats.json", guarded(self._get_stats))
         r.add("GET", "/metrics", self._metrics)
+        r.add("GET", "/traces.json", self._traces)
         r.add("POST", "/webhooks/<name>.json", guarded(self._webhook_json))
         r.add("GET", "/webhooks/<name>.json", guarded(self._webhook_get))
         r.add("POST", "/webhooks/<name>", guarded(self._webhook_form))

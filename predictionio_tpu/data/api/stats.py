@@ -25,7 +25,17 @@ class Stats:
 
     def _maybe_rotate(self):
         now = time.time()
-        if now - self._window_start >= self.WINDOW_SEC:
+        elapsed = now - self._window_start
+        if elapsed >= 2 * self.WINDOW_SEC:
+            # idle gap spanning more than one full window: the stale
+            # current window is not "previous" — at least one empty
+            # window sat between it and now, so reporting it would
+            # claim hour-old traffic as last-hour traffic (ISSUE 2
+            # satellite). Both windows start empty.
+            self._previous = defaultdict(int)
+            self._current = defaultdict(int)
+            self._window_start = now
+        elif elapsed >= self.WINDOW_SEC:
             self._previous = self._current
             self._current = defaultdict(int)
             self._window_start = now

@@ -1,0 +1,28 @@
+"""Unified telemetry: metrics registry, trace spans, JAX runtime
+counters (ISSUE 2).
+
+- ``obs.metrics`` — Counter/Gauge/Histogram primitives on a
+  process-wide ``MetricsRegistry`` (per-server child registries chain
+  to it); every ``GET /metrics`` and the histogram blocks on
+  ``/stats.json`` render from here.
+- ``obs.trace`` — trace spans with contextvar propagation and
+  cross-trace links; ``GET /traces.json`` on both servers reads the
+  process-wide ``TRACER``.
+- ``obs.jaxmon`` — compile counts, host<->device transfer bytes,
+  device-memory gauges.
+"""
+
+from predictionio_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
+                                          FuncCollector, Gauge,
+                                          Histogram, MetricsRegistry,
+                                          REGISTRY, get_registry)
+from predictionio_tpu.obs.trace import (Span, Trace, Tracer, TRACER,
+                                        traces_response)
+from predictionio_tpu.obs import jaxmon
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "FuncCollector", "Gauge", "Histogram",
+    "MetricsRegistry", "REGISTRY", "get_registry",
+    "Span", "Trace", "Tracer", "TRACER", "traces_response",
+    "jaxmon",
+]

@@ -1,0 +1,161 @@
+"""JAX runtime telemetry: compiles, host<->device bytes, device memory.
+
+ISSUE 2 tentpole piece 3. TPU-scale systems (ALX, arxiv 2112.02194)
+make per-stage transfer accounting a first-class metric because on a
+tunneled chip the host<->device link — not the MXU — bounds fold-in
+and serve latency. Three instruments, all on the process-wide registry
+so both HTTP servers' ``/metrics`` expose them:
+
+- **compile counters** via ``jax.monitoring`` event listeners (every
+  event whose name mentions a compilation, plus cumulative backend
+  compile seconds) — a climbing compile count in steady-state serving
+  means shape churn (the classic silent TPU perf bug);
+- **transfer byte counters** incremented by the code paths that
+  actually move data (``utils/device_cache.cached_put``, the ALS
+  plan upload, ``utils/arrays.to_host``), so fold-in's per-tick upload
+  cost (the ROADMAP open item) is measurable per tick via
+  ``h2d_delta()`` around a solve;
+- **device memory gauges** sampled from ``Device.memory_stats()`` at
+  collect time (TPU/GPU report ``bytes_in_use``/``bytes_limit``; CPU
+  devices report nothing and render no samples).
+
+``install()`` is idempotent and safe without an initialized backend.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from predictionio_tpu.obs.metrics import get_registry
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_installed = False
+_m_compiles = None
+_m_compile_s = None
+_m_h2d = None
+_m_d2h = None
+# per-thread upload accounting: lets a caller price ITS OWN uploads
+# (the fold tick) without attributing a concurrent /reload's or
+# serving cache-miss's bytes on another thread to itself
+_tls = threading.local()
+
+
+def _is_compile_event(name: str) -> bool:
+    return "compil" in name  # compile / compilation / compiling
+
+
+def install(registry=None):
+    """Register the JAX listeners and gauges on the process registry
+    (or ``registry``). Idempotent; never raises — a jax without
+    ``jax.monitoring`` just loses the compile counters."""
+    global _installed, _m_compiles, _m_compile_s, _m_h2d, _m_d2h
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+        reg = registry or get_registry()
+        _m_compiles = reg.counter(
+            "pio_jax_compiles_total",
+            "XLA compilation events observed via jax.monitoring")
+        _m_compile_s = reg.counter(
+            "pio_jax_compile_seconds_total",
+            "Cumulative backend compile wall time")
+        _m_h2d = reg.counter(
+            "pio_jax_host_to_device_bytes_total",
+            "Bytes uploaded host->device by instrumented paths "
+            "(model tables, solve plans, fold-in uploads)")
+        _m_d2h = reg.counter(
+            "pio_jax_device_to_host_bytes_total",
+            "Bytes fetched device->host by instrumented paths "
+            "(model gathers, predict results)")
+        reg.gauge_func(
+            "pio_jax_device_memory_bytes",
+            "Per-device memory from Device.memory_stats() "
+            "(kind=bytes_in_use|bytes_limit; absent on CPU backends)",
+            _device_memory_samples)
+    try:
+        from jax import monitoring
+
+        def _on_event(name, *a, **kw):
+            if _is_compile_event(name):
+                _m_compiles.inc()
+
+        def _on_duration(name, secs, *a, **kw):
+            if _is_compile_event(name):
+                try:
+                    _m_compile_s.inc(float(secs))
+                except (TypeError, ValueError):
+                    pass
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:   # jax too old / monitoring absent
+        logger.debug("jax.monitoring listeners unavailable: %s", e)
+
+
+def _device_memory_samples():
+    import jax
+    out = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        dev = f"{d.platform}:{d.id}"
+        for kind in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+            if kind in stats:
+                out.append(({"device": dev, "kind": kind},
+                            float(stats[kind])))
+    return out
+
+
+def _ensure():
+    if not _installed:
+        install()
+
+
+def record_h2d(nbytes: int):
+    """Count an instrumented host->device upload."""
+    if nbytes:
+        _ensure()
+        _m_h2d.inc(float(nbytes))
+        _tls.h2d = getattr(_tls, "h2d", 0.0) + float(nbytes)
+
+
+def record_d2h(nbytes: int):
+    """Count an instrumented device->host fetch."""
+    if nbytes:
+        _ensure()
+        _m_d2h.inc(float(nbytes))
+
+
+def h2d_total() -> float:
+    _ensure()
+    return _m_h2d.value
+
+
+def thread_h2d_total() -> float:
+    """Bytes uploaded BY THE CALLING THREAD — the scheduler snapshots
+    this around a fold so its per-tick upload cost excludes concurrent
+    uploads (serving cache misses, a /reload) on other threads."""
+    return getattr(_tls, "h2d", 0.0)
+
+
+def h2d_delta(before: float) -> float:
+    """Calling thread's bytes uploaded since a prior
+    ``thread_h2d_total()`` snapshot."""
+    return thread_h2d_total() - before
+
+
+def nbytes_of(arrays) -> int:
+    """Total nbytes across a flat iterable of array-likes (items
+    without ``nbytes`` count zero)."""
+    total = 0
+    for a in arrays:
+        total += int(getattr(a, "nbytes", 0) or 0)
+    return total

@@ -1,0 +1,273 @@
+"""Request/fold trace spans with context propagation (ISSUE 2 piece 2).
+
+A ``trace_id`` is minted at ingress — an Event Server POST, an Engine
+Server query, a scheduler fold tick, a training run — and carried
+through nested ``span()`` scopes via a contextvar, so the storage
+write, tail read, fold-in solve, registry publish, hot-swap, and
+batched predict all land in one span tree with per-stage wall timings.
+
+Cross-trace causality uses **links** (the OpenTelemetry span-link idea):
+one fold tick absorbs many ingested events, so the tick's trace links
+the events' ingest traces (and vice versa) instead of pretending to be
+their parent. The Event Server registers ``event_id -> trace_id`` at
+write time; the scheduler's tail read resolves the fresh events it
+consumed back to their ingest traces.
+
+Completed traces live in per-kind ring buffers (an in-memory,
+process-wide view — query traces at serving QPS must not evict the
+day's fold ticks) served at ``GET /traces.json`` on both HTTP servers:
+last N, filterable by kind, sortable by slowest.
+
+Hot-path cost: ``span()`` outside any active trace is a no-op context
+manager (~1 µs); inside a trace it is one object append + two
+``perf_counter`` calls (guarded by tests/test_obs_overhead.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import itertools
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+_span_seq = itertools.count(1)
+
+
+class Span:
+    __slots__ = ("name", "span_id", "parent_id", "t_wall", "_t0",
+                 "duration_s", "attrs", "error")
+
+    def __init__(self, name: str, parent_id: Optional[int]):
+        self.name = name
+        self.span_id = next(_span_seq)
+        self.parent_id = parent_id
+        self.t_wall = time.time()
+        self._t0 = time.perf_counter()
+        self.duration_s: Optional[float] = None
+        self.attrs: Dict[str, object] = {}
+        self.error: Optional[str] = None
+
+    def end(self):
+        if self.duration_s is None:
+            self.duration_s = time.perf_counter() - self._t0
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "spanId": self.span_id,
+             "start": self.t_wall,
+             "durationMs": (round(self.duration_s * 1000.0, 3)
+                            if self.duration_s is not None else None)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+class Trace:
+    """One span tree. The root span shares the trace's kind as its
+    name; ``links`` are trace_ids of causally-related traces (event
+    ingest <-> fold tick), capped so a fold absorbing thousands of
+    events can't bloat its /traces.json entry (``linksDropped``
+    records the overflow)."""
+
+    MAX_LINKS = 64
+
+    def __init__(self, kind: str, trace_id: Optional[str] = None):
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.kind = kind
+        self.root = Span(kind, None)
+        self.spans: List[Span] = [self.root]
+        self.links: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+        self.links_dropped = 0
+        self.discard = False   # set True to skip the ring (empty ticks)
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        return self.root.duration_s
+
+    def link(self, other_trace_id: str):
+        if not other_trace_id or other_trace_id == self.trace_id:
+            return
+        if other_trace_id in self.links:
+            return
+        if len(self.links) >= self.MAX_LINKS:
+            self.links_dropped += 1
+            return
+        self.links[other_trace_id] = None
+
+    def to_dict(self) -> dict:
+        by_parent: Dict[Optional[int], List[Span]] = {}
+        for s in self.spans:
+            by_parent.setdefault(s.parent_id, []).append(s)
+
+        def build(span: Span) -> dict:
+            d = span.to_dict()
+            kids = by_parent.get(span.span_id)
+            if kids:
+                d["children"] = [build(k) for k in kids]
+            return d
+
+        d = {"traceId": self.trace_id, "kind": self.kind,
+             "start": self.root.t_wall,
+             "durationMs": (round(self.root.duration_s * 1000.0, 3)
+                            if self.root.duration_s is not None
+                            else None),
+             "links": list(self.links),
+             "root": build(self.root)}
+        if self.links_dropped:
+            d["linksDropped"] = self.links_dropped
+        return d
+
+
+class Tracer:
+    """Process-wide trace collector + context propagation."""
+
+    def __init__(self, per_kind_capacity: int = 128,
+                 event_map_capacity: int = 8192):
+        self.per_kind_capacity = per_kind_capacity
+        self._lock = threading.Lock()
+        self._done: Dict[str, collections.deque] = {}
+        # trace_id -> committed Trace, kept in lockstep with the rings
+        # so link_completed is O(1) instead of a ring scan (a fold can
+        # absorb thousands of events per tick)
+        self._by_id: Dict[str, Trace] = {}
+        self._ctx: contextvars.ContextVar = contextvars.ContextVar(
+            "pio_trace_ctx", default=None)
+        # event_id -> trace_id, bounded FIFO: lets the scheduler's tail
+        # read resolve fresh events back to their ingest traces
+        self._event_traces: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._event_map_capacity = event_map_capacity
+
+    # -- context -------------------------------------------------------
+    def current_trace(self) -> Optional[Trace]:
+        ctx = self._ctx.get()
+        return ctx[0] if ctx else None
+
+    def current_trace_id(self) -> Optional[str]:
+        t = self.current_trace()
+        return t.trace_id if t else None
+
+    @contextmanager
+    def trace(self, kind: str, trace_id: Optional[str] = None, **attrs):
+        """Mint a trace and make it current for the calling thread's
+        scope. Exceptions mark the root span and re-raise. Set
+        ``trace.discard = True`` inside to skip recording (e.g. an
+        empty scheduler tick)."""
+        t = Trace(kind, trace_id=trace_id)
+        if attrs:
+            t.root.attrs.update(attrs)
+        token = self._ctx.set((t, t.root))
+        try:
+            yield t
+        except BaseException as e:
+            t.root.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._ctx.reset(token)
+            t.root.end()
+            if not t.discard:
+                self._commit(t)
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """A child span of the current trace; a cheap no-op when no
+        trace is active (so instrumented code needs no caller checks)."""
+        ctx = self._ctx.get()
+        if ctx is None:
+            yield None
+            return
+        trace, parent = ctx
+        s = Span(name, parent.span_id)
+        if attrs:
+            s.attrs.update(attrs)
+        trace.spans.append(s)
+        token = self._ctx.set((trace, s))
+        try:
+            yield s
+        except BaseException as e:
+            s.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._ctx.reset(token)
+            s.end()
+
+    def annotate(self, **attrs):
+        """Attach attributes to the current span, if any."""
+        ctx = self._ctx.get()
+        if ctx is not None:
+            ctx[1].attrs.update(attrs)
+
+    # -- commit / ring -------------------------------------------------
+    def _commit(self, t: Trace):
+        with self._lock:
+            ring = self._done.get(t.kind)
+            if ring is None:
+                ring = collections.deque(maxlen=self.per_kind_capacity)
+                self._done[t.kind] = ring
+            if len(ring) == ring.maxlen:   # evicting: drop its index
+                self._by_id.pop(ring[0].trace_id, None)
+            ring.append(t)
+            self._by_id[t.trace_id] = t
+
+    # -- cross-trace causality ------------------------------------------
+    def register_event(self, event_id: Optional[str],
+                       trace_id: Optional[str]):
+        if not event_id or not trace_id:
+            return
+        with self._lock:
+            self._event_traces[str(event_id)] = trace_id
+            while len(self._event_traces) > self._event_map_capacity:
+                self._event_traces.popitem(last=False)
+
+    def trace_id_for_event(self, event_id) -> Optional[str]:
+        with self._lock:
+            return self._event_traces.get(str(event_id))
+
+    def link_completed(self, trace_id: str, other_trace_id: str):
+        """Add a link onto an already-committed trace (the back-link
+        from an event's ingest trace to the fold tick that absorbed
+        it). O(1); no-op when the trace already left the ring."""
+        with self._lock:
+            t = self._by_id.get(trace_id)
+            if t is not None:
+                t.link(other_trace_id)
+
+    # -- the /traces.json view -----------------------------------------
+    def snapshot(self, limit: int = 50, kind: Optional[str] = None,
+                 slowest: bool = False) -> List[dict]:
+        with self._lock:
+            if kind is not None:
+                traces = list(self._done.get(kind, ()))
+            else:
+                traces = [t for ring in self._done.values()
+                          for t in ring]
+        if slowest:
+            traces.sort(key=lambda t: t.duration_s or 0.0, reverse=True)
+        else:
+            traces.sort(key=lambda t: t.root.t_wall, reverse=True)
+        return [t.to_dict() for t in traces[:max(0, int(limit))]]
+
+    def clear(self):
+        with self._lock:
+            self._done.clear()
+            self._by_id.clear()
+            self._event_traces.clear()
+
+
+# The process-wide tracer.
+TRACER = Tracer()
+
+
+def traces_response(params: dict):
+    """Shared ``GET /traces.json`` handler body for every HTTP server:
+    ``?n=`` limit (default 50), ``?kind=`` filter, ``?sort=slowest``."""
+    limit = int(params.get("n", params.get("limit", 50)))
+    return {"traces": TRACER.snapshot(
+        limit=limit, kind=params.get("kind"),
+        slowest=params.get("sort") == "slowest")}

@@ -48,6 +48,7 @@ from __future__ import annotations
 import datetime as _dt
 import logging
 import threading
+import time as _time
 import urllib.request
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
@@ -55,6 +56,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 from predictionio_tpu.data.aggregator import merge_aggregations
 from predictionio_tpu.data.event import Event, utcnow
 from predictionio_tpu.data.store import LEventStore
+from predictionio_tpu.obs import TRACER, get_registry, jaxmon
 
 logger = logging.getLogger(__name__)
 
@@ -140,6 +142,26 @@ class DeltaTrainingScheduler:
         self._user_deltas: Dict[str, EntityDelta] = {}
         self._item_deltas: Dict[str, EntityDelta] = {}
         self._pending_events = 0   # fresh events since last fold (1/event)
+        # ingest-trace ids of the pending events (resolved at tail time
+        # via the tracer's event map): the fold tick's trace links them
+        # so /traces.json ties an ingested event to the fold that
+        # absorbed it (ISSUE 2 end-to-end causality)
+        self._pending_trace_ids: Set[str] = set()
+        # process-wide fold instruments (get-or-create: schedulers in
+        # one process share the families, and both HTTP servers expose
+        # them through the registry parent chain)
+        reg = get_registry()
+        self._h_tick = reg.histogram(
+            "pio_fold_tick_seconds",
+            "Wall time of a scheduler tick that ran a fold-in "
+            "(tail read + touched-row solves + publish + swap)")
+        self._c_fold_events = reg.counter(
+            "pio_fold_events_total",
+            "Fresh events absorbed by completed fold-ins")
+        self._c_fold_h2d = reg.counter(
+            "pio_fold_upload_bytes_total",
+            "Host->device bytes uploaded by fold-in solves (the "
+            "per-tick upload cost; ROADMAP open item)")
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -195,12 +217,17 @@ class DeltaTrainingScheduler:
             limit=cfg.tail_batch_limit)
         new_users: Dict[str, EntityDelta] = {}
         new_items: Dict[str, EntityDelta] = {}
+        new_trace_ids: Set[str] = set()
         max_t = self._cursor
         boundary: Set[str] = set()
         for e in it:
             if e.event_id is not None and e.event_id in self._seen_at_cursor:
                 continue  # boundary-instant re-read
             fresh += 1
+            if e.event_id is not None:
+                tid = TRACER.trace_id_for_event(e.event_id)
+                if tid:
+                    new_trace_ids.add(tid)
             d = EntityDelta.from_event(e)
             # route by entity TYPE: a rate/buy/view event's subject is a
             # user and its target an item; a $set on an item is an
@@ -225,6 +252,15 @@ class DeltaTrainingScheduler:
             self._item_deltas = merge_aggregations(
                 [self._item_deltas, new_items])
             self._pending_events += fresh
+            # bounded: link fidelity degrades gracefully under a flood
+            # (Trace.MAX_LINKS caps the fold trace's side anyway)
+            room = 256 - len(self._pending_trace_ids)
+            if room > 0:
+                for tid in new_trace_ids:
+                    self._pending_trace_ids.add(tid)
+                    room -= 1
+                    if room <= 0:
+                        break
             if max_t is not None and (self._cursor is None
                                       or max_t > self._cursor):
                 self._cursor = max_t
@@ -266,13 +302,27 @@ class DeltaTrainingScheduler:
             user_deltas = self._user_deltas
             item_deltas = self._item_deltas
             n_events = self._pending_events
+            trace_ids = self._pending_trace_ids
             self._user_deltas = {}
             self._item_deltas = {}
             self._pending_events = 0
+            self._pending_trace_ids = set()
         touched_users = list(user_deltas.keys())
         touched_items = list(item_deltas.keys())
+        # two-way causality links: the fold trace names the ingest
+        # traces it absorbs, and each ingest trace gains a link to the
+        # fold (so either end of /traces.json walks to the other)
+        tick_trace = TRACER.current_trace()
+        if tick_trace is not None:
+            for tid in trace_ids:
+                tick_trace.link(tid)
+                TRACER.link_completed(tid, tick_trace.trace_id)
+        # this thread's uploads only: a concurrent serving cache miss
+        # or /reload on another thread must not inflate the fold's cost
+        h2d_before = jaxmon.thread_h2d_total()
         try:
-            td = self._read_training_data()
+            with TRACER.span("tail_data_read"):
+                td = self._read_training_data()
             new_models: List[Any] = []
             reports: List[dict] = []
             folded_any = False
@@ -286,9 +336,12 @@ class DeltaTrainingScheduler:
                 if fold is None:
                     new_models.append(model)  # not online-capable: keep
                     continue
-                new_model, report = fold(model, td, touched_users,
-                                         touched_items,
-                                         preparator_params=prep_params)
+                with TRACER.span("fold_solve",
+                                 touchedUsers=len(touched_users),
+                                 touchedItems=len(touched_items)):
+                    new_model, report = fold(
+                        model, td, touched_users, touched_items,
+                        preparator_params=prep_params)
                 new_models.append(new_model)
                 reports.append(report)
                 folded_any = True
@@ -296,7 +349,8 @@ class DeltaTrainingScheduler:
             # transient failure (storage hiccup, solve error): restore
             # the popped deltas so the NEXT tick retries these events
             # instead of silently dropping them until a full retrain
-            self._restore_deltas(user_deltas, item_deltas, n_events)
+            self._restore_deltas(user_deltas, item_deltas, n_events,
+                                 trace_ids)
             raise
         report = {
             "foldIn": self.fold_in_count + 1,
@@ -304,7 +358,11 @@ class DeltaTrainingScheduler:
             "touchedItems": len(touched_items),
             "events": n_events,
             "algorithms": reports,
+            # per-tick upload cost through instrumented paths — the
+            # ROADMAP open item as a first-class number
+            "h2dBytes": jaxmon.h2d_delta(h2d_before),
         }
+        TRACER.annotate(h2dBytes=report["h2dBytes"])
         if not folded_any:
             logger.warning("no algorithm supports fold_in; deltas dropped")
             self.last_report = report
@@ -336,21 +394,27 @@ class DeltaTrainingScheduler:
             # folded — /stats.json must not claim events the serving
             # path never absorbed. The re-solve is deterministic over
             # the re-read data, so the retry is idempotent.
-            self._restore_deltas(user_deltas, item_deltas, n_events)
+            self._restore_deltas(user_deltas, item_deltas, n_events,
+                                 trace_ids)
             raise
         self.models = new_models
         self.fold_in_count += 1
         self.events_folded += n_events
+        self._c_fold_events.inc(n_events)
+        self._c_fold_h2d.inc(report["h2dBytes"])
         self.last_report = report
         return report
 
-    def _restore_deltas(self, user_deltas, item_deltas, n_events: int):
+    def _restore_deltas(self, user_deltas, item_deltas, n_events: int,
+                        trace_ids: Optional[Set[str]] = None):
         with self._lock:
             self._user_deltas = merge_aggregations(
                 [user_deltas, self._user_deltas])
             self._item_deltas = merge_aggregations(
                 [item_deltas, self._item_deltas])
             self._pending_events += n_events
+            if trace_ids:
+                self._pending_trace_ids |= trace_ids
 
     def _publish(self, models: Sequence[Any], report: dict):
         version = None
@@ -366,35 +430,55 @@ class DeltaTrainingScheduler:
                 # would otherwise be skipped forever). Conservative: a
                 # boundary re-read refolds, which is idempotent.
                 meta["cursor"] = cursor.isoformat()
-            version = self.registry.publish(
-                self.engine, self.engine_params, self.instance, models,
-                meta=meta)
+            with TRACER.span("registry_publish"):
+                version = self.registry.publish(
+                    self.engine, self.engine_params, self.instance,
+                    models, meta=meta)
+            TRACER.annotate(version=version)
             report["publishedVersion"] = version
         if self.server is not None:
-            self.server.swap_models(models, version=version,
-                                    fold_in_events=report["events"])
+            with TRACER.span("hot_swap", version=version or ""):
+                self.server.swap_models(models, version=version,
+                                        fold_in_events=report["events"])
         if self.reload_url is not None:
-            try:
-                req = urllib.request.Request(
-                    self.reload_url, method="POST", data=b"")
-                urllib.request.urlopen(req, timeout=30).read()
-                report["reloaded"] = True
-            except Exception as e:
-                report["reloaded"] = False
-                logger.error("POST %s failed: %s", self.reload_url, e)
+            with TRACER.span("reload", url=self.reload_url):
+                try:
+                    req = urllib.request.Request(
+                        self.reload_url, method="POST", data=b"")
+                    urllib.request.urlopen(req, timeout=30).read()
+                    report["reloaded"] = True
+                except Exception as e:
+                    report["reloaded"] = False
+                    logger.error("POST %s failed: %s", self.reload_url, e)
 
     # -- tick / loop --------------------------------------------------------
     def tick(self, force: bool = False) -> Optional[dict]:
         """One scheduler step: tail, then fold if a threshold fired (or
-        ``force``). Returns the fold-in report, or None if no fold ran."""
-        self.poll_events()
-        if self.retrain_requested and not force:
-            return None  # drifted: wait for the full retrain
-        if force or self.should_fold():
-            if self.pending_deltas() == 0:
-                return None
-            return self.fold_in()
-        return None
+        ``force``). Returns the fold-in report, or None if no fold ran.
+
+        Each tick that observes fresh events or runs a fold records a
+        ``fold_tick`` trace (tail read -> touched-row solves ->
+        registry publish -> hot swap), linked to the ingest traces of
+        the events it absorbed; idle ticks are discarded so the poll
+        loop doesn't flood the trace ring."""
+        t0 = _time.perf_counter()
+        with TRACER.trace("fold_tick") as tr:
+            with TRACER.span("tail_read") as sp:
+                fresh = self.poll_events()
+                if sp is not None:
+                    sp.attrs["freshEvents"] = fresh
+            tr.discard = fresh == 0   # kept only if a fold runs below
+            if self.retrain_requested and not force:
+                return None  # drifted: wait for the full retrain
+            if force or self.should_fold():
+                if self.pending_deltas() == 0:
+                    return None
+                tr.discard = False
+                report = self.fold_in()
+                self._h_tick.observe(_time.perf_counter() - t0)
+                tr.root.attrs["events"] = report["events"]
+                return report
+            return None
 
     def start(self) -> "DeltaTrainingScheduler":
         if self._thread is not None:

@@ -517,6 +517,11 @@ def _upload_plan(mesh: MeshContext, plan: SolvePlan, chunk: int = 1):
                                     for x in (rows, idx, val, mask)))
         for tensors in chunks:
             groups.append(tuple(mesh.put_stacked(x) for x in tensors))
+    # host->device transfer accounting (obs.jaxmon): the plan upload is
+    # the dominant per-train / per-fold-in link cost on a tunneled chip
+    from predictionio_tpu.obs import jaxmon
+    jaxmon.record_h2d(jaxmon.nbytes_of(
+        t for group in groups for t in group))
     return tuple(groups)
 
 

@@ -40,7 +40,8 @@ logger = logging.getLogger(__name__)
 
 
 class _Pending:
-    __slots__ = ("query", "event", "result", "error", "t_enqueue")
+    __slots__ = ("query", "event", "result", "error", "t_enqueue",
+                 "trace_id", "batch_trace_id")
 
     def __init__(self, query):
         self.query = query
@@ -48,13 +49,22 @@ class _Pending:
         self.result = None
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.perf_counter()
+        # ingress trace of the submitting request thread; the dispatch
+        # loop links it to the batch_predict trace (and back)
+        self.trace_id: Optional[str] = None
+        self.batch_trace_id: Optional[str] = None
 
 
 class MicroBatcher:
     def __init__(self, process_batch, max_batch: int = 32,
                  max_wait_ms: float = 5.0,
-                 latency_budget_ms: Optional[float] = None):
-        """process_batch: fn(List[query]) -> List[result]."""
+                 latency_budget_ms: Optional[float] = None,
+                 metrics=None):
+        """process_batch: fn(List[query]) -> List[result]. `metrics`:
+        an obs.MetricsRegistry to mount the coalescing telemetry on —
+        the counters below stay the single source of truth (stats()
+        reads them directly) and the registry samples them at scrape
+        time; the batch-wait distribution is a native histogram."""
         self.process_batch = process_batch
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
@@ -96,6 +106,43 @@ class MicroBatcher:
         self._flight_lock = threading.Lock()
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
+        self.wait_hist = None
+        if metrics is not None:
+            self.wait_hist = metrics.histogram(
+                "pio_engine_batch_wait_seconds",
+                "Per-query time in the coalescing stage "
+                "(enqueue -> dispatch)")
+            metrics.counter_func(
+                "pio_engine_batches_total", "Micro-batch dispatches",
+                lambda: self.n_batches)
+            metrics.counter_func(
+                "pio_engine_batched_queries_total",
+                "Queries through the micro-batcher",
+                lambda: self.n_queries)
+            metrics.counter_func(
+                "pio_engine_immediate_batches_total",
+                "Dispatches that never blocked on the window",
+                lambda: self.n_immediate)
+            metrics.gauge_func(
+                "pio_engine_max_batch_size", "Largest coalesced batch",
+                lambda: self.max_batch_seen)
+            metrics.counter_func(
+                "pio_engine_batch_exits_total",
+                "Why each dispatch closed its batch (attributes a "
+                "sub-micro_batch realized batch size: drain_gate = "
+                "client pool was the limit, window = straggler hold "
+                "expired, full = max_batch hit)",
+                lambda: [({"reason": "full"}, self.n_exit_full),
+                         ({"reason": "drain_gate"},
+                          self.n_exit_drain_gate),
+                         ({"reason": "window"}, self.n_exit_window)])
+            metrics.gauge_func(
+                "pio_engine_avg_inflight_at_dispatch",
+                "Mean submitted-unanswered queries at dispatch (the "
+                "effective concurrent-client count)",
+                lambda: round(self.inflight_at_dispatch_sum
+                              / self.n_batches, 3)
+                if self.n_batches else 0.0)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -119,7 +166,9 @@ class MicroBatcher:
 
     def submit(self, query) -> Any:
         """Blocking: enqueue and wait for the batched result."""
+        from predictionio_tpu.obs import TRACER
         p = _Pending(query)
+        p.trace_id = TRACER.current_trace_id()
         with self._flight_lock:
             # check-and-enqueue is atomic with stop()'s set-and-sweep
             # (both under _flight_lock), so no submitter can slip a
@@ -128,7 +177,15 @@ class MicroBatcher:
                 raise RuntimeError("micro-batcher is shut down")
             self._inflight += 1
             self._q.put(p)
-        p.event.wait()
+        with TRACER.span("batch_wait"):
+            p.event.wait()
+        if p.batch_trace_id is not None:
+            # tie this query's ingress trace to the coalesced window
+            # that answered it (the dispatch loop recorded the reverse
+            # link before waking us)
+            cur = TRACER.current_trace()
+            if cur is not None:
+                cur.link(p.batch_trace_id)
         if p.error is not None:
             raise p.error
         return p.result
@@ -188,8 +245,12 @@ class MicroBatcher:
                 self.n_exit_window += 1
             if not held:
                 self.n_immediate += 1
+            t_dispatch = time.perf_counter()
+            if self.wait_hist is not None:
+                for p in batch:
+                    self.wait_hist.observe(t_dispatch - p.t_enqueue)
             try:
-                results = self.process_batch([p.query for p in batch])
+                results = self._run_batch(batch)
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"batch handler returned {len(results)} results "
@@ -205,6 +266,22 @@ class MicroBatcher:
                 for p in batch:
                     p.error = e
                     p.event.set()
+
+    def _run_batch(self, batch):
+        """One dispatch. When any member carries an ingress trace, the
+        device call runs under its own batch_predict trace linked both
+        ways — the dispatch thread has no request context, so the link
+        set is how /traces.json ties a query to its window."""
+        member_traces = [p.trace_id for p in batch if p.trace_id]
+        if not member_traces:
+            return self.process_batch([p.query for p in batch])
+        from predictionio_tpu.obs import TRACER
+        with TRACER.trace("batch_predict", batch=len(batch)) as bt:
+            for tid in member_traces:
+                bt.link(tid)
+            for p in batch:
+                p.batch_trace_id = bt.trace_id
+            return self.process_batch([p.query for p in batch])
 
     def stop(self):
         self._stop.set()

@@ -30,6 +30,8 @@ from predictionio_tpu.core.engine import Engine, EngineParams
 from predictionio_tpu.data.event import format_event_time, utcnow
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.models import get_engine_factory
+from predictionio_tpu.obs import (MetricsRegistry, TRACER, get_registry,
+                                  jaxmon, traces_response)
 from predictionio_tpu.serving.plugins import EngineServerPluginContext
 from predictionio_tpu.utils.http import (HttpServer, Request, Response,
                                          Router)
@@ -125,14 +127,74 @@ class EngineServer:
         self.model_version: Optional[str] = None
         self.start_time = utcnow()
         self.server: Optional[HttpServer] = None
+        # jax.profiler trace state for the idempotent /profile.json
+        # toggle (a second start used to 500 out of jax.profiler)
+        self._profile_dir: Optional[str] = None
+        # ISSUE 2: this server's metrics registry, chained onto the
+        # process-wide one (JAX telemetry, fold/train instruments ride
+        # along on /metrics). Per-server counters keep the server as
+        # their single source of truth and are sampled via func
+        # collectors at scrape time; latency distributions are native
+        # registry histograms.
+        jaxmon.install()
+        self.metrics = MetricsRegistry(parent=get_registry())
+        self._h_query = self.metrics.histogram(
+            "pio_engine_query_seconds",
+            "Per-query serving latency (batched queries observe the "
+            "window's wall time each)")
+        self._register_metrics()
         self.batcher = None
         if config.micro_batch > 1:
             from predictionio_tpu.serving.batcher import MicroBatcher
             self.batcher = MicroBatcher(
                 self.handle_query_batch, max_batch=config.micro_batch,
                 max_wait_ms=config.micro_batch_wait_ms,
-                latency_budget_ms=config.micro_batch_latency_budget_ms)
+                latency_budget_ms=config.micro_batch_latency_budget_ms,
+                metrics=self.metrics)
         self.router = self._build_router()
+
+    def _register_metrics(self):
+        """Mount every serving counter on the registry. The func
+        collectors sample the live attributes under no extra locks —
+        scrape-time reads of GIL-atomic ints/floats."""
+        m = self.metrics
+        m.counter_func("pio_engine_requests_total", "Queries served",
+                       lambda: self.request_count)
+        m.counter_func("pio_engine_serving_seconds_total",
+                       "Cumulative serve wall time",
+                       lambda: self.serving_seconds)
+        m.counter_func("pio_engine_predict_seconds_total",
+                       "Cumulative device/predict time",
+                       lambda: self.predict_seconds)
+        m.counter_func("pio_engine_model_swaps_total",
+                       "Hot model swaps since start (reloads + fold-ins)",
+                       lambda: self.swap_count)
+        m.counter_func("pio_engine_fold_ins_total",
+                       "Online fold-in swaps since start",
+                       lambda: self.fold_in_count)
+        m.counter_func("pio_engine_fold_in_events_total",
+                       "Events absorbed by online fold-ins",
+                       lambda: self.fold_in_events)
+        m.summary_func("pio_engine_serving_seconds",
+                       "Recent serving-time quantiles (rolling ring)",
+                       self._quantile_samples)
+        if self.coordinator is not None:
+            m.gauge_func("pio_engine_mesh_processes",
+                         "Processes in the serving mesh",
+                         lambda: self.coordinator.health()["processes"])
+            m.gauge_func("pio_engine_mesh_poisoned",
+                         "1 when a mesh broadcast failed and every query "
+                         "answers 503 until redeploy",
+                         lambda: int(
+                             self.coordinator.health()["poisoned"]))
+
+    def _quantile_samples(self):
+        with self._lock:
+            pct = self._ring_percentiles()
+        if pct is None:
+            return None
+        return [({"quantile": q}, float(v))
+                for q, v in zip(("0.5", "0.95", "0.99"), pct)]
 
     # -- model loading (createServerActorWithEngine, :206-265) -------------
     def load_engine_instance(self):
@@ -230,8 +292,9 @@ class EngineServer:
         with self._spmd_guard(query_dict):
             supplemented = serving.supplement(query)
             tp = time.perf_counter()
-            predictions = [algo.predict(model, supplemented)
-                           for algo, model in zip(algorithms, models)]
+            with TRACER.span("predict", algorithms=len(algorithms)):
+                predictions = [algo.predict(model, supplemented)
+                               for algo, model in zip(algorithms, models)]
             predict_dt = time.perf_counter() - tp
         prediction = serving.serve(query, predictions)
         pred_dict = (prediction.to_dict()
@@ -251,6 +314,7 @@ class EngineServer:
             self.last_serving_sec = dt
             self.predict_seconds += predict_dt
             self._lat_ring.append(dt)
+        self._h_query.observe(dt)
         return pred_dict
 
     def _spmd_guard(self, payload):
@@ -306,8 +370,10 @@ class EngineServer:
             indexed = [(i, serving.supplement(q))
                        for i, q in enumerate(queries)]
             tp = time.perf_counter()
-            per_algo = [dict(algo.batch_predict(model, indexed))
-                        for algo, model in zip(algorithms, models)]
+            with TRACER.span("predict", batch=len(queries),
+                             algorithms=len(algorithms)):
+                per_algo = [dict(algo.batch_predict(model, indexed))
+                            for algo, model in zip(algorithms, models)]
             predict_dt = time.perf_counter() - tp
         out = []
         for i, (q, d) in enumerate(zip(queries, query_dicts)):
@@ -331,6 +397,8 @@ class EngineServer:
             # every query in the window experienced the window's wall
             # time inside the server: one ring sample per query
             self._lat_ring.extend([dt] * len(queries))
+        for _ in queries:
+            self._h_query.observe(dt)
         return out
 
     # -- feedback loop (:526-596) ------------------------------------------
@@ -400,9 +468,14 @@ class EngineServer:
         d = req.json()
         if not isinstance(d, dict):
             raise ValueError("query must be a JSON object")
-        if self.batcher is not None:
-            return Response(200, self.batcher.submit(d))
-        return Response(200, self.handle_query(d))
+        # ingress trace: minted per query. In batched mode the device
+        # work happens under the batcher thread's own batch_predict
+        # trace; submit() records the two-way link so /traces.json ties
+        # a query to the coalesced window that answered it.
+        with TRACER.trace("query"):
+            if self.batcher is not None:
+                return Response(200, self.batcher.submit(d))
+            return Response(200, self.handle_query(d))
 
     def _reload(self, req: Request) -> Response:
         """Hot-swap to the latest COMPLETED instance (:337-358)."""
@@ -455,6 +528,13 @@ class EngineServer:
                 out.update({"p50ServingSec": float(pct[0]),
                             "p95ServingSec": float(pct[1]),
                             "p99ServingSec": float(pct[2])})
+            # registry-derived distributions (ISSUE 2): bucketed
+            # percentiles for the query path, and batch-wait when the
+            # micro-batcher is on — same instruments /metrics exposes
+            out["queryLatency"] = self._h_query.snapshot()
+            if self.batcher is not None and self.batcher.wait_hist \
+                    is not None:
+                out["batchWait"] = self.batcher.wait_hist.snapshot()
             if self.batcher is not None:
                 # realized coalescing (avg/max batch size) — the datum
                 # for tuning micro_batch_wait_ms on a given link
@@ -466,89 +546,71 @@ class EngineServer:
     def _profile(self, req: Request) -> Response:
         """jax.profiler trace control — beyond-parity observability
         (SURVEY.md §5 tracing). POST /profile.json {"action": "start",
-        "dir": "/tmp/trace"} | {"action": "stop"}."""
+        "dir": "/tmp/trace"} | {"action": "stop"}.
+
+        Idempotent (ISSUE 2 satellite): a second start while tracing —
+        which used to raise out of jax.profiler.start_trace and 500 the
+        endpoint — reports the running trace instead, a stop without a
+        trace reports idle, and every response carries the current
+        state."""
         import jax
         d = req.json() or {}
         action = d.get("action")
         if action == "start":
-            trace_dir = d.get("dir", "/tmp/pio_trace")
-            jax.profiler.start_trace(trace_dir)
-            return Response(200, {"message": "tracing", "dir": trace_dir})
+            with self._lock:
+                if self._profile_dir is not None:
+                    return Response(200, {
+                        "message": "already tracing",
+                        "tracing": True, "dir": self._profile_dir})
+                trace_dir = d.get("dir", "/tmp/pio_trace")
+                try:
+                    jax.profiler.start_trace(trace_dir)
+                except RuntimeError as e:
+                    # jax-level tracer already running (started outside
+                    # this endpoint): adopt it so a later stop can
+                    # actually stop it, and report instead of 500ing
+                    self._profile_dir = trace_dir
+                    return Response(200, {
+                        "message": f"profiler already active: {e}",
+                        "tracing": True, "dir": trace_dir})
+                self._profile_dir = trace_dir
+            return Response(200, {"message": "tracing", "tracing": True,
+                                  "dir": trace_dir})
         if action == "stop":
-            jax.profiler.stop_trace()
-            return Response(200, {"message": "trace stopped"})
-        return Response(400, {"message": "action must be start|stop"})
+            with self._lock:
+                if self._profile_dir is None:
+                    return Response(200, {"message": "not tracing",
+                                          "tracing": False})
+                trace_dir = self._profile_dir
+                self._profile_dir = None
+                try:
+                    jax.profiler.stop_trace()
+                except RuntimeError as e:
+                    # adopted/raced trace already gone: still idle
+                    return Response(200, {
+                        "message": f"trace already stopped: {e}",
+                        "tracing": False, "dir": trace_dir})
+            return Response(200, {"message": "trace stopped",
+                                  "tracing": False, "dir": trace_dir})
+        with self._lock:
+            tracing = self._profile_dir is not None
+        return Response(400, {"message": "action must be start|stop",
+                              "tracing": tracing})
 
     def _metrics(self, req: Request) -> Response:
-        """Prometheus text exposition of the serving counters
-        (beyond-parity; same numbers as /stats.json)."""
-        from predictionio_tpu.utils.prometheus import (CONTENT_TYPE,
-                                                        render_metrics)
-        with self._lock:
-            n = self.request_count
-            m = [
-                ("pio_engine_requests_total", "counter",
-                 "Queries served", [(None, n)]),
-                ("pio_engine_serving_seconds_total", "counter",
-                 "Cumulative serve wall time",
-                 [(None, self.serving_seconds)]),
-                ("pio_engine_predict_seconds_total", "counter",
-                 "Cumulative device/predict time",
-                 [(None, self.predict_seconds)]),
-                ("pio_engine_model_swaps_total", "counter",
-                 "Hot model swaps since start (reloads + fold-ins)",
-                 [(None, self.swap_count)]),
-                ("pio_engine_fold_ins_total", "counter",
-                 "Online fold-in swaps since start",
-                 [(None, self.fold_in_count)]),
-                ("pio_engine_fold_in_events_total", "counter",
-                 "Events absorbed by online fold-ins",
-                 [(None, self.fold_in_events)]),
-            ]
-            pct = self._ring_percentiles()
-            if pct is not None:
-                m.append(("pio_engine_serving_seconds", "summary",
-                          "Recent serving-time quantiles (rolling ring)",
-                          [({"quantile": q}, float(v)) for q, v in
-                           zip(("0.5", "0.95", "0.99"), pct)]))
-        if self.batcher is not None:
-            b = self.batcher.stats()
-            m += [
-                ("pio_engine_batches_total", "counter",
-                 "Micro-batch dispatches", [(None, b["batches"])]),
-                ("pio_engine_batched_queries_total", "counter",
-                 "Queries through the micro-batcher",
-                 [(None, b["batchedQueries"])]),
-                ("pio_engine_immediate_batches_total", "counter",
-                 "Dispatches that never blocked on the window",
-                 [(None, b["immediateBatches"])]),
-                ("pio_engine_max_batch_size", "gauge",
-                 "Largest coalesced batch", [(None, b["maxBatchSize"])]),
-                ("pio_engine_batch_exits_total", "counter",
-                 "Why each dispatch closed its batch (attributes a "
-                 "sub-micro_batch realized batch size: drain_gate = "
-                 "client pool was the limit, window = straggler hold "
-                 "expired, full = max_batch hit)",
-                 [({"reason": "full"}, b["exitFullBatch"]),
-                  ({"reason": "drain_gate"}, b["exitDrainGate"]),
-                  ({"reason": "window"}, b["exitWindow"])]),
-                ("pio_engine_avg_inflight_at_dispatch", "gauge",
-                 "Mean submitted-unanswered queries at dispatch (the "
-                 "effective concurrent-client count)",
-                 [(None, round(b["avgInflightAtDispatch"], 3))]),
-            ]
-        if self.coordinator is not None:
-            h = self.coordinator.health()
-            m += [
-                ("pio_engine_mesh_processes", "gauge",
-                 "Processes in the serving mesh",
-                 [(None, h["processes"])]),
-                ("pio_engine_mesh_poisoned", "gauge",
-                 "1 when a mesh broadcast failed and every query answers "
-                 "503 until redeploy", [(None, int(h["poisoned"]))]),
-            ]
-        return Response(200, render_metrics(m),
+        """Prometheus text exposition, rendered solely by the shared
+        metrics registry (ISSUE 2): this server's families (counters,
+        quantile summary, query/batch-wait histograms, batcher and mesh
+        collectors) plus the process-wide ones (JAX runtime, fold/train
+        instruments) through the parent chain."""
+        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+        return Response(200, self.metrics.render(),
                         content_type=CONTENT_TYPE)
+
+    def _traces(self, req: Request) -> Response:
+        """GET /traces.json — recent span trees from the process-wide
+        tracer (?n=, ?kind=, ?sort=slowest)."""
+        return Response(200, traces_response(req.params))
 
     def _build_router(self) -> Router:
         r = Router()
@@ -561,6 +623,7 @@ class EngineServer:
         r.add("GET", "/plugins.json", self._plugins)
         r.add("GET", "/stats.json", self._stats)
         r.add("GET", "/metrics", self._metrics)
+        r.add("GET", "/traces.json", self._traces)
         r.add("POST", "/profile.json", self._profile)
         return r
 

@@ -40,7 +40,11 @@ def cmd_version(args) -> int:
 
 
 def cmd_status(args) -> int:
-    """(Console.scala:1033 status — verify storage + mesh)"""
+    """(Console.scala:1033 status — verify storage + mesh).
+    ``--telemetry`` (ISSUE 2) additionally polls the running servers'
+    /stats.json + /traces.json and prints the compact operator view:
+    counters, registry-derived latency percentiles, fold activity, and
+    the slowest recent traces."""
     from predictionio_tpu.data.storage.registry import Storage
     _print("Inspecting storage backend connections...")
     results = Storage.verify_all_data_objects()
@@ -56,10 +60,64 @@ def cmd_status(args) -> int:
     except Exception as e:
         _print(f"  device init failed: {e}")
         return 1
+    if getattr(args, "telemetry", False):
+        _print_telemetry(args)
     if all(results.values()):
         _print("Your system is all ready to go.")
         return 0
     return 1
+
+
+def _print_hist(name: str, h) -> None:
+    if not isinstance(h, dict) or not h.get("count"):
+        return
+    _print(f"    {name}: n={h['count']} "
+           f"p50={h.get('p50', 0) * 1000:.3f}ms "
+           f"p95={h.get('p95', 0) * 1000:.3f}ms "
+           f"p99={h.get('p99', 0) * 1000:.3f}ms")
+
+
+def _print_telemetry(args) -> None:
+    from predictionio_tpu.utils.http import fetch_json as _fetch_json
+    ip = getattr(args, "ip", None) or "127.0.0.1"
+    engine = f"http://{ip}:{getattr(args, 'engine_port', 8000)}"
+    events = f"http://{ip}:{getattr(args, 'event_server_port', 7070)}"
+
+    _print("Engine server telemetry...")
+    st = _fetch_json(f"{engine}/stats.json")
+    if "error" in st:
+        _print(f"  unreachable: {st['error']}")
+    else:
+        _print(f"  requests={st.get('requestCount')} "
+               f"avgServing={st.get('avgServingSec', 0):.6f}s "
+               f"avgPredict={st.get('avgPredictSec', 0):.6f}s")
+        _print(f"  modelSwaps={st.get('modelSwaps')} "
+               f"foldIns={st.get('foldIns')} "
+               f"foldInEvents={st.get('foldInEvents')} "
+               f"version={st.get('modelVersion')}")
+        _print_hist("queryLatency", st.get("queryLatency"))
+        _print_hist("batchWait", st.get("batchWait"))
+    _print("Event server telemetry...")
+    ev = _fetch_json(f"{events}/stats.json?accessKey="
+                     f"{getattr(args, 'accesskey', '') or ''}")
+    if "error" in ev:
+        _print(f"  unreachable or no --stats: {ev['error']}")
+    else:
+        cur = ev.get("currentWindow", {})
+        _print(f"  window events={cur.get('count')} "
+               f"byEvent={cur.get('byEvent')}")
+    _print("Slowest recent traces (engine)...")
+    traces = _fetch_json(
+        f"{engine}/traces.json?n=5&sort=slowest").get("traces")
+    if not traces:
+        _print("  none")
+    else:
+        for t in traces:
+            spans = t.get("root", {}).get("children", [])
+            stages = ",".join(s.get("name", "?") for s in spans[:6])
+            _print(f"  {t.get('kind'):14s} {t.get('durationMs', 0):>10}ms "
+                   f"links={len(t.get('links', []))} [{stages}] "
+                   f"{t.get('traceId')}")
 
 
 def cmd_build(args) -> int:
@@ -334,7 +392,10 @@ def cmd_eventserver(args) -> int:
 
 def cmd_dashboard(args) -> int:
     from predictionio_tpu.tools.dashboard import Dashboard, DashboardConfig
-    server = Dashboard(DashboardConfig(ip=args.ip, port=args.port))
+    server = Dashboard(DashboardConfig(
+        ip=args.ip, port=args.port,
+        engine_url=args.engine_url,
+        event_server_url=args.event_server_url))
     _print(f"Dashboard is listening on http://{args.ip}:{args.port}")
     return _serve_foreground(server, "dashboard")
 
@@ -621,7 +682,18 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command", required=True)
 
     sub.add_parser("version").set_defaults(func=cmd_version)
-    sub.add_parser("status").set_defaults(func=cmd_status)
+    st = sub.add_parser("status")
+    st.add_argument("--telemetry", action="store_true",
+                    help="also poll the running servers' /stats.json + "
+                         "/traces.json and print the compact operator "
+                         "view (counters, latency percentiles, fold "
+                         "activity, slowest traces)")
+    st.add_argument("--ip", default="127.0.0.1")
+    st.add_argument("--engine-port", type=int, default=8000)
+    st.add_argument("--event-server-port", type=int, default=7070)
+    st.add_argument("--accesskey", default="",
+                    help="event-server access key for its /stats.json")
+    st.set_defaults(func=cmd_status)
 
     b = sub.add_parser("build")
     _add_variant_arg(b)
@@ -711,6 +783,11 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("dashboard")
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
+    db.add_argument("--engine-url", default="http://127.0.0.1:8000",
+                    help="engine server the /telemetry view polls")
+    db.add_argument("--event-server-url",
+                    default="http://127.0.0.1:7070",
+                    help="event server the /telemetry view polls")
     db.set_defaults(func=cmd_dashboard)
 
     adm = sub.add_parser("adminserver")

@@ -4,6 +4,12 @@ Rebuilds the reference's Dashboard
 (reference: tools/src/main/scala/io/prediction/tools/dashboard/Dashboard.scala:76-138
 and the twirl index page): an HTML index of completed evaluation instances
 with per-instance result pages in txt/html/json.
+
+ISSUE 2 adds ``/telemetry``: a compact live view of the stack — the
+engine and event servers' ``/stats.json`` (fetched over HTTP, so the
+dashboard works from its own process) plus this process's own registry
+snapshot and recent traces — and ``/metrics`` for the dashboard process
+itself.
 """
 
 from __future__ import annotations
@@ -12,6 +18,7 @@ import html as _html
 from dataclasses import dataclass
 
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import get_registry
 from predictionio_tpu.utils.http import (HttpServer, Request, Response,
                                          Router)
 
@@ -20,11 +27,16 @@ from predictionio_tpu.utils.http import (HttpServer, Request, Response,
 class DashboardConfig:
     ip: str = "127.0.0.1"
     port: int = 9000
+    # the stack servers the /telemetry view polls
+    engine_url: str = "http://127.0.0.1:8000"
+    event_server_url: str = "http://127.0.0.1:7070"
 
 
 class Dashboard:
     def __init__(self, config: DashboardConfig = DashboardConfig()):
         self.config = config
+        from predictionio_tpu.obs import jaxmon
+        jaxmon.install()   # /metrics carries the JAX runtime families
         self.router = self._build_router()
         self.server = None
 
@@ -64,9 +76,102 @@ class Dashboard:
                             content_type="text/html; charset=UTF-8")
         return Response(200, i.evaluator_results_json)
 
+    # -- ISSUE 2: the compact live telemetry view ---------------------------
+    @staticmethod
+    def _fetch_json(url: str):
+        from predictionio_tpu.utils.http import fetch_json
+        return fetch_json(url)
+
+    @staticmethod
+    def _kv_rows(d: dict, keys) -> str:
+        rows = []
+        for k in keys:
+            if k in d:
+                v = d[k]
+                if isinstance(v, float):
+                    v = f"{v:.6g}"
+                rows.append(f"<tr><td>{_html.escape(str(k))}</td>"
+                            f"<td>{_html.escape(str(v))}</td></tr>")
+        return "".join(rows)
+
+    @staticmethod
+    def _hist_row(name: str, h: dict) -> str:
+        if not isinstance(h, dict) or "count" not in h:
+            return ""
+        cells = "".join(
+            f"<td>{h.get(k, 0.0) * 1000:.3f}</td>"
+            for k in ("p50", "p95", "p99"))
+        return (f"<tr><td>{_html.escape(name)}</td>"
+                f"<td>{h['count']}</td>{cells}</tr>")
+
+    def _telemetry(self, req: Request) -> Response:
+        """GET /telemetry — one page: per-server counters and latency
+        percentiles, slowest recent traces, this process's registry."""
+        cfg = self.config
+        engine = self._fetch_json(f"{cfg.engine_url}/stats.json")
+        events = self._fetch_json(f"{cfg.event_server_url}/stats.json")
+        traces = self._fetch_json(
+            f"{cfg.engine_url}/traces.json?n=10&sort=slowest"
+        ).get("traces", [])
+
+        eng_rows = self._kv_rows(engine, (
+            "error", "requestCount", "avgServingSec", "avgPredictSec",
+            "modelSwaps", "foldIns", "foldInEvents", "modelVersion"))
+        hist_rows = "".join(
+            self._hist_row(name, engine.get(name, {}))
+            for name in ("queryLatency", "batchWait"))
+        ev_rows = self._kv_rows(events, ("error",))
+        cur = events.get("currentWindow")
+        if isinstance(cur, dict):
+            ev_rows += self._kv_rows(cur, ("count",))
+            for k, v in sorted(cur.get("byEvent", {}).items()):
+                ev_rows += (f"<tr><td>event {_html.escape(k)}</td>"
+                            f"<td>{v}</td></tr>")
+        trace_rows = "".join(
+            f"<tr><td>{_html.escape(t.get('kind', '?'))}</td>"
+            f"<td>{_html.escape(t.get('traceId', ''))}</td>"
+            f"<td>{t.get('durationMs')}</td>"
+            f"<td>{len(t.get('links', []))}</td></tr>"
+            for t in traces if isinstance(t, dict))
+        reg_rows = ""
+        for name, val in sorted(get_registry().snapshot().items()):
+            if isinstance(val, dict) and "count" in val:
+                reg_rows += self._hist_row(name, val)
+            elif isinstance(val, (int, float)):
+                reg_rows += (f"<tr><td>{_html.escape(name)}</td>"
+                             f"<td>{val:g}</td></tr>")
+        page = f"""<html><head><title>pio-tpu telemetry</title>
+<meta http-equiv="refresh" content="5"></head><body>
+<h1>Telemetry</h1>
+<h2>Engine server ({_html.escape(cfg.engine_url)})</h2>
+<table border=1>{eng_rows}</table>
+<table border=1><tr><th>histogram</th><th>count</th><th>p50 ms</th>
+<th>p95 ms</th><th>p99 ms</th></tr>{hist_rows}</table>
+<h2>Event server ({_html.escape(cfg.event_server_url)})</h2>
+<table border=1>{ev_rows}</table>
+<h2>Slowest recent traces</h2>
+<table border=1><tr><th>kind</th><th>trace</th><th>ms</th>
+<th>links</th></tr>{trace_rows}</table>
+<h2>This process's registry</h2>
+<table border=1>{reg_rows}</table>
+</body></html>"""
+        return Response(200, page, content_type="text/html; charset=UTF-8")
+
+    def _metrics(self, req: Request) -> Response:
+        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+        return Response(200, get_registry().render(),
+                        content_type=CONTENT_TYPE)
+
+    def _traces(self, req: Request) -> Response:
+        from predictionio_tpu.obs import traces_response
+        return Response(200, traces_response(req.params))
+
     def _build_router(self) -> Router:
         r = Router()
         r.add("GET", "/", self._index)
+        r.add("GET", "/telemetry", self._telemetry)
+        r.add("GET", "/metrics", self._metrics)
+        r.add("GET", "/traces.json", self._traces)
         r.add("GET", "/engine_instances/<id>/evaluator_results.<fmt>",
               self._result)
         return r

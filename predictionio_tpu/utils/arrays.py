@@ -26,7 +26,12 @@ def to_host(obj: Any) -> Any:
     """Recursively convert jax.Array leaves to numpy. Handles dataclasses,
     dicts, lists, tuples (incl. namedtuples), and leaves everything else."""
     if _is_jax_array(obj):
-        return np.asarray(obj)
+        host = np.asarray(obj)
+        # device->host transfer accounting (obs.jaxmon): model gathers
+        # are the big D2H movers on a tunneled chip
+        from predictionio_tpu.obs import jaxmon
+        jaxmon.record_d2h(host.nbytes)
+        return host
     if isinstance(obj, dict):
         return {k: to_host(v) for k, v in obj.items()}
     if isinstance(obj, tuple):

@@ -18,6 +18,14 @@ _lock = threading.Lock()
 _cache: Dict[Tuple[int, Any], Tuple[Any, Any]] = {}
 
 
+def _record_upload(arr):
+    """Host->device transfer accounting (obs.jaxmon): only cache MISSES
+    move bytes, so counting here — not per call — is what makes the
+    counter mean actual link traffic."""
+    from predictionio_tpu.obs import jaxmon
+    jaxmon.record_h2d(int(getattr(arr, "nbytes", 0) or 0))
+
+
 def cached_put(arr, sharding=None):
     """device_put with identity-based memoization. `arr` must be a
     weakref-able host array (numpy ndarray)."""
@@ -30,6 +38,7 @@ def cached_put(arr, sharding=None):
             return entry[1]
     dev = jax.device_put(arr, sharding) if sharding is not None \
         else jax.device_put(arr)
+    _record_upload(arr)
     try:
         ref = weakref.ref(arr, lambda r, k=key: _cache.pop(k, None))
     except TypeError:
@@ -57,6 +66,7 @@ def cached_put_padded(arr, sharding, row_multiple: int):
     padded = arr if target == n else np.concatenate(
         [arr, np.zeros((target - n,) + arr.shape[1:], arr.dtype)])
     dev = jax.device_put(padded, sharding)
+    _record_upload(padded)
     try:
         ref = weakref.ref(arr, lambda r, k=key: _cache.pop(k, None))
     except TypeError:

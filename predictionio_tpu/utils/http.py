@@ -107,6 +107,19 @@ class Response:
 Handler = Callable[[Request], Response]
 
 
+def fetch_json(url: str, timeout: float = 3.0) -> Any:
+    """GET a JSON endpoint, mapping any failure to {"error": str} —
+    the polling pattern shared by `pio status --telemetry` and the
+    dashboard's /telemetry view (an unreachable server is a row in the
+    report, not an exception)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except Exception as e:
+        return {"error": str(e)}
+
+
 def _accepts_gzip(value: str) -> bool:
     """True when an Accept-Encoding value allows gzip — token match, not
     substring (``gzip;q=0`` is an explicit refusal)."""

@@ -3,14 +3,18 @@
 Beyond-parity observability: the reference exposes counters only as
 JSON/HTML status pages (CreateServer.scala:418-420, Stats.scala:40-79);
 modern deployments scrape. Both HTTP servers serve ``GET /metrics`` in
-the v0.0.4 text format rendered here.
+the v0.0.4 text format rendered here — since ISSUE 2 the sample lists
+come from ``obs.metrics.MetricsRegistry.collect()``, never hand-built.
 """
 
 from __future__ import annotations
 
-from typing import Iterable, Mapping, Optional, Sequence, Tuple
+from typing import Iterable, Mapping, Optional, Sequence, Tuple, Union
 
-Sample = Tuple[Optional[Mapping[str, str]], float]
+# (labels, value) — or (name-suffix, labels, value) for histogram
+# component samples (_bucket/_sum/_count ride under one family name)
+Sample = Union[Tuple[Optional[Mapping[str, str]], float],
+               Tuple[str, Optional[Mapping[str, str]], float]]
 
 # the exposition format version this module renders; callers use it as
 # the HTTP Content-Type so header and body can never disagree
@@ -22,19 +26,30 @@ def _escape(v: str) -> str:
             .replace("\n", "\\n"))
 
 
+def _escape_help(v: str) -> str:
+    # HELP lines escape backslash and newline only (no quote context)
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def render_metrics(metrics: Iterable[Tuple[str, str, str,
                                            Sequence[Sample]]]) -> str:
     """metrics: (name, type, help, samples); samples are
-    (labels-or-None, value). Returns the exposition text."""
+    (labels-or-None, value) or (suffix, labels-or-None, value).
+    Returns the exposition text."""
     out = []
     for name, mtype, help_, samples in metrics:
-        out.append(f"# HELP {name} {help_}")
+        out.append(f"# HELP {name} {_escape_help(help_)}")
         out.append(f"# TYPE {name} {mtype}")
-        for labels, value in samples:
+        for sample in samples:
+            if len(sample) == 3:
+                suffix, labels, value = sample
+            else:
+                labels, value = sample
+                suffix = ""
             lab = ""
             if labels:
                 inner = ",".join(f'{k}="{_escape(v)}"'
-                                 for k, v in sorted(labels.items()))
+                                 for k, v in labels.items())
                 lab = "{" + inner + "}"
-            out.append(f"{name}{lab} {value}")
+            out.append(f"{name}{suffix}{lab} {value}")
     return "\n".join(out) + "\n"

@@ -23,12 +23,38 @@ from predictionio_tpu.core.evaluation import Evaluation, MetricEvaluator
 from predictionio_tpu.data.storage.base import (EngineInstance,
                                                 EvaluationInstance, Model)
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import TRACER, get_registry, jaxmon
 
 logger = logging.getLogger(__name__)
 
 
 def _now():
     return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _stage_hist():
+    """Process-wide per-stage training timings (ISSUE 2): one labeled
+    histogram instead of ad-hoc log lines, exposed on every /metrics
+    through the registry parent chain."""
+    return get_registry().histogram(
+        "pio_train_stage_seconds",
+        "Wall time of core-workflow stages, labeled by stage",
+        buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+        labelnames=("stage",))
+
+
+def _timed_stage(hist, stage: str):
+    """Context manager: one span + one histogram observation."""
+    import contextlib
+    import time
+
+    @contextlib.contextmanager
+    def cm():
+        t0 = time.perf_counter()
+        with TRACER.span(stage):
+            yield
+        hist.labels(stage=stage).observe(time.perf_counter() - t0)
+    return cm()
 
 
 def run_train(engine: Engine, engine_params: EngineParams,
@@ -52,15 +78,23 @@ def run_train(engine: Engine, engine_params: EngineParams,
         serving_params=json.dumps(ep_json.get("serving", {})))
     instance_id = instances.insert(instance)
     instance = instances.get(instance_id)
+    hist = _stage_hist()
+    jaxmon.install()
     try:
-        result = engine.train(engine_params, workflow_params)
-        if workflow_params.save_model:
-            serializable = engine.make_serializable_models(
-                result, instance_id, engine_params)
-            blob = engine.serialize_models(serializable)
-            Storage.get_model_data_models().insert(Model(instance_id, blob))
-        instances.update(instance.with_(status="COMPLETED",
-                                        end_time=_now()))
+        with TRACER.trace("train", instance=instance_id,
+                          engine=engine_id):
+            with _timed_stage(hist, "train"):
+                result = engine.train(engine_params, workflow_params)
+            if workflow_params.save_model:
+                with _timed_stage(hist, "serialize"):
+                    serializable = engine.make_serializable_models(
+                        result, instance_id, engine_params)
+                    blob = engine.serialize_models(serializable)
+                with _timed_stage(hist, "persist"):
+                    Storage.get_model_data_models().insert(
+                        Model(instance_id, blob))
+            instances.update(instance.with_(status="COMPLETED",
+                                            end_time=_now()))
         logger.info("Training completed: engine instance %s", instance_id)
         return instance_id
     except Exception:
@@ -91,8 +125,10 @@ def run_evaluation(engine: Engine, evaluation: Evaluation,
         evaluator = MetricEvaluator(evaluation.metric,
                                     list(evaluation.metrics),
                                     output_path=output_path)
-        result = evaluator.evaluate_base(engine, engine_params_list,
-                                         workflow_params)
+        with TRACER.trace("evaluation", instance=instance_id), \
+                _timed_stage(_stage_hist(), "evaluate"):
+            result = evaluator.evaluate_base(engine, engine_params_list,
+                                             workflow_params)
         dao.update(instance.with_(
             status="EVALCOMPLETED", end_time=_now(),
             evaluator_results=result.one_liner(),

@@ -298,9 +298,54 @@ class TestMetrics:
                                           stats=False))
         s.start()
         try:
-            with pytest.raises(urllib.error.HTTPError) as ei:
-                urllib.request.urlopen(
-                    f"http://127.0.0.1:{s.config.port}/metrics", timeout=10)
-            assert ei.value.code == 404
+            # /traces.json shares the gate: ingest traces carry
+            # per-event detail and the route is unauthenticated
+            for path in ("/metrics", "/traces.json"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{s.config.port}{path}",
+                        timeout=10)
+                assert ei.value.code == 404
         finally:
             s.stop()
+
+
+class TestStatsWindowRotation:
+    """ISSUE 2 satellite: after an idle gap longer than one window the
+    stale window must not be reported as "previous"."""
+
+    def _stats_at(self, monkeypatch, times):
+        from predictionio_tpu.data.api import stats as stats_mod
+        clock = iter(times)
+        monkeypatch.setattr(stats_mod.time, "time", lambda: next(clock))
+        return stats_mod.Stats()
+
+    def test_single_window_gap_rotates_normally(self, monkeypatch):
+        W = 3600.0
+        s = self._stats_at(monkeypatch, [0.0, 1.0, W + 1.0])
+        s.update(1, "rate", "user", 201)     # lands in window 0
+        d = s.to_dict(1)                     # read at t = W + 1
+        assert d["previousWindow"]["count"] == 1
+        assert d["currentWindow"]["count"] == 0
+
+    def test_multi_window_gap_clears_stale_previous(self, monkeypatch):
+        W = 3600.0
+        # write at t=1, then nothing until t = 2W + 5: a whole empty
+        # window sat in between, so "previous" must be empty too
+        s = self._stats_at(monkeypatch, [0.0, 1.0, 2 * W + 5.0])
+        s.update(1, "rate", "user", 201)
+        d = s.to_dict(1)
+        assert d["previousWindow"]["count"] == 0
+        assert d["currentWindow"]["count"] == 0
+        assert d["startTime"] == 2 * W + 5.0
+
+    def test_fresh_traffic_after_long_gap_counts_current(self,
+                                                         monkeypatch):
+        W = 3600.0
+        s = self._stats_at(monkeypatch,
+                           [0.0, 1.0, 3 * W, 3 * W + 1.0])
+        s.update(1, "rate", "user", 201)     # old window
+        s.update(1, "buy", "user", 201)      # after the gap
+        d = s.to_dict(1)
+        assert d["currentWindow"]["byEvent"] == {"buy": 1}
+        assert d["previousWindow"]["count"] == 0

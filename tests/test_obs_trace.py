@@ -1,0 +1,120 @@
+"""Tracer semantics: context propagation, span nesting, links,
+discard, the /traces.json snapshot, and the event-id map (ISSUE 2)."""
+
+import threading
+
+import pytest
+
+from predictionio_tpu.obs.trace import Tracer, traces_response
+
+
+@pytest.fixture
+def tracer():
+    return Tracer(per_kind_capacity=8)
+
+
+class TestSpans:
+    def test_nesting_builds_a_tree(self, tracer):
+        with tracer.trace("query") as t:
+            with tracer.span("supplement"):
+                pass
+            with tracer.span("predict") as p:
+                assert p.parent_id == t.root.span_id
+                with tracer.span("kernel") as k:
+                    assert k.parent_id == p.span_id
+        d = tracer.snapshot()[0]
+        root = d["root"]
+        assert root["name"] == "query"
+        names = [c["name"] for c in root["children"]]
+        assert names == ["supplement", "predict"]
+        predict = root["children"][1]
+        assert predict["children"][0]["name"] == "kernel"
+        assert all(c["durationMs"] is not None
+                   for c in root["children"])
+
+    def test_span_outside_trace_is_noop(self, tracer):
+        with tracer.span("orphan") as s:
+            assert s is None
+        assert tracer.snapshot() == []
+
+    def test_exception_marks_span_and_rethrows(self, tracer):
+        with pytest.raises(ValueError):
+            with tracer.trace("query"):
+                with tracer.span("predict"):
+                    raise ValueError("boom")
+        d = tracer.snapshot()[0]
+        assert "boom" in d["root"]["children"][0]["error"]
+        assert "boom" in d["root"]["error"]
+
+    def test_context_is_per_thread(self, tracer):
+        seen = {}
+
+        def other():
+            seen["tid"] = tracer.current_trace_id()
+
+        with tracer.trace("query"):
+            th = threading.Thread(target=other)
+            th.start()
+            th.join()
+            assert tracer.current_trace_id() is not None
+        assert seen["tid"] is None   # no leak across threads
+
+    def test_discard_skips_the_ring(self, tracer):
+        with tracer.trace("fold_tick") as t:
+            t.discard = True
+        assert tracer.snapshot() == []
+
+
+class TestLinksAndEventMap:
+    def test_two_way_links(self, tracer):
+        with tracer.trace("event_ingest") as ingest:
+            ingest_id = ingest.trace_id
+        with tracer.trace("fold_tick") as tick:
+            tick.link(ingest_id)
+            tracer.link_completed(ingest_id, tick.trace_id)
+        by_kind = {d["kind"]: d for d in tracer.snapshot()}
+        assert ingest_id in by_kind["fold_tick"]["links"]
+        assert by_kind["fold_tick"]["traceId"] \
+            in by_kind["event_ingest"]["links"]
+
+    def test_self_link_ignored(self, tracer):
+        with tracer.trace("t") as t:
+            t.link(t.trace_id)
+        assert tracer.snapshot()[0]["links"] == []
+
+    def test_event_map_bounded(self):
+        tracer = Tracer(event_map_capacity=4)
+        for i in range(10):
+            tracer.register_event(f"e{i}", f"t{i}")
+        assert tracer.trace_id_for_event("e0") is None  # evicted
+        assert tracer.trace_id_for_event("e9") == "t9"
+
+
+class TestSnapshot:
+    def test_ring_caps_per_kind(self, tracer):
+        for i in range(20):
+            with tracer.trace("query"):
+                pass
+        assert len(tracer.snapshot(limit=100)) == 8
+
+    def test_kind_filter_and_slowest_sort(self, tracer):
+        import time
+        with tracer.trace("query"):
+            time.sleep(0.02)
+        with tracer.trace("query"):
+            pass
+        with tracer.trace("fold_tick"):
+            pass
+        only_folds = tracer.snapshot(kind="fold_tick")
+        assert [d["kind"] for d in only_folds] == ["fold_tick"]
+        slowest = tracer.snapshot(slowest=True)
+        assert slowest[0]["durationMs"] >= slowest[-1]["durationMs"]
+
+    def test_traces_response_params(self, tracer, monkeypatch):
+        import predictionio_tpu.obs.trace as trace_mod
+        monkeypatch.setattr(trace_mod, "TRACER", tracer)
+        with tracer.trace("query"):
+            pass
+        out = trace_mod.traces_response({"n": "1", "kind": "query"})
+        assert len(out["traces"]) == 1
+        assert traces_response is trace_mod.traces_response

@@ -44,3 +44,55 @@ def test_profile_start_stop(tmp_path):
         assert status == 400
     finally:
         s.stop()
+
+
+def test_profile_toggle_idempotent(tmp_path):
+    """ISSUE 2 satellite: a second {"action": "start"} while tracing
+    used to raise out of jax.profiler.start_trace and 500 the endpoint;
+    start/stop are now idempotent and every response reports state."""
+    from predictionio_tpu.core import Engine
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    from tests.sample_engine import (Algo0, DataSource0, Preparator0,
+                                     Serving0)
+
+    engine = Engine({"": DataSource0}, {"": Preparator0}, {"": Algo0},
+                    {"": Serving0})
+    s = EngineServer(ServerConfig(ip="127.0.0.1", port=0), engine=engine)
+    s.start()
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{s.config.port}/profile.json",
+                data=json.dumps(body).encode(), method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=15) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        # stop with nothing running: 200 + state, not an error
+        status, body = post({"action": "stop"})
+        assert status == 200 and body["tracing"] is False
+
+        trace_dir = str(tmp_path / "trace2")
+        status, body = post({"action": "start", "dir": trace_dir})
+        assert status == 200 and body["tracing"] is True
+
+        # the satellite's repro: second start while tracing must NOT 500
+        status, body = post({"action": "start", "dir": trace_dir})
+        assert status == 200
+        assert body["tracing"] is True
+        assert body["dir"] == trace_dir
+
+        status, body = post({"action": "stop"})
+        assert status == 200 and body["tracing"] is False
+
+        # second stop: still 200, still reports idle
+        status, body = post({"action": "stop"})
+        assert status == 200 and body["tracing"] is False
+
+        # bad action also reports state
+        status, body = post({"action": "nope"})
+        assert status == 400 and body["tracing"] is False
+    finally:
+        s.stop()
