@@ -22,6 +22,7 @@ from __future__ import annotations
 import base64
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -45,6 +46,16 @@ class EventServerConfig:
     ip: str = "0.0.0.0"
     port: int = 7070
     stats: bool = False
+    # durable ingest spill (ISSUE 3): when the event-store write fails
+    # or its circuit breaker is open, accepted events append to a local
+    # WAL and ACK 201 {"spilled": true}; a background replayer drains
+    # the WAL into the primary on recovery (order-preserving, id-deduped)
+    spill: bool = True
+    spill_dir: Optional[str] = None   # default <PIO_FS_BASEDIR>/ingest_spill
+    # event-store breaker: consecutive write failures before failing
+    # fast (straight to the WAL), and the open->half-open probe delay
+    breaker_failures: int = 5
+    breaker_reset_s: float = 5.0
 
 
 class EventServer:
@@ -91,6 +102,25 @@ class EventServer:
             "pio_event_write_seconds",
             "Event-store write latency per accepted event")
         self._window_pin = None
+        # ISSUE 3 resilience: breaker over the event-store write path +
+        # lazy spill WAL (created on first spill, or adopted at start()
+        # when a prior process left an undrained one)
+        from predictionio_tpu.resilience import CircuitBreaker
+        self.breaker = CircuitBreaker(
+            "event_store", failure_threshold=config.breaker_failures,
+            reset_timeout_s=config.breaker_reset_s)
+        self._wal = None
+        self._replayer = None
+        self._wal_lock = threading.Lock()
+        self.spilled_count = 0
+        self.metrics.counter_func(
+            "pio_ingest_spilled_total",
+            "Accepted events diverted to the spill WAL",
+            lambda: self.spilled_count)
+        self.metrics.gauge_func(
+            "pio_ingest_spill_pending_bytes",
+            "Un-replayed bytes in the spill WAL",
+            lambda: (self._wal.pending_bytes() if self._wal else 0))
         self._register_metrics()
         self.router = self._build_router()
         self.server: Optional[HttpServer] = None
@@ -216,25 +246,107 @@ class EventServer:
             self.plugin_context.check_input(
                 {"appId": access_key.appid, "channelId": channel_id,
                  "event": d})
-            event_id = self._insert_traced(event, access_key.appid,
-                                           channel_id)
+            event_id, spilled = self._insert_traced(
+                event, access_key.appid, channel_id)
             if self.config.stats:
                 self.stats.update(access_key.appid, event.event,
                                   event.entity_type, 201)
-            return Response(201, {"eventId": event_id,
-                                  "traceId": tr.trace_id})
+            body = {"eventId": event_id, "traceId": tr.trace_id}
+            if spilled:
+                body["spilled"] = True
+            return Response(201, body)
 
     def _insert_traced(self, event, app_id, channel_id):
         """Storage write under a span + the write-latency histogram,
-        registering event_id -> trace_id for fold-tick linking."""
+        registering event_id -> trace_id for fold-tick linking.
+        Returns ``(event_id, spilled)``."""
         with TRACER.span("storage_write") as sp:
             t0 = time.perf_counter()
-            event_id = self.events.insert(event, app_id, channel_id)
+            event_id, spilled = self._resilient_insert(event, app_id,
+                                                       channel_id)
             self._h_write.observe(time.perf_counter() - t0)
             if sp is not None:
                 sp.attrs["eventId"] = event_id
+                if spilled:
+                    sp.attrs["spilled"] = True
         TRACER.register_event(event_id, TRACER.current_trace_id())
-        return event_id
+        return event_id, spilled
+
+    # -- resilient write path (ISSUE 3) -------------------------------------
+    def _get_wal(self):
+        """The spill WAL + its replayer, created on first need (the path
+        depends on PIO_FS_BASEDIR, and idle servers should not touch
+        disk)."""
+        with self._wal_lock:
+            if self._wal is None:
+                from predictionio_tpu.resilience import (SpillReplayer,
+                                                         SpillWAL)
+                path = self._spill_path()
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                self._wal = SpillWAL(path)
+                self._replayer = SpillReplayer(
+                    self._wal, self.events, app_breaker=self.breaker,
+                    registry=self.metrics)
+                self._replayer.start()
+            return self._wal
+
+    def _spill_path(self) -> str:
+        if self.config.spill_dir:
+            d = self.config.spill_dir
+        else:
+            from predictionio_tpu.data.storage.registry import base_dir
+            d = os.path.join(base_dir(), "ingest_spill")
+        return os.path.join(d, "events.wal")
+
+    #: error classes the spill path treats as TRANSIENT (an outage the
+    #: replay will outlast). Anything else — validation errors, SQL
+    #: constraint rejections — is deterministic: spilling it would ACK
+    #: an event the store will never accept and wedge the replayer
+    #: head-of-line, so those propagate to the client instead.
+    from predictionio_tpu.resilience import \
+        TRANSIENT_ERRORS as TRANSIENT_WRITE_ERRORS
+
+    def _resilient_insert(self, event, app_id, channel_id):
+        """Primary write behind the event-store breaker; on a transient
+        failure or an open circuit the event lands in the durable WAL
+        and is still ACKed (the never-lose-an-accepted-event contract).
+        Returns ``(event_id, spilled)``."""
+        from predictionio_tpu.data.event import new_event_id
+        from predictionio_tpu.resilience import CircuitOpenError
+        if not self.config.spill:
+            return self.events.insert(event, app_id, channel_id), False
+        # pre-assign the id: if a transient failure strikes AFTER the
+        # backend actually committed (timeout on the ack), the spill
+        # carries the SAME id, so the replayer's get-check dedups the
+        # committed copy instead of inserting a second event under a
+        # fresh id (the eventserver_client._with_id retry pattern)
+        if not event.event_id:
+            event = event.with_id(new_event_id())
+        try:
+            self.breaker.allow()
+        except CircuitOpenError:
+            return self._spill(event, app_id, channel_id), True
+        try:
+            eid = self.events.insert(event, app_id, channel_id)
+        except self.TRANSIENT_WRITE_ERRORS as e:
+            self.breaker.record_failure()
+            logger.warning("event-store write failed (%s); spilling", e)
+            return self._spill(event, app_id, channel_id), True
+        except Exception:
+            # a deterministic rejection (validation, constraint): the
+            # store ANSWERED, so it is reachable — that's a breaker
+            # success (and releases a half-open probe slot); the client
+            # gets the honest error instead of a false ACK
+            self.breaker.record_success()
+            raise
+        self.breaker.record_success()
+        return eid, False
+
+    def _spill(self, event, app_id, channel_id) -> str:
+        with TRACER.span("spill_append"):
+            eid = self._get_wal().append(event, app_id, channel_id)
+        self.spilled_count += 1
+        return eid
 
     def _batch_create(self, req: Request) -> Response:
         access_key, channel_id = self._authenticate(req)
@@ -252,9 +364,12 @@ class EventServer:
                     event = Event.from_dict(d)
                     self._check_event_allowed(access_key, event.event)
                     EventValidation.validate(event)
-                    event_id = self._insert_traced(
+                    event_id, spilled = self._insert_traced(
                         event, access_key.appid, channel_id)
-                    results.append({"status": 201, "eventId": event_id})
+                    item = {"status": 201, "eventId": event_id}
+                    if spilled:
+                        item["spilled"] = True
+                    results.append(item)
                     if self.config.stats:
                         self.stats.update(access_key.appid, event.event,
                                           event.entity_type, 201)
@@ -396,8 +511,12 @@ class EventServer:
             return Response(404, {"message": f"webhook {name} not supported"})
         event = connector.to_event(req.json() or {})
         EventValidation.validate(event)
-        event_id = self.events.insert(event, access_key.appid, channel_id)
-        return Response(201, {"eventId": event_id})
+        event_id, spilled = self._resilient_insert(
+            event, access_key.appid, channel_id)
+        body = {"eventId": event_id}
+        if spilled:
+            body["spilled"] = True
+        return Response(201, body)
 
     def _webhook_form(self, req: Request) -> Response:
         access_key, channel_id = self._authenticate(req)
@@ -407,8 +526,12 @@ class EventServer:
             return Response(404, {"message": f"webhook {name} not supported"})
         event = connector.to_event(req.form())
         EventValidation.validate(event)
-        event_id = self.events.insert(event, access_key.appid, channel_id)
-        return Response(201, {"eventId": event_id})
+        event_id, spilled = self._resilient_insert(
+            event, access_key.appid, channel_id)
+        body = {"eventId": event_id}
+        if spilled:
+            body["spilled"] = True
+        return Response(201, body)
 
     def _webhook_get(self, req: Request) -> Response:
         self._authenticate(req)
@@ -449,6 +572,12 @@ class EventServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, background: bool = True) -> "EventServer":
+        # adopt a WAL a prior process left undrained: the replay
+        # contract survives restarts (events spilled before a crash
+        # still reach the primary store)
+        if self.config.spill and os.path.exists(self._spill_path()) \
+                and os.path.getsize(self._spill_path()) > 0:
+            self._get_wal()
         srv = HttpServer(self.router, self.config.ip, self.config.port)
         self.server = srv
         srv.start(background=background)
@@ -463,6 +592,24 @@ class EventServer:
         if self.server:
             self.server.stop()
             self.server = None
+        with self._wal_lock:
+            replayer, self._replayer = self._replayer, None
+            wal, self._wal = self._wal, None
+        if replayer is not None:
+            replayer.stop()
+        if wal is not None:
+            # the WAL file itself persists (durable by design); only
+            # the handle closes. A final opportunistic drain narrows
+            # the restart-replay window without blocking shutdown —
+            # which is why it only runs with the breaker CLOSED: with
+            # the store down the drain can only sleep through retry
+            # backoffs and fail anyway (the restart replay covers it)
+            try:
+                if wal.pending_bytes() and self.breaker.state == "closed":
+                    replayer.drain(max_records=1000)
+            except Exception:
+                logger.debug("final spill drain failed", exc_info=True)
+            wal.close()
 
 
 class AuthError(Exception):

@@ -18,6 +18,13 @@ Configure:
     PIO_STORAGE_SOURCES_<S>_CHANNELS=5=mych,7=other   (optional: the
         REST API addresses channels by NAME; this maps the numeric
         channel ids the Events interface speaks to those names)
+    PIO_STORAGE_SOURCES_<S>_TIMEOUT=60      (connection timeout, seconds)
+    PIO_STORAGE_SOURCES_<S>_RETRIES=3       (attempts per request;
+        transport errors and 503s retry with jittered exponential
+        backoff, honoring a server-sent Retry-After — the event
+        server's shed/breaker paths emit one. Safe for writes: events
+        carry client-assigned ids, so a retried POST overwrites by key
+        instead of duplicating.)
 
 Scope notes (enforced, not silent): an access key is bound to ONE app,
 so calls for a different app_id raise; `init` is a no-op (namespaces are
@@ -61,7 +68,10 @@ class StorageClient:
             if "=" in pair:
                 cid, name = pair.split("=", 1)
                 channel_map[int(cid.strip())] = name.strip()
-        self._events = RemoteEvents(url, self.access_key, channel_map)
+        self._events = RemoteEvents(
+            url, self.access_key, channel_map,
+            timeout_s=float(config.get("TIMEOUT") or 60.0),
+            retries=int(config.get("RETRIES") or 3))
 
     def get_data_object(self, kind: str, namespace: str):
         if kind != "events":
@@ -78,8 +88,13 @@ class RemoteEvents(base.Events):
     connection per thread (the server is a threaded HTTP server; keep-
     alive removes per-call TCP setup from the bulk paths)."""
 
+    #: cap on honoring a server-sent Retry-After (a misconfigured server
+    #: must not park a trainer for an hour)
+    MAX_RETRY_AFTER_S = 30.0
+
     def __init__(self, url: str, access_key: str,
-                 channel_map: Optional[Dict[int, str]] = None):
+                 channel_map: Optional[Dict[int, str]] = None,
+                 timeout_s: float = 60.0, retries: int = 3):
         if "://" not in url:
             # conventional HOSTS form: bare "host" or "host:port"
             url = "http://" + url
@@ -90,6 +105,14 @@ class RemoteEvents(base.Events):
         self.port = p.port or 7070
         self.access_key = access_key
         self.channel_map = channel_map or {}
+        self.timeout_s = timeout_s
+        self.retries = max(1, int(retries))
+        # jittered-backoff schedule for transport errors and 503s (the
+        # shed/breaker paths): full jitter de-synchronizes a fleet of
+        # clients re-hitting a recovering server (ISSUE 3)
+        from predictionio_tpu.resilience import RetryPolicy
+        self._retry = RetryPolicy(max_attempts=self.retries,
+                                  base_delay_s=0.1, max_delay_s=5.0)
         self._app_id: Optional[int] = None   # learned lazily, then pinned
         self._local = threading.local()
 
@@ -97,7 +120,8 @@ class RemoteEvents(base.Events):
     def _conn(self) -> http.client.HTTPConnection:
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = http.client.HTTPConnection(self.host, self.port, timeout=60)
+            c = http.client.HTTPConnection(self.host, self.port,
+                                           timeout=self.timeout_s)
             self._local.conn = c
         return c
 
@@ -112,18 +136,38 @@ class RemoteEvents(base.Events):
         # bulk responses (columnar training reads) gzip ~10x; the server
         # only compresses when asked and past a size floor
         headers["Accept-Encoding"] = "gzip"
-        for attempt in (0, 1):   # one transparent reconnect, like pgsql
+        # Retries are safe for writes too: every insert carries a
+        # client-assigned event id (see _with_id), so a re-send
+        # overwrites by key instead of duplicating.
+        for attempt in range(1, self.retries + 1):
             c = self._conn()
             try:
                 c.request(method, full, body=payload, headers=headers)
                 resp = c.getresponse()
                 data = resp.read()
-                break
             except (http.client.HTTPException, ConnectionError, OSError):
                 self._local.conn = None
                 c.close()
-                if attempt:
+                if attempt >= self.retries:
                     raise
+                self._retry.sleep(self._retry.delay_for(attempt))
+                continue
+            if resp.status == 503 and attempt < self.retries:
+                # overloaded/breaker-open server: honor its Retry-After
+                # (the shed path emits an honest wait bound), bounded;
+                # fall back to the jittered schedule without one
+                ra = resp.headers.get("Retry-After")
+                try:
+                    # clamp to [0, cap]: a buggy proxy's negative value
+                    # must not blow up time.sleep
+                    delay = max(0.0, min(float(ra),
+                                         self.MAX_RETRY_AFTER_S)) \
+                        if ra else self._retry.delay_for(attempt)
+                except ValueError:
+                    delay = self._retry.delay_for(attempt)
+                self._retry.sleep(delay)
+                continue
+            break
         # decode OUTSIDE the retry loop: a corrupt gzip body is a
         # response-decoding problem, not a transport failure — retrying
         # would silently re-send writes (BadGzipFile is an OSError)

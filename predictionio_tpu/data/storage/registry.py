@@ -130,12 +130,28 @@ def get_data_object(repo: str, kind: str):
             cfg = repository_config(repo)
             client = _client_for(cfg)
             namespace = repository_namespace(repo)
-            _dataobjects[key] = client.get_data_object(kind, namespace)
+            obj = client.get_data_object(kind, namespace)
+            if kind == "events":
+                # chaos harness (ISSUE 3): when PIO_FAULTS names a
+                # storage target, every events DAO handed out is
+                # fault-wrapped — any entry point (event server,
+                # scheduler tail, pio import) runs against the faulted
+                # backend with zero code changes
+                from predictionio_tpu.resilience.faults import \
+                    maybe_wrap_events
+                obj = maybe_wrap_events(obj)
+            _dataobjects[key] = obj
         return _dataobjects[key]
 
 
 def clear_cache() -> None:
-    """Drop cached clients/DAOs (tests switch env between cases)."""
+    """Drop cached clients/DAOs (tests switch env between cases). Also
+    forgets the cached PIO_FAULTS injector: the chaos-wrap decision is
+    taken when a DAO is created, so toggling PIO_FAULTS mid-process
+    only takes effect through this reset + DAO re-creation (in a
+    server, PIO_FAULTS is a launch-time setting)."""
+    from predictionio_tpu.resilience.faults import reset_env_injector
+    reset_env_injector()
     with _lock:
         for c in _clients.values():
             close = getattr(c, "close", None)

@@ -100,6 +100,18 @@ class SchedulerConfig:
     drift_ratio: float = 1.5       # post-fold loss / anchor loss escalation
     poll_interval_s: float = 2.0   # background loop cadence
     tail_batch_limit: int = 50_000  # max events consumed per tick
+    # supervision (ISSUE 3): consecutive tick failures back off
+    # exponentially (poll_interval * 2^k, capped), and after
+    # max_tick_failures the scheduler stops folding and escalates to a
+    # full retrain through on_retrain — a wedged fold loop must not
+    # retry on the same cadence forever while the model quietly ages
+    max_tick_failures: int = 5
+    failure_backoff_cap_s: float = 60.0
+    # breaker over the event-store tail read: a down store makes ticks
+    # skip the read (no thread pile-up on a dead backend) until the
+    # half-open probe sees it recover
+    tail_breaker_failures: int = 3
+    tail_breaker_reset_s: float = 10.0
 
 
 class DeltaTrainingScheduler:
@@ -162,6 +174,18 @@ class DeltaTrainingScheduler:
             "pio_fold_upload_bytes_total",
             "Host->device bytes uploaded by fold-in solves (the "
             "per-tick upload cost; ROADMAP open item)")
+        self._c_tick_failures = reg.counter(
+            "pio_fold_tick_failures_total",
+            "Scheduler ticks that raised (tail read, solve, or publish "
+            "failure); consecutive failures back off exponentially")
+        # breaker over the event-store tail read (ISSUE 3)
+        from predictionio_tpu.resilience import CircuitBreaker
+        self._tail_breaker = CircuitBreaker(
+            "scheduler_tail",
+            failure_threshold=config.tail_breaker_failures,
+            reset_timeout_s=config.tail_breaker_reset_s)
+        self.consecutive_failures = 0
+        self.last_error: Optional[str] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -211,40 +235,80 @@ class DeltaTrainingScheduler:
         once, however many entities it touches)."""
         cfg = self.config
         fresh = 0
-        it = self.events.find(
-            app_name=cfg.app_name, channel_name=cfg.channel_name,
-            start_time=self._cursor, event_names=self._event_names(),
-            limit=cfg.tail_batch_limit)
+        # breaker-gated tail: while the event store is down, ticks skip
+        # the read entirely (CircuitOpenError propagates — the loop's
+        # supervision waits for the probe window); after the reset
+        # timeout one probe read is admitted and a success closes the
+        # breaker. The iterator stays LAZY (a full 50k-event tick never
+        # materializes twice); delta state commits only after the loop
+        # completes, so a mid-iteration read failure is side-effect-free.
+        self._tail_breaker.allow()
         new_users: Dict[str, EntityDelta] = {}
         new_items: Dict[str, EntityDelta] = {}
         new_trace_ids: Set[str] = set()
         max_t = self._cursor
         boundary: Set[str] = set()
-        for e in it:
-            if e.event_id is not None and e.event_id in self._seen_at_cursor:
-                continue  # boundary-instant re-read
-            fresh += 1
-            if e.event_id is not None:
-                tid = TRACER.trace_id_for_event(e.event_id)
-                if tid:
-                    new_trace_ids.add(tid)
-            d = EntityDelta.from_event(e)
-            # route by entity TYPE: a rate/buy/view event's subject is a
-            # user and its target an item; a $set on an item is an
-            # item-side delta even though it arrives in entity_id
-            if e.entity_id:
-                side = (new_items if e.entity_type == "item" else new_users)
-                prev = side.get(e.entity_id)
-                side[e.entity_id] = d if prev is None else prev.merge(d)
-            if e.target_entity_id and e.target_entity_type != "user":
-                prev = new_items.get(e.target_entity_id)
-                new_items[e.target_entity_id] = (
-                    d if prev is None else prev.merge(d))
-            if max_t is None or e.event_time > max_t:
-                max_t = e.event_time
-                boundary = {e.event_id} if e.event_id else set()
-            elif e.event_time == max_t and e.event_id:
-                boundary.add(e.event_id)
+        # only STORE work (find + iterator pulls) is attributed to the
+        # breaker; a poisoned event that raises during delta processing
+        # must land in the supervision loop's counted/escalating branch
+        # (the breaker staying closed is what routes it there), not
+        # masquerade as a store outage
+        try:
+            it = iter(self.events.find(
+                app_name=cfg.app_name, channel_name=cfg.channel_name,
+                start_time=self._cursor, event_names=self._event_names(),
+                limit=cfg.tail_batch_limit))
+        except Exception:
+            self._tail_breaker.record_failure()
+            raise
+        while True:
+            try:
+                e = next(it)
+            except StopIteration:
+                break
+            except Exception:
+                self._tail_breaker.record_failure()
+                raise
+            try:
+                if e.event_id is not None \
+                        and e.event_id in self._seen_at_cursor:
+                    continue  # boundary-instant re-read
+                fresh += 1
+                if e.event_id is not None:
+                    tid = TRACER.trace_id_for_event(e.event_id)
+                    if tid:
+                        new_trace_ids.add(tid)
+                d = EntityDelta.from_event(e)
+                # route by entity TYPE: a rate/buy/view event's subject
+                # is a user and its target an item; a $set on an item
+                # is an item-side delta even though it arrives in
+                # entity_id
+                if e.entity_id:
+                    side = (new_items if e.entity_type == "item"
+                            else new_users)
+                    prev = side.get(e.entity_id)
+                    side[e.entity_id] = d if prev is None \
+                        else prev.merge(d)
+                if e.target_entity_id and e.target_entity_type != "user":
+                    prev = new_items.get(e.target_entity_id)
+                    new_items[e.target_entity_id] = (
+                        d if prev is None else prev.merge(d))
+                if max_t is None or e.event_time > max_t:
+                    max_t = e.event_time
+                    boundary = {e.event_id} if e.event_id else set()
+                elif e.event_time == max_t and e.event_id:
+                    boundary.add(e.event_id)
+            except Exception:
+                # delta PROCESSING failed, but the store was answering:
+                # close out the breaker interaction with the verdict
+                # the read evidence supports (this also releases a
+                # half-open probe slot allow() may hold — without it
+                # the breaker would be stuck half-open forever), then
+                # let the supervision loop's counted branch own the
+                # failure (breaker closed routes it there).
+                self._tail_breaker.record_success()
+                raise
+        self._tail_breaker.record_success()
         with self._lock:
             # partition merge through the aggregator's monoid machinery
             self._user_deltas = merge_aggregations(
@@ -393,9 +457,13 @@ class DeltaTrainingScheduler:
             # next tick re-solves and re-publishes, and count nothing as
             # folded — /stats.json must not claim events the serving
             # path never absorbed. The re-solve is deterministic over
-            # the re-read data, so the retry is idempotent.
+            # the re-read data, so the retry is idempotent. The attached
+            # server keeps answering from the stale model and says so
+            # (X-PIO-Model-Staleness-Ms) until a publish lands.
             self._restore_deltas(user_deltas, item_deltas, n_events,
                                  trace_ids)
+            if self.server is not None:
+                self.server.note_publish_failure()
             raise
         self.models = new_models
         self.fold_in_count += 1
@@ -486,11 +554,84 @@ class DeltaTrainingScheduler:
         self._stop.clear()
 
         def loop():
-            while not self._stop.wait(self.config.poll_interval_s):
+            # supervised ticks (ISSUE 3): consecutive failures back off
+            # exponentially (a down event store is probed at the breaker
+            # cadence, not hammered at poll cadence), and a persistently
+            # failing fold loop escalates to a full retrain instead of
+            # retrying on the same cadence forever
+            from predictionio_tpu.resilience import CircuitOpenError
+            cfg = self.config
+            delay = cfg.poll_interval_s
+            while True:
+                if self._stop.wait(delay):
+                    return
                 try:
                     self.tick()
-                except Exception:
-                    logger.exception("scheduler tick failed")
+                    self.consecutive_failures = 0
+                    self.last_error = None
+                    delay = cfg.poll_interval_s
+                except CircuitOpenError as e:
+                    # the tail breaker fast-failing is the INTENDED
+                    # degradation while the store is down — wait for
+                    # the probe window; it must not count toward the
+                    # retrain escalation (a retrain needs the store
+                    # too, and a recovered store should resume folding)
+                    self.last_error = str(e)
+                    delay = min(max(e.retry_after_s,
+                                    cfg.poll_interval_s),
+                                cfg.failure_backoff_cap_s)
+                    logger.warning(
+                        "scheduler tail breaker open; next probe in "
+                        "%.1fs", delay)
+                except Exception as e:
+                    self.last_error = str(e)
+                    self._c_tick_failures.inc()
+                    if self._tail_breaker.state != "closed":
+                        # the failure tripped (or re-tripped, on a
+                        # failed half-open probe) the tail breaker: the
+                        # breaker owns store-read outages — wait for
+                        # its probe cadence, and like the fast-fail
+                        # path above do NOT count toward the retrain
+                        # escalation. Everything else (solve, publish,
+                        # poisoned-event processing) leaves the breaker
+                        # closed — poll_events attributes only store
+                        # work to it — so those failures always land in
+                        # the counted, escalating branch below.
+                        delay = max(cfg.poll_interval_s,
+                                    min(cfg.tail_breaker_reset_s,
+                                        cfg.failure_backoff_cap_s))
+                        logger.warning(
+                            "scheduler tail read failed and the "
+                            "breaker is %s; next attempt in %.1fs",
+                            self._tail_breaker.state, delay)
+                        continue
+                    self.consecutive_failures += 1
+                    delay = min(
+                        cfg.poll_interval_s
+                        * (2 ** self.consecutive_failures),
+                        cfg.failure_backoff_cap_s)
+                    logger.exception(
+                        "scheduler tick failed (%d consecutive)",
+                        self.consecutive_failures)
+                    if (self.consecutive_failures
+                            >= cfg.max_tick_failures
+                            and not self.retrain_requested):
+                        self.retrain_requested = True
+                        report = {
+                            "retrainRequested": True,
+                            "reason": "consecutive_tick_failures",
+                            "failures": self.consecutive_failures,
+                            "lastError": self.last_error,
+                        }
+                        logger.error(
+                            "scheduler: %d consecutive tick failures — "
+                            "escalating to full retrain",
+                            self.consecutive_failures)
+                        if self.on_retrain is not None:
+                            try:
+                                self.on_retrain(report)
+                            except Exception:
+                                logger.exception("on_retrain failed")
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="pio-delta-scheduler")
@@ -515,6 +656,9 @@ class DeltaTrainingScheduler:
             "anchorLoss": self.anchor_loss,
             "lastLoss": self.last_loss,
             "retrainRequested": self.retrain_requested,
+            "consecutiveFailures": self.consecutive_failures,
+            "lastError": self.last_error,
+            "tailBreaker": self._tail_breaker.state,
         }
 
 

@@ -1,0 +1,24 @@
+"""Fault-tolerance layer: retries, circuit breakers, durable ingest
+spill, and seeded chaos injection (ISSUE 3 tentpole).
+
+Transient infra failure is the steady state at production scale; this
+package is the shared substrate every layer degrades through instead of
+crashing:
+
+- ``policy`` — ``RetryPolicy`` (exponential backoff + full jitter under
+  a deadline budget) and ``CircuitBreaker`` (closed/open/half-open per
+  backend, observable via the metrics registry).
+- ``spill`` — ``SpillWAL`` + ``SpillReplayer``: the event server's
+  never-lose-an-accepted-event guarantee when the primary store is down.
+- ``faults`` — ``PIO_FAULTS`` seeded chaos harness wrapping storage
+  backends and HTTP hops; drives the ``-m chaos`` test suite.
+"""
+
+from predictionio_tpu.resilience.policy import (  # noqa: F401
+    TRANSIENT_ERRORS, CircuitBreaker, CircuitOpenError,
+    RetryBudgetExceeded, RetryPolicy, retry_after_hint)
+from predictionio_tpu.resilience.spill import (  # noqa: F401
+    SpillReplayer, SpillWAL)
+from predictionio_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector, FaultSpec, FaultyEvents, InjectedFault,
+    injector_from_env, maybe_wrap_events, reset_env_injector)

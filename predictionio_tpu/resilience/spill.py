@@ -1,0 +1,426 @@
+"""Durable ingest spill: a local write-ahead log behind the event store.
+
+The event server's production promise is that an ACKed event is never
+lost — but the primary store is a network dependency that fails. When a
+write fails (or its circuit breaker is open), the server appends the
+event to a local append-only WAL and ACKs ``201 {"spilled": true}``; a
+background ``SpillReplayer`` drains the WAL into the primary backend on
+recovery, preserving insertion order and deduplicating by event id, so
+the spill is invisible to everything downstream (the scheduler's tail
+read sees replayed events exactly once).
+
+Framing reuses the nativelog discipline (storage/nativelog.py; the C
+log's record = length-prefixed JSON blob + integrity check, torn tail
+repaired on open): each record here is
+
+    <u32 payload_len> <u32 crc32(payload)> <payload bytes>
+
+where the payload is the same compact-JSON event dict the nativelog
+appends, wrapped in a ``{"appId", "channelId", "event"}`` envelope (the
+WAL spans namespaces). A crash mid-append leaves a torn tail that fails
+the length/CRC check; ``_recover()`` truncates to the last valid record
+on open — any byte-prefix of a flushed WAL is a valid WAL.
+
+Replay durability: the drain cursor (byte offset of the first
+un-replayed record) lives in a sidecar file written via temp +
+``os.replace`` (crash-atomic). The worst crash outcome is re-replaying
+the record between an insert and its cursor advance — idempotent,
+because events spill with their ids already assigned and the replayer
+get-checks before insert (event-id dedup), the same client-assigned-id
+idempotency the eventserver/pgsql backends rely on.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Iterator, Optional, Tuple
+
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.resilience.policy import TRANSIENT_ERRORS
+
+logger = logging.getLogger(__name__)
+
+_HEADER = struct.Struct("<II")   # payload length, crc32(payload)
+
+
+class SpillWAL:
+    """Append-only spill log + crash-atomic drain cursor.
+
+    Thread-safe: ingest threads append while the replayer reads; the
+    lock covers file mutation (append, truncate-on-drain, cursor
+    write), reads run against a size snapshot taken under it.
+    """
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self.cursor_path = path + ".cursor"
+        self.fsync = fsync
+        self._lock = threading.RLock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._cursor = self._read_cursor()
+        self._size = self._recover()
+        if self._cursor > self._size:
+            # cursor outlived a WAL the recovery truncated: clamp
+            self._cursor = self._size
+            self._write_cursor(self._cursor)
+        # O(1) pending_count: maintained on append/checkpoint, seeded
+        # by one header-only scan (payloads skipped) at open
+        self._pending_records = self._count_records_from(self._cursor)
+        self._f = open(self.path, "ab")
+
+    # -- framing ------------------------------------------------------------
+    def _recover(self) -> int:
+        """Scan the log, truncating a torn tail (crash mid-append) to
+        the last whole record; returns the valid size."""
+        if not os.path.exists(self.path):
+            return 0
+        valid = 0
+        with open(self.path, "rb") as f:
+            while True:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    break
+                length, crc = _HEADER.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    break
+                valid += _HEADER.size + length
+        actual = os.path.getsize(self.path)
+        if actual != valid:
+            logger.warning("spill WAL %s: truncating torn tail "
+                           "(%d -> %d bytes)", self.path, actual, valid)
+            with open(self.path, "r+b") as f:
+                f.truncate(valid)
+        return valid
+
+    def _count_records_from(self, offset: int) -> int:
+        """Header-only record count from ``offset`` to the valid end
+        (payloads are seeked over, not read/decoded)."""
+        if offset >= self._size or not os.path.exists(self.path):
+            return 0
+        n = 0
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            pos = offset
+            while pos < self._size:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    break
+                length, _ = _HEADER.unpack(header)
+                f.seek(length, 1)
+                pos += _HEADER.size + length
+                n += 1
+        return n
+
+    def _read_cursor(self) -> int:
+        try:
+            with open(self.cursor_path) as f:
+                return int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def _write_cursor(self, offset: int):
+        tmp = f"{self.cursor_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(offset))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.cursor_path)
+
+    # -- write side ---------------------------------------------------------
+    def append(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        """Durably spill one event; assigns an event id if the event has
+        none (the id the client is ACKed with, and the replay dedup
+        key). Returns the id."""
+        eid = event.event_id or new_event_id()
+        payload = json.dumps(
+            {"appId": app_id, "channelId": channel_id,
+             "event": event.with_id(eid).to_dict()},
+            separators=(",", ":")).encode("utf-8")
+        record = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._f.write(record)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+            self._size += len(record)
+            self._pending_records += 1
+        return eid
+
+    # -- read side ----------------------------------------------------------
+    def pending(self) -> Iterator[Tuple[int, int, Optional[int], Event]]:
+        """Yield ``(offset_after_record, app_id, channel_id, event)`` for
+        every un-replayed record, in insertion order."""
+        with self._lock:
+            start, end = self._cursor, self._size
+        if start >= end:
+            return
+        with open(self.path, "rb") as f:
+            f.seek(start)
+            pos = start
+            while pos < end:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    return               # racing recovery truncation
+                length, crc = _HEADER.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    return
+                pos += _HEADER.size + length
+                d = json.loads(payload.decode("utf-8"))
+                yield (pos, d["appId"], d.get("channelId"),
+                       Event.from_dict(d["event"]))
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return self._pending_records
+
+    def pending_bytes(self) -> int:
+        with self._lock:
+            return max(self._size - self._cursor, 0)
+
+    def checkpoint(self, offset: int, records: Optional[int] = None):
+        """Advance the drain cursor (crash-atomic). ``records`` is how
+        many records the caller consumed up to ``offset`` (the replayer
+        always knows); without it the count is recomputed by a
+        header-only scan. A fully-drained WAL is compacted back to zero
+        bytes so it never grows unboundedly across spill episodes."""
+        with self._lock:
+            if offset <= self._cursor:
+                return
+            self._cursor = min(offset, self._size)
+            if self._cursor >= self._size:
+                # fully drained: reset file + cursor instead of letting
+                # the pair creep upward forever
+                self._f.truncate(0)
+                self._f.seek(0)
+                self._size = 0
+                self._cursor = 0
+                self._pending_records = 0
+            elif records is not None:
+                self._pending_records = max(
+                    0, self._pending_records - records)
+            else:
+                self._pending_records = self._count_records_from(
+                    self._cursor)
+            self._write_cursor(self._cursor)
+
+    def close(self):
+        with self._lock:
+            self._f.close()
+
+
+class SpillReplayer:
+    """Background drain of a ``SpillWAL`` into the primary event store.
+
+    Order-preserving (records replay in insertion order; a failure
+    stops the drain at that record rather than skipping it) and
+    idempotent (get-check by event id before insert — a crash between
+    an insert and its cursor advance re-replays into an overwrite/skip,
+    never a duplicate). Inserts run under the store's circuit breaker
+    and a jittered retry policy, so a replayer probing a still-down
+    backend backs off instead of hammering it.
+    """
+
+    def __init__(self, wal: SpillWAL, events, app_breaker=None,
+                 policy=None, interval_s: float = 1.0, registry=None,
+                 batch_checkpoint: int = 32, quarantine_after: int = 5):
+        from predictionio_tpu.resilience.policy import RetryPolicy
+        self.wal = wal
+        self.events = events
+        self.breaker = app_breaker
+        self.policy = policy or RetryPolicy(max_attempts=2,
+                                            base_delay_s=0.05)
+        self.interval_s = interval_s
+        self.batch_checkpoint = max(1, batch_checkpoint)
+        # poisoned-record guard: a record the HEALTHY store rejects
+        # this many drains in a row is moved to the quarantine sidecar
+        # so it cannot wedge every later-spilled event behind it
+        self.quarantine_after = max(1, quarantine_after)
+        self._head_fail_offset: Optional[int] = None
+        self._head_fail_count = 0
+        self.replayed = 0
+        self.deduped = 0
+        self.quarantined = 0
+        self.last_error: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if registry is None:
+            from predictionio_tpu.obs import get_registry
+            registry = get_registry()
+        self._c_replayed = registry.counter(
+            "pio_spill_replayed_total",
+            "Spilled events drained into the primary event store")
+        self._c_deduped = registry.counter(
+            "pio_spill_deduped_total",
+            "Replay records skipped because the id already existed "
+            "(crash-window re-replays)")
+        self._c_quarantined = registry.counter(
+            "pio_spill_quarantined_total",
+            "Replay records the healthy store rejected repeatedly, "
+            "moved to the .quarantine sidecar (alert: these need "
+            "operator attention)")
+
+    #: the shared outage-class error set (resilience.TRANSIENT_ERRORS —
+    #: the same classification the event server spills on). Anything
+    #: else is a deterministic rejection by a REACHABLE store — a
+    #: breaker success, and quarantine bait.
+    TRANSIENT_ERRORS = TRANSIENT_ERRORS
+
+    def _insert_one(self, app_id, channel_id, event: Event) -> bool:
+        """One record into the primary store; True = inserted, False =
+        deduped. Raises on (breaker-gated, retried) failure."""
+        def attempt():
+            if self.breaker is not None:
+                self.breaker.allow()
+            try:
+                existing = self.events.get(event.event_id, app_id,
+                                           channel_id)
+                if existing is None:
+                    self.events.insert(event, app_id, channel_id)
+            except self.TRANSIENT_ERRORS:
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                raise
+            except Exception:
+                # the store ANSWERED (with a rejection): reachable —
+                # breaker success, so repeated rejections are visible
+                # to the quarantine guard instead of opening the breaker
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                raise
+            if self.breaker is not None:
+                self.breaker.record_success()
+            return existing is None
+
+        return self.policy.call(attempt)
+
+    def _note_head_failure(self, offset: int, app_id, channel_id,
+                           event: Event, error: Exception) -> bool:
+        """Track repeated failures of the record at the drain head.
+        Returns True when the record was quarantined (drain may step
+        past it). Only DETERMINISTIC rejections count — transient
+        (outage-class) errors, including policy-wrapped retries and
+        breaker fast-fails, are never quarantine grounds; neither is
+        anything that happens while the breaker is not closed."""
+        from predictionio_tpu.resilience.policy import CLOSED
+        if isinstance(error, self.TRANSIENT_ERRORS):
+            # RetryBudgetExceeded and CircuitOpenError are IOErrors,
+            # so wrapped transient retries land here too
+            return False
+        if self.breaker is not None and self.breaker.state != CLOSED:
+            return False
+        if self._head_fail_offset != offset:
+            self._head_fail_offset = offset
+            self._head_fail_count = 0
+        self._head_fail_count += 1
+        if self._head_fail_count < self.quarantine_after:
+            return False
+        qpath = self.wal.path + ".quarantine"
+        with open(qpath, "a") as f:
+            f.write(json.dumps({
+                "appId": app_id, "channelId": channel_id,
+                "event": event.to_dict(), "error": str(error)}) + "\n")
+        self.quarantined += 1
+        self._c_quarantined.inc()
+        self._head_fail_offset = None
+        self._head_fail_count = 0
+        logger.error(
+            "spill replay: healthy store rejected event %s %d times "
+            "(%s) — quarantined to %s; later records resume draining",
+            event.event_id, self.quarantine_after, error, qpath)
+        return True
+
+    def drain(self, max_records: Optional[int] = None) -> int:
+        """Replay pending records in order until the WAL is empty, the
+        cap is hit, or an insert fails. A transient failure stops the
+        drain AT the failing record (nothing is skipped); a record the
+        HEALTHY store keeps rejecting is quarantined after
+        ``quarantine_after`` drains so it cannot wedge the records
+        behind it. Returns records replayed+deduped."""
+        done = 0
+        last_offset = None
+        since_ckpt = 0
+        try:
+            for offset, app_id, channel_id, event in self.wal.pending():
+                try:
+                    inserted = self._insert_one(app_id, channel_id, event)
+                except Exception as e:
+                    self.last_error = str(e)
+                    if self._note_head_failure(offset, app_id,
+                                               channel_id, event, e):
+                        # quarantined: step past it and keep draining
+                        self.wal.checkpoint(offset,
+                                            records=since_ckpt + 1)
+                        since_ckpt = 0
+                        last_offset = None
+                        continue
+                    logger.warning("spill replay stopped at event %s: %s",
+                                   event.event_id, e)
+                    break
+                if inserted:
+                    self.replayed += 1
+                    self._c_replayed.inc()
+                else:
+                    self.deduped += 1
+                    self._c_deduped.inc()
+                done += 1
+                since_ckpt += 1
+                last_offset = offset
+                if done % self.batch_checkpoint == 0:
+                    self.wal.checkpoint(offset, records=since_ckpt)
+                    since_ckpt = 0
+                    last_offset = None
+                if max_records is not None and done >= max_records:
+                    break
+            else:
+                self.last_error = None
+                self._head_fail_offset = None
+                self._head_fail_count = 0
+        finally:
+            if last_offset is not None:
+                self.wal.checkpoint(last_offset, records=since_ckpt)
+        return done
+
+    # -- background loop ----------------------------------------------------
+    def start(self) -> "SpillReplayer":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    if self.wal.pending_bytes():
+                        from predictionio_tpu.obs import TRACER
+                        with TRACER.trace("spill_replay") as tr:
+                            n = self.drain()
+                            tr.root.attrs["events"] = n
+                            tr.discard = n == 0
+                except Exception:
+                    logger.exception("spill replay tick failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="pio-spill-replayer")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def stats(self) -> dict:
+        return {"pending": self.wal.pending_count(),
+                "pendingBytes": self.wal.pending_bytes(),
+                "replayed": self.replayed,
+                "deduped": self.deduped,
+                "lastError": self.last_error}

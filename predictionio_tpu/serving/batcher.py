@@ -39,6 +39,32 @@ from typing import Any, Optional
 logger = logging.getLogger(__name__)
 
 
+class ShedError(RuntimeError):
+    """Load shed: the queue's wait bound exceeds the request's deadline,
+    so the server answers 503 + Retry-After NOW instead of burning a
+    thread on an answer the client will have abandoned (ISSUE 3
+    graceful degradation). ``retry_after_s`` is the server's own wait
+    bound — the honest earliest time a retry could be served."""
+
+    http_status = 503
+
+    def __init__(self, wait_bound_s: float, deadline_s: float):
+        super().__init__(
+            f"overloaded: queue wait bound {wait_bound_s * 1000:.0f}ms "
+            f"exceeds request deadline {deadline_s * 1000:.0f}ms")
+        self.retry_after_s = wait_bound_s
+
+
+class ShutdownError(RuntimeError):
+    """The micro-batcher is stopping; queued requests fail explicitly
+    instead of hanging their futures."""
+
+    http_status = 503
+
+    def __init__(self, message: str = "server shutting down"):
+        super().__init__(message)
+
+
 class _Pending:
     __slots__ = ("query", "event", "result", "error", "t_enqueue",
                  "trace_id", "batch_trace_id")
@@ -104,6 +130,12 @@ class MicroBatcher:
         # signal: hold only while the batch is smaller than this
         self._inflight = 0
         self._flight_lock = threading.Lock()
+        # deadline shedding (ISSUE 3): EWMA of per-batch service time
+        # feeds the queue wait bound; requests whose deadline the bound
+        # already exceeds are refused at admission with 503+Retry-After
+        self._service_ewma_s = 0.0
+        self.n_shed = 0
+        self.n_shutdown_failed = 0
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
         self.wait_hist = None
@@ -143,6 +175,16 @@ class MicroBatcher:
                 lambda: round(self.inflight_at_dispatch_sum
                               / self.n_batches, 3)
                 if self.n_batches else 0.0)
+            metrics.counter_func(
+                "pio_engine_shed_total",
+                "Queries refused at admission because the queue wait "
+                "bound exceeded their deadline (503 + Retry-After)",
+                lambda: self.n_shed)
+            metrics.gauge_func(
+                "pio_engine_queue_wait_bound_seconds",
+                "Current admission-time wait bound (queue depth x EWMA "
+                "batch service time + window)",
+                lambda: self.queue_wait_bound_s())
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -161,12 +203,43 @@ class MicroBatcher:
                 "exitFullBatch": self.n_exit_full,
                 "exitDrainGate": self.n_exit_drain_gate,
                 "exitWindow": self.n_exit_window,
+                "shedQueries": self.n_shed,
+                "queueWaitBoundSec": self.queue_wait_bound_s(),
                 "avgInflightAtDispatch": (
                     self.inflight_at_dispatch_sum / nb if nb else 0.0)}
 
-    def submit(self, query) -> Any:
-        """Blocking: enqueue and wait for the batched result."""
+    def queue_wait_bound_s(self) -> float:
+        """Upper bound on how long a query enqueued NOW waits before its
+        batch dispatches: the batch currently on the device (if any)
+        plus every queued batch ahead of it costs one EWMA service time
+        each, plus one coalescing window. An idle batcher returns 0 —
+        the drain gate dispatches a lone query immediately, so nothing
+        with a deadline is ever shed at zero load. This is the
+        admission-control signal AND the Retry-After value on sheds —
+        the server's honest estimate, not a constant."""
+        depth = self._q.qsize()
+        # inflight > queued means a dispatched batch occupies the device
+        busy = 1 if self._inflight > depth else 0
+        batches_ahead = (depth + self.max_batch - 1) // self.max_batch \
+            + busy
+        if batches_ahead == 0:
+            return 0.0
+        return batches_ahead * self._service_ewma_s + self.max_wait_s
+
+    def submit(self, query, deadline_s: Optional[float] = None) -> Any:
+        """Blocking: enqueue and wait for the batched result.
+
+        ``deadline_s``: the request's remaining deadline budget
+        (propagated from HTTP ingress). When the queue's wait bound
+        already exceeds it the query is shed at admission with
+        ``ShedError`` (503 + Retry-After) — wasted-work protection
+        under saturation while in-deadline queries still answer."""
         from predictionio_tpu.obs import TRACER
+        if deadline_s is not None:
+            bound = self.queue_wait_bound_s()
+            if bound > deadline_s:
+                self.n_shed += 1
+                raise ShedError(bound, deadline_s)
         p = _Pending(query)
         p.trace_id = TRACER.current_trace_id()
         with self._flight_lock:
@@ -174,7 +247,7 @@ class MicroBatcher:
             # (both under _flight_lock), so no submitter can slip a
             # pending item in after the shutdown sweep ran
             if self._stop.is_set():
-                raise RuntimeError("micro-batcher is shut down")
+                raise ShutdownError("micro-batcher is shut down")
             self._inflight += 1
             self._q.put(p)
         with TRACER.span("batch_wait"):
@@ -245,6 +318,17 @@ class MicroBatcher:
                 self.n_exit_window += 1
             if not held:
                 self.n_immediate += 1
+            if self._stop.is_set():
+                # stop landed while this batch was collecting: fail its
+                # members explicitly rather than racing a device call
+                # against interpreter teardown
+                with self._flight_lock:
+                    self._inflight -= len(batch)
+                for p in batch:
+                    self.n_shutdown_failed += 1
+                    p.error = ShutdownError()
+                    p.event.set()
+                continue
             t_dispatch = time.perf_counter()
             if self.wait_hist is not None:
                 for p in batch:
@@ -266,6 +350,13 @@ class MicroBatcher:
                 for p in batch:
                     p.error = e
                     p.event.set()
+            # EWMA of batch service time: the queue wait bound's basis.
+            # Updated on the dispatch thread only; alpha 0.2 smooths
+            # device-warmup spikes without lagging a real slowdown.
+            dt = time.perf_counter() - t_dispatch
+            self._service_ewma_s = (dt if self._service_ewma_s == 0.0
+                                    else 0.8 * self._service_ewma_s
+                                    + 0.2 * dt)
 
     def _run_batch(self, batch):
         """One dispatch. When any member carries an ingress trace, the
@@ -283,14 +374,19 @@ class MicroBatcher:
                 p.batch_trace_id = bt.trace_id
             return self.process_batch([p.query for p in batch])
 
-    def stop(self):
+    def stop(self, join_timeout_s: float = 10.0):
+        """Drain-on-stop: the dispatch thread is given time to finish
+        the batch on the device, then every request still queued (or
+        collected but not yet dispatched) fails with an explicit
+        "server shutting down" 503 — no future ever hangs. Atomic with
+        submit()'s check-and-enqueue via _flight_lock, so nothing can
+        enqueue after the sweep."""
         self._stop.set()
-        self._thread.join(timeout=2)
-        # fail every waiter still queued: without this sweep their
-        # untimed event.wait() blocks forever and a clean shutdown
-        # strands request threads mid-flight. Atomic with submit()'s
-        # check-and-enqueue via _flight_lock, so nothing can enqueue
-        # after the sweep.
+        self._thread.join(timeout=join_timeout_s)
+        if self._thread.is_alive():
+            logger.warning(
+                "micro-batcher dispatch thread still busy after %.1fs; "
+                "sweeping the queue anyway", join_timeout_s)
         with self._flight_lock:
             while True:
                 try:
@@ -298,5 +394,6 @@ class MicroBatcher:
                 except queue.Empty:
                     break
                 self._inflight -= 1
-                p.error = RuntimeError("server shutting down")
+                self.n_shutdown_failed += 1
+                p.error = ShutdownError()
                 p.event.set()

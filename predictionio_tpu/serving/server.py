@@ -125,6 +125,13 @@ class EngineServer:
         self.fold_in_count = 0
         self.fold_in_events = 0
         self.model_version: Optional[str] = None
+        # graceful degradation (ISSUE 3): when a fold-in publish/hot-swap
+        # fails the server keeps answering from the stale-but-valid
+        # model and advertises its age via the X-PIO-Model-Staleness-Ms
+        # response header until a swap lands again
+        self.publish_degraded = False
+        self.publish_failures = 0
+        self._last_swap_wall = time.time()
         self.start_time = utcnow()
         self.server: Optional[HttpServer] = None
         # jax.profiler trace state for the idempotent /profile.json
@@ -178,6 +185,17 @@ class EngineServer:
         m.summary_func("pio_engine_serving_seconds",
                        "Recent serving-time quantiles (rolling ring)",
                        self._quantile_samples)
+        m.gauge_func("pio_engine_model_stale",
+                     "1 while serving a stale model because a fold-in "
+                     "publish/hot-swap failed",
+                     lambda: int(self.publish_degraded))
+        m.gauge_func("pio_engine_model_staleness_seconds",
+                     "Age of the serving model (since last load/swap)",
+                     lambda: self.model_staleness_s())
+        m.counter_func("pio_engine_publish_failures_total",
+                       "Fold-in publish/hot-swap failures reported by "
+                       "the scheduler",
+                       lambda: self.publish_failures)
         if self.coordinator is not None:
             m.gauge_func("pio_engine_mesh_processes",
                          "Processes in the serving mesh",
@@ -248,6 +266,8 @@ class EngineServer:
             self.models = result.models
             self.serving = self.engine.make_serving(self.engine_params)
             self.model_version = instance.id
+            self._last_swap_wall = time.time()
+            self.publish_degraded = False
             if was_loaded:
                 self.swap_count += 1  # /reload hot-swap, not first load
             logger.info("Engine instance %s loaded (%d algorithm(s))",
@@ -274,8 +294,24 @@ class EngineServer:
             self.fold_in_events += int(fold_in_events)
             if version is not None:
                 self.model_version = version
+            # a landed swap ends any stale-model degradation window
+            self._last_swap_wall = time.time()
+            self.publish_degraded = False
         logger.info("Hot-swapped models (swap #%d, version %s)",
                     self.swap_count, version or "<in-process>")
+
+    # -- graceful degradation (ISSUE 3) -------------------------------------
+    def note_publish_failure(self):
+        """The scheduler reports a failed fold-in publish/hot-swap: keep
+        serving the stale-but-valid model, but say so — queries gain the
+        X-PIO-Model-Staleness-Ms header and /metrics flips
+        pio_engine_model_stale until a swap lands."""
+        with self._lock:
+            self.publish_degraded = True
+            self.publish_failures += 1
+
+    def model_staleness_s(self) -> float:
+        return max(time.time() - self._last_swap_wall, 0.0)
 
     # -- query path (ServerActor.myRoute /queries.json, :490-641) ----------
     def handle_query(self, query_dict: dict) -> dict:
@@ -464,18 +500,48 @@ class EngineServer:
 {tail}</table></body></html>"""
         return Response(200, html, content_type="text/html; charset=UTF-8")
 
+    @staticmethod
+    def _request_deadline_s(req: Request) -> Optional[float]:
+        """Deadline budget propagated from HTTP ingress (ISSUE 3):
+        ``X-PIO-Deadline-Ms`` header or ``deadlineMs`` query param —
+        how long the CLIENT will still care about the answer. Fed to
+        the batcher's admission control so saturated queues shed
+        out-of-deadline work with 503 + Retry-After."""
+        raw = (req.headers.get("X-PIO-Deadline-Ms")
+               or req.params.get("deadlineMs"))
+        if not raw:
+            return None
+        try:
+            ms = float(raw)
+        except ValueError:
+            raise ValueError(f"bad deadline {raw!r}: want milliseconds")
+        if ms <= 0:
+            raise ValueError("deadline must be positive milliseconds")
+        return ms / 1000.0
+
+    def _degraded_headers(self) -> Optional[dict]:
+        """The stale-model advisory header while a fold-in publish
+        failure leaves this server behind the event stream."""
+        if not self.publish_degraded:
+            return None
+        return {"X-PIO-Model-Staleness-Ms":
+                str(int(self.model_staleness_s() * 1000))}
+
     def _queries(self, req: Request) -> Response:
         d = req.json()
         if not isinstance(d, dict):
             raise ValueError("query must be a JSON object")
+        deadline_s = self._request_deadline_s(req)
         # ingress trace: minted per query. In batched mode the device
         # work happens under the batcher thread's own batch_predict
         # trace; submit() records the two-way link so /traces.json ties
         # a query to the coalesced window that answered it.
         with TRACER.trace("query"):
             if self.batcher is not None:
-                return Response(200, self.batcher.submit(d))
-            return Response(200, self.handle_query(d))
+                out = self.batcher.submit(d, deadline_s=deadline_s)
+            else:
+                out = self.handle_query(d)
+            return Response(200, out, headers=self._degraded_headers())
 
     def _reload(self, req: Request) -> Response:
         """Hot-swap to the latest COMPLETED instance (:337-358)."""
@@ -522,6 +588,11 @@ class EngineServer:
                 "foldIns": self.fold_in_count,
                 "foldInEvents": self.fold_in_events,
                 "modelVersion": self.model_version,
+                # graceful-degradation state (ISSUE 3): is this server
+                # knowingly serving a stale model, and how stale
+                "publishDegraded": self.publish_degraded,
+                "publishFailures": self.publish_failures,
+                "modelStalenessSec": self.model_staleness_s(),
             }
             pct = self._ring_percentiles()
             if pct is not None:

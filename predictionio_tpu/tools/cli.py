@@ -655,6 +655,55 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_faults(args) -> int:
+    """Chaos-harness control (ISSUE 3): parse/validate a PIO_FAULTS
+    spec, show what is active, and preview the seeded decision stream —
+    the operator's dry run before pointing chaos at a live stack."""
+    import os as _os
+
+    from predictionio_tpu.resilience.faults import (ENV_VAR, FaultInjector,
+                                                    FaultSpec, InjectedFault)
+    spec_s = args.spec or _os.environ.get(ENV_VAR, "")
+    if not spec_s.strip():
+        _print(f"No fault spec: set {ENV_VAR} or pass --spec.")
+        _print("Syntax: target:key=value[,key=value][;target:...]")
+        _print("  e.g. 'storage.write:error=0.3,seed=42'")
+        return 0
+    try:
+        spec = FaultSpec.parse(spec_s)
+    except ValueError as e:
+        _print(f"Invalid fault spec: {e}")
+        return 1
+    _print(f"Fault spec OK (seed={spec.seed if spec.seed is not None else 0}):")
+    for target, rule in sorted(spec.rules.items()):
+        bits = []
+        if rule.error:
+            bits.append(f"error={rule.error:g}")
+        if rule.partition:
+            bits.append(f"partition={rule.partition:g}")
+        if rule.latency_ms:
+            rate = 1.0 if rule.latency_rate is None else rule.latency_rate
+            bits.append(f"latency={rule.latency_ms:g}ms@{rate:g}")
+        _print(f"  {target:16s} {', '.join(bits) or '(no-op)'}")
+    if args.preview:
+        inj = FaultInjector(spec, sleep=lambda s: None)
+        _print(f"First {args.preview} seeded decisions for "
+               f"{args.target!r}:")
+        for i in range(args.preview):
+            try:
+                inj.before(args.target)
+                _print(f"  {i:3d}  ok")
+            except InjectedFault:
+                _print(f"  {i:3d}  ERROR (injected)")
+            except ConnectionError:
+                _print(f"  {i:3d}  PARTITION (injected)")
+    active = _os.environ.get(ENV_VAR, "").strip()
+    _print(f"{ENV_VAR} is "
+           + (f"ACTIVE in this environment: {active}" if active
+              else "not set (pass it to the server process to arm)"))
+    return 0
+
+
 def cmd_upgrade(args) -> int:
     """(Console upgrade / WorkflowUtils.checkUpgrade — the reference phones
     home for new versions; this build is offline, so upgrade is a no-op
@@ -917,6 +966,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     up = sub.add_parser("upgrade")
     up.set_defaults(func=cmd_upgrade)
+
+    fl = sub.add_parser(
+        "faults", help="chaos-harness control: validate a PIO_FAULTS "
+        "spec and preview its seeded decisions")
+    fl.add_argument("--spec", help="fault spec (default: $PIO_FAULTS)")
+    fl.add_argument("--preview", type=int, default=0, metavar="N",
+                    help="print the first N seeded decisions")
+    fl.add_argument("--target", default="storage.write",
+                    help="target for --preview (default storage.write)")
+    fl.set_defaults(func=cmd_faults)
 
     return p
 

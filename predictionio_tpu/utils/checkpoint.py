@@ -41,13 +41,27 @@ def save_sharded(path: str, arrays: Dict[str, Any]) -> bool:
         ckptr.wait_until_finished()
         return True
     except Exception:
-        # single-host fallback: host-gather + npz
+        # single-host fallback: host-gather + npz. Crash-atomic: the
+        # blob is written to a temp file in the same directory and
+        # os.replace()d into place, so a crash mid-save leaves either
+        # the previous complete checkpoint or none — never a torn
+        # arrays.npz that restore_sharded half-loads.
         import jax
         if jax.process_count() > 1:
             raise
         host = {k: np.asarray(v) for k, v in arrays.items()}
         os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, "arrays.npz"), **host)
+        final = os.path.join(path, "arrays.npz")
+        tmp = os.path.join(path, f".arrays.npz.tmp.{os.getpid()}")
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **host)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         return True
 
 

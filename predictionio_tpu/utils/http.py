@@ -93,6 +93,9 @@ class Response:
     status: int = 200
     body: Any = None           # dict/list -> JSON; str -> as-is
     content_type: str = "application/json; charset=UTF-8"
+    # extra response headers (Retry-After on sheds, model-staleness on
+    # degraded serving); None avoids a dict per ordinary response
+    headers: Optional[Dict[str, str]] = None
 
     def payload(self) -> bytes:
         if self.body is None:
@@ -219,17 +222,28 @@ class HttpServer:
                                           f"missing field {e}"})
                 except Exception as e:
                     # exceptions that know their HTTP status (e.g. mesh
-                    # coordinator poisoned -> 503) pass it through
+                    # coordinator poisoned -> 503, a shed query, an
+                    # open circuit breaker) pass it through; a
+                    # retry_after_s attribute becomes the Retry-After
+                    # header so well-behaved clients back off for the
+                    # server-known recovery window
                     status = getattr(e, "http_status", None)
                     if status:
                         logger.error("handler error (%d): %s", status, e)
                         resp = Response(int(status), {"message": str(e)})
+                        ra = getattr(e, "retry_after_s", None)
+                        if ra is not None:
+                            resp.headers = {
+                                "Retry-After":
+                                    str(max(1, int(float(ra) + 0.5)))}
                     else:
                         logger.exception("handler error")
                         resp = Response(500, {"message": str(e)})
                 payload = resp.payload()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
+                for hk, hv in (resp.headers or {}).items():
+                    self.send_header(hk, hv)
                 # transparent gzip for clients that ask: bulk JSON (the
                 # columnar training reads) compresses ~10x, which is the
                 # difference on a thin link; tiny responses skip the

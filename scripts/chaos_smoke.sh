@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Chaos smoke: run the seeded fault-injection suite deterministically.
+#
+# The chaos tests (`-m chaos`, tests/test_chaos.py) drive the real
+# ingest -> spill -> replay, breaker, shed, and degraded-serving paths
+# against seeded fault injection and assert zero event loss. They are
+# excluded from the tier-1 `-m 'not slow'` lane (the chaos marker
+# implies slow — tests/conftest.py); this script is their entry point
+# for CI and for an operator rehearsing failure modes locally.
+#
+# Determinism: every injector in the suite is seeded (specs carry
+# seed=...), jax runs on CPU, and hash randomization is pinned, so a
+# red run reproduces byte-for-byte.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PYTHONHASHSEED=0
+# never inherit ambient chaos into the suite's own controlled specs
+unset PIO_FAULTS 2>/dev/null || true
+
+exec python -m pytest tests/ -q -m chaos -p no:cacheprovider \
+    -p no:randomly --continue-on-collection-errors "$@"

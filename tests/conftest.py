@@ -68,3 +68,19 @@ def pytest_configure(config):
     # communicate(timeout=...)
     config.addinivalue_line(
         "markers", "timeout(seconds): advisory wall-clock bound")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` lane")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection suite (scripts/chaos_smoke.sh); "
+        "implies slow so the tier-1 lane never runs it")
+
+
+def pytest_collection_modifyitems(config, items):
+    # chaos tests stay out of the tier-1 `-m 'not slow'` lane without
+    # every test double-marking: the chaos marker implies slow
+    import pytest as _pytest
+    for item in items:
+        if item.get_closest_marker("chaos") is not None \
+                and item.get_closest_marker("slow") is None:
+            item.add_marker(_pytest.mark.slow)

@@ -1,0 +1,112 @@
+"""Poisoned-mesh serving path (ISSUE 3 satellite): when the broadcast
+watchdog poisons the coordinator, the engine server must answer 503
+with a body NAMING the condition (not a bare failure), and /metrics
+must expose the poisoned gauge an alert can fire on. The real
+watchdog-timeout mechanics are exercised in tests/test_distributed.py
+(test_worker_death_degrades_loudly_not_hang); here a poisoned
+coordinator is injected so the HTTP surface is asserted without a
+2-process mesh."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.serving.mesh_serving import MeshServingUnavailable
+from predictionio_tpu.serving.plugins import EngineServerPluginContext
+from predictionio_tpu.serving.server import EngineServer, ServerConfig
+
+
+def call(port, method, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=(json.dumps(body).encode()
+              if isinstance(body, (dict, list)) else body))
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class _PoisonedCoordinator:
+    """A coordinator after its broadcast watchdog fired: health reports
+    poisoned and every serialized() entry fails fast, exactly like
+    MeshQueryCoordinator post-timeout."""
+
+    multi_process = True
+    is_primary = True
+
+    def health(self):
+        return {"processes": 2, "poisoned": True, "shutdown": False}
+
+    def serialized(self, payload):
+        raise MeshServingUnavailable(
+            "mesh coordinator is poisoned (an earlier broadcast never "
+            "completed; worker dead?); redeploy the mesh")
+
+    def shutdown(self):
+        pass
+
+
+class _Serving:
+    def supplement(self, q):
+        return q
+
+    def serve(self, q, preds):
+        return preds[0]
+
+
+class _Algo:
+    query_class = None
+
+    def predict(self, model, q):
+        return {"never": "reached"}
+
+
+@pytest.fixture
+def poisoned_server():
+    s = EngineServer(
+        ServerConfig(ip="127.0.0.1", port=0, micro_batch=1),
+        plugin_context=EngineServerPluginContext(),
+        mesh_coordinator=_PoisonedCoordinator())
+    s.algorithms = [_Algo()]
+    s.models = [None]
+    s.serving = _Serving()
+
+    class _Inst:
+        id = "inst"
+        engine_factory = "fake"
+
+    s.engine_instance = _Inst()
+    s.start()
+    yield s
+    s.stop()
+
+
+class TestPoisonedMesh:
+    def test_query_answers_503_naming_the_condition(self, poisoned_server):
+        status, body = call(poisoned_server.config.port, "POST",
+                            "/queries.json", {"user": "u1"})
+        assert status == 503
+        msg = json.loads(body)["message"]
+        # the body must NAME the condition and the remedy, not just fail
+        assert "poisoned" in msg
+        assert "redeploy" in msg
+
+    def test_metrics_expose_poisoned_gauge(self, poisoned_server):
+        status, body = call(poisoned_server.config.port, "GET", "/metrics")
+        assert status == 200
+        assert "\npio_engine_mesh_poisoned 1\n" in body
+        assert "\npio_engine_mesh_processes 2\n" in body
+
+    def test_stats_and_status_page_surface_poisoned(self, poisoned_server):
+        p = poisoned_server.config.port
+        status, body = call(p, "GET", "/stats.json")
+        assert status == 200
+        mesh = json.loads(body)["meshCoordinator"]
+        assert mesh["poisoned"] is True and mesh["processes"] == 2
+        status, html = call(p, "GET", "/")
+        assert status == 200
+        assert "POISONED" in html

@@ -1,0 +1,717 @@
+"""Fault-tolerance layer unit tests (ISSUE 3): retry policy, circuit
+breaker, spill WAL + replayer, fault-spec parsing, deadline shedding,
+crash-atomic checkpoints, and client backoff. The end-to-end seeded
+chaos scenarios live in tests/test_chaos.py (`-m chaos`)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.memory import MemEvents
+from predictionio_tpu.obs import MetricsRegistry
+from predictionio_tpu.resilience import (CircuitBreaker, CircuitOpenError,
+                                         FaultInjector, FaultSpec,
+                                         FaultyEvents, InjectedFault,
+                                         RetryBudgetExceeded, RetryPolicy,
+                                         SpillReplayer, SpillWAL)
+
+
+def ev(i, name="rate"):
+    return Event(event=name, entity_type="user", entity_id=f"u{i}",
+                 target_entity_type="item", target_entity_id=f"i{i}",
+                 properties=DataMap({"rating": float(i % 5 + 1)}))
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def _virtual(self, **kw):
+        slept = []
+        clock = [0.0]
+
+        def sleep(s):
+            slept.append(s)
+            clock[0] += s
+
+        return RetryPolicy(sleep=sleep, clock=lambda: clock[0],
+                           **kw), slept
+
+    def test_succeeds_after_transient_failures(self):
+        policy, slept = self._virtual(max_attempts=4, base_delay_s=0.1)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise IOError("transient")
+            return "ok"
+
+        assert policy.call(flaky) == "ok"
+        assert len(calls) == 3
+        assert len(slept) == 2
+
+    def test_gives_up_after_max_attempts(self):
+        policy, slept = self._virtual(max_attempts=3)
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise IOError("down")
+
+        with pytest.raises(RetryBudgetExceeded) as ei:
+            policy.call(dead)
+        assert len(calls) == 3
+        assert isinstance(ei.value.__cause__, IOError)
+
+    def test_non_retryable_propagates_immediately(self):
+        policy, _ = self._virtual(max_attempts=5)
+        calls = []
+
+        def bad_request():
+            calls.append(1)
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            policy.call(bad_request)
+        assert len(calls) == 1
+
+    def test_full_jitter_bounded_by_exponential_cap(self):
+        policy, _ = self._virtual(max_attempts=8, base_delay_s=0.1,
+                                  max_delay_s=1.0)
+        for attempt in range(1, 8):
+            cap = min(1.0, 0.1 * 2 ** (attempt - 1))
+            for _ in range(20):
+                d = policy.delay_for(attempt)
+                assert 0.0 <= d <= cap
+
+    def test_deadline_budget_stops_retries(self):
+        # budget 0.5s, every delay 0.4s: after the first failure the
+        # remaining budget cannot fit sleep + attempt -> stop at 1 retry
+        policy, slept = self._virtual(max_attempts=10, base_delay_s=0.8,
+                                      max_delay_s=0.8, deadline_s=0.5)
+        object.__setattr__(policy, "rng", _FixedRng(0.5))  # delay = 0.4
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise IOError("down")
+
+        with pytest.raises(RetryBudgetExceeded):
+            policy.call(dead)
+        assert len(calls) == 2   # initial + the one retry that fit
+
+    def test_retry_after_hint_overrides_delay(self):
+        policy, slept = self._virtual(max_attempts=2, base_delay_s=10.0)
+
+        class Hinted(IOError):
+            retry_after_s = 0.123
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise Hinted("busy")
+            return "ok"
+
+        assert policy.call(flaky) == "ok"
+        assert slept == [0.123]
+
+
+class _FixedRng:
+    def __init__(self, frac):
+        self.frac = frac
+
+    def uniform(self, lo, hi):
+        return lo + self.frac * (hi - lo)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def _breaker(self, **kw):
+        clock = [0.0]
+        reg = MetricsRegistry()
+        br = CircuitBreaker("test", clock=lambda: clock[0],
+                            registry=reg, **kw)
+        return br, clock, reg
+
+    def test_opens_after_threshold_and_fails_fast(self):
+        br, clock, _ = self._breaker(failure_threshold=3)
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == "closed"
+        br.record_failure()
+        assert br.state == "open"
+        with pytest.raises(CircuitOpenError) as ei:
+            br.allow()
+        assert ei.value.retry_after_s > 0
+
+    def test_half_open_probe_closes_on_success(self):
+        br, clock, _ = self._breaker(failure_threshold=1,
+                                     reset_timeout_s=10.0)
+        br.record_failure()
+        assert br.state == "open"
+        clock[0] += 10.0
+        assert br.state == "half_open"
+        br.allow()                   # the probe slot
+        with pytest.raises(CircuitOpenError):
+            br.allow()               # concurrent caller: probe taken
+        br.record_success()
+        assert br.state == "closed"
+        br.allow()                   # closed admits freely
+
+    def test_failed_probe_reopens_with_doubled_timeout(self):
+        br, clock, _ = self._breaker(failure_threshold=1,
+                                     reset_timeout_s=10.0,
+                                     max_reset_timeout_s=25.0)
+        br.record_failure()
+        clock[0] += 10.0
+        br.allow()
+        br.record_failure()          # probe failed
+        assert br.state == "open"
+        clock[0] += 10.0             # old timeout: not enough now
+        assert br.state == "open"
+        clock[0] += 10.0             # doubled timeout reached
+        assert br.state == "half_open"
+        br.allow()
+        br.record_failure()
+        clock[0] += 25.0             # capped at max_reset_timeout_s
+        assert br.state == "half_open"
+
+    def test_transitions_and_state_visible_in_registry(self):
+        br, clock, reg = self._breaker(failure_threshold=1,
+                                       reset_timeout_s=1.0)
+        br.record_failure()
+        clock[0] += 1.0
+        br.allow()
+        br.record_success()
+        text = reg.render()
+        assert 'pio_breaker_state{breaker="test"} 0.0' in text
+        assert ('pio_breaker_transitions_total{breaker="test",'
+                'to="open"} 1.0') in text
+        assert ('pio_breaker_transitions_total{breaker="test",'
+                'to="closed"} 1.0') in text
+
+    def test_guard_context_manager_records_outcomes(self):
+        br, _, _ = self._breaker(failure_threshold=1)
+        with pytest.raises(IOError):
+            with br.guard():
+                raise IOError("down")
+        assert br.state == "open"
+
+    def test_call_wrapper(self):
+        br, clock, _ = self._breaker(failure_threshold=1,
+                                     reset_timeout_s=5.0)
+        assert br.call(lambda: 42) == 42
+        with pytest.raises(IOError):
+            br.call(_raise_io)
+        with pytest.raises(CircuitOpenError):
+            br.call(lambda: 42)      # open: fn never runs
+        clock[0] += 5.0
+        assert br.call(lambda: 7) == 7   # probe succeeds, closes
+
+
+def _raise_io():
+    raise IOError("down")
+
+
+# ---------------------------------------------------------------------------
+# SpillWAL
+# ---------------------------------------------------------------------------
+
+class TestSpillWAL:
+    def test_append_replay_order_and_ids(self, tmp_path):
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        ids = [wal.append(ev(i), app_id=1) for i in range(5)]
+        got = list(wal.pending())
+        assert [e.event_id for _, _, _, e in got] == ids
+        assert [a for _, a, _, _ in got] == [1] * 5
+        wal.close()
+
+    def test_checkpoint_advances_and_compacts(self, tmp_path):
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        wal.append(ev(0), 1)
+        wal.append(ev(1), 1)
+        records = list(wal.pending())
+        wal.checkpoint(records[0][0])
+        assert wal.pending_count() == 1
+        assert [e.entity_id for _, _, _, e in wal.pending()] == ["u1"]
+        wal.checkpoint(records[1][0])
+        assert wal.pending_count() == 0
+        # fully drained WAL compacts to zero bytes
+        assert os.path.getsize(wal.path) == 0
+        # and keeps accepting appends afterwards
+        wal.append(ev(2), 1)
+        assert wal.pending_count() == 1
+        wal.close()
+
+    def test_torn_tail_truncated_on_open(self, tmp_path):
+        path = str(tmp_path / "w.wal")
+        wal = SpillWAL(path)
+        wal.append(ev(0), 1)
+        wal.append(ev(1), 1)
+        wal.close()
+        with open(path, "ab") as f:
+            f.write(b"\x40\x00\x00\x00\xde\xad")   # torn mid-append
+        wal2 = SpillWAL(path)
+        assert wal2.pending_count() == 2            # tail repaired
+        assert [e.entity_id for _, _, _, e in wal2.pending()] \
+            == ["u0", "u1"]
+        wal2.close()
+
+    def test_cursor_survives_reopen(self, tmp_path):
+        path = str(tmp_path / "w.wal")
+        wal = SpillWAL(path)
+        wal.append(ev(0), 1)
+        wal.append(ev(1), 1)
+        first = next(iter(wal.pending()))
+        wal.checkpoint(first[0])
+        wal.close()
+        wal2 = SpillWAL(path)
+        assert [e.entity_id for _, _, _, e in wal2.pending()] == ["u1"]
+        wal2.close()
+
+    def test_channel_id_round_trips(self, tmp_path):
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        wal.append(ev(0), 7, channel_id=3)
+        (_, app_id, channel_id, e), = wal.pending()
+        assert (app_id, channel_id) == (7, 3)
+        wal.close()
+
+
+# ---------------------------------------------------------------------------
+# SpillReplayer
+# ---------------------------------------------------------------------------
+
+class _FlakyEvents(MemEvents):
+    """Fails the first N insert attempts."""
+
+    def __init__(self, fail_first=0):
+        super().__init__()
+        self.fail_first = fail_first
+        self.attempts = 0
+
+    def insert(self, event, app_id, channel_id=None):
+        self.attempts += 1
+        if self.attempts <= self.fail_first:
+            raise IOError("primary store down")
+        return super().insert(event, app_id, channel_id)
+
+
+class TestSpillReplayer:
+    def _replayer(self, wal, store, **kw):
+        policy = RetryPolicy(max_attempts=1, sleep=lambda s: None)
+        return SpillReplayer(wal, store, policy=policy,
+                             registry=MetricsRegistry(), **kw)
+
+    def test_drains_in_order_and_checkpoints(self, tmp_path):
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        ids = [wal.append(ev(i), 1) for i in range(10)]
+        store = MemEvents()
+        r = self._replayer(wal, store)
+        assert r.drain() == 10
+        assert wal.pending_count() == 0
+        got = sorted(e.event_id for e in store.find(1, limit=-1))
+        assert got == sorted(ids)
+
+    def test_failure_stops_at_record_nothing_skipped(self, tmp_path):
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        for i in range(5):
+            wal.append(ev(i), 1)
+        store = _FlakyEvents(fail_first=10 ** 6)   # always down
+        r = self._replayer(wal, store)
+        assert r.drain() == 0
+        assert wal.pending_count() == 5            # nothing lost
+        store.fail_first = 0                       # recovery
+        assert r.drain() == 5
+        assert wal.pending_count() == 0
+        assert len(list(store.find(1, limit=-1))) == 5
+
+    def test_dedup_by_event_id(self, tmp_path):
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        ids = [wal.append(ev(i), 1) for i in range(3)]
+        store = MemEvents()
+        # the crash-window case: record 0 already reached the primary
+        store.insert(ev(0).with_id(ids[0]), 1)
+        r = self._replayer(wal, store)
+        r.drain()
+        assert r.deduped == 1
+        assert r.replayed == 2
+        assert len(list(store.find(1, limit=-1))) == 3
+
+    def test_poisoned_record_quarantined_not_wedging(self, tmp_path):
+        """A record the HEALTHY store rejects deterministically must
+        not wedge the replayer head-of-line forever: after
+        quarantine_after drains it moves to the .quarantine sidecar
+        and the records behind it drain normally."""
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        ids = [wal.append(ev(i), 1) for i in range(3)]
+
+        class _Rejecting(MemEvents):
+            def insert(self, event, app_id, channel_id=None):
+                if event.event_id == ids[1]:
+                    raise ValueError("constraint violation")  # always
+                return super().insert(event, app_id, channel_id)
+
+        store = _Rejecting()
+        r = self._replayer(wal, store)
+        r.quarantine_after = 2
+        r.drain()                       # record 0 lands, head fails x1
+        assert wal.pending_count() == 2
+        r.drain()                       # head fails x2 -> quarantined,
+        assert r.quarantined == 1       # record 2 drains right after
+        assert wal.pending_count() == 0
+        got = {e.event_id for e in store.find(1, limit=-1)}
+        assert got == {ids[0], ids[2]}
+        qpath = wal.path + ".quarantine"
+        assert os.path.exists(qpath)
+        with open(qpath) as f:
+            import json as _json
+            q = [_json.loads(line) for line in f]
+        assert len(q) == 1 and q[0]["event"]["eventId"] == ids[1]
+        assert "constraint" in q[0]["error"]
+
+    def test_transient_failures_never_quarantine(self, tmp_path):
+        """Outage-class failures stop the drain at the record (nothing
+        skipped, nothing quarantined) no matter how many drains run."""
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        wal.append(ev(0), 1)
+        store = _FlakyEvents(fail_first=10 ** 6)
+        r = self._replayer(wal, store)
+        r.quarantine_after = 2
+        for _ in range(5):
+            r.drain()
+        assert r.quarantined == 0
+        assert wal.pending_count() == 1
+        store.fail_first = 0
+        assert r.drain() == 1           # recovery drains it intact
+
+    def test_breaker_gates_replay(self, tmp_path):
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        wal.append(ev(0), 1)
+        clock = [0.0]
+        br = CircuitBreaker("replay", failure_threshold=1,
+                            reset_timeout_s=10.0,
+                            clock=lambda: clock[0],
+                            registry=MetricsRegistry())
+        br.record_failure()            # open
+        store = MemEvents()
+        r = self._replayer(wal, store, app_breaker=br)
+        assert r.drain() == 0          # fast-fail, no insert attempted
+        assert wal.pending_count() == 1
+        clock[0] += 10.0               # half-open probe admits the drain
+        assert r.drain() == 1
+        assert br.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# Fault spec / injector
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_parse_and_prefix_match(self):
+        spec = FaultSpec.parse(
+            "storage:latency_ms=5,latency_rate=0.5;"
+            "storage.write:error=0.3,seed=42")
+        assert spec.seed == 42
+        w = spec.rule_for("storage.write")
+        assert w.error == 0.3 and w.latency_ms == 5.0
+        r = spec.rule_for("storage.read")
+        assert r.error is None and r.latency_ms == 5.0
+        assert spec.rule_for("http") is None
+
+    def test_explicit_zero_exempts_subtarget(self):
+        # a specific clause's explicit 0 OVERRIDES a broad clause: the
+        # way writes are exempted from a storage-wide error rate
+        spec = FaultSpec.parse(
+            "storage:error=1.0,seed=1;storage.write:error=0")
+        assert spec.rule_for("storage.write").error == 0.0
+        assert spec.rule_for("storage.read").error == 1.0
+        inj = FaultInjector(spec, registry=MetricsRegistry())
+        store = FaultyEvents(MemEvents(), inj)
+        store.insert(ev(0), 1)                 # writes never fault
+        with pytest.raises(InjectedFault):
+            store.get("x", 1)                  # reads always do
+
+    @pytest.mark.parametrize("bad", [
+        "nocolon", "t:error", "t:error=x", "t:bogus=1", "t:error=1.5"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultSpec.parse(bad)
+
+    def test_seeded_decisions_reproduce(self):
+        spec = FaultSpec.parse("storage.write:error=0.5,seed=7")
+
+        def run():
+            inj = FaultInjector(spec, sleep=lambda s: None,
+                                registry=MetricsRegistry())
+            out = []
+            for _ in range(50):
+                try:
+                    inj.before("storage.write")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b = run(), run()
+        assert a == b
+        assert 5 < sum(a) < 45        # actually injecting at ~50%
+
+    def test_faulty_events_wraps_reads_and_writes(self):
+        spec = FaultSpec.parse("storage.write:error=1.0,seed=1")
+        inj = FaultInjector(spec, registry=MetricsRegistry())
+        store = FaultyEvents(MemEvents(), inj)
+        with pytest.raises(InjectedFault):
+            store.insert(ev(0), 1)
+        # reads unaffected by a write-only spec
+        assert list(store.find(1, limit=-1)) == []
+
+    def test_wrap_callable(self):
+        spec = FaultSpec.parse("http:error=1.0,seed=1")
+        inj = FaultInjector(spec, registry=MetricsRegistry())
+        hop = inj.wrap_callable("http", lambda: "ok")
+        with pytest.raises(InjectedFault):
+            hop()
+
+    def test_cli_faults_verb(self, capsys):
+        from predictionio_tpu.tools.cli import main as cli_main
+        rc = cli_main(["faults", "--spec",
+                       "storage.write:error=0.3,seed=42",
+                       "--preview", "5"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "storage.write" in out and "seed=42" in out
+        rc = cli_main(["faults", "--spec", "garbage"])
+        assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# Deadline shedding + shutdown drain (micro-batcher)
+# ---------------------------------------------------------------------------
+
+class TestDeadlineShed:
+    def test_saturated_queue_sheds_out_of_deadline(self):
+        from predictionio_tpu.serving.batcher import MicroBatcher, ShedError
+        release = threading.Event()
+
+        def handler(qs):
+            release.wait(5)
+            return qs
+
+        b = MicroBatcher(handler, max_batch=1, max_wait_ms=1)
+        try:
+            t = threading.Thread(target=b.submit, args=(0,), daemon=True)
+            t.start()
+            time.sleep(0.05)
+            # one batch is on the "device"; pretend it is slow
+            b._service_ewma_s = 10.0
+            for _ in range(3):
+                threading.Thread(target=lambda: _swallow(b.submit, 1),
+                                 daemon=True).start()
+            time.sleep(0.05)
+            with pytest.raises(ShedError) as ei:
+                b.submit({"q": 1}, deadline_s=0.05)
+            assert ei.value.http_status == 503
+            assert ei.value.retry_after_s > 0.05
+            assert b.n_shed == 1
+            # a generous deadline is admitted (no shed)
+            threading.Thread(target=lambda: _swallow(
+                b.submit, {"q": 2}, deadline_s=10 ** 6),
+                daemon=True).start()
+            time.sleep(0.05)
+            assert b.n_shed == 1
+        finally:
+            release.set()
+            b.stop()
+
+    def test_idle_server_never_sheds_any_deadline(self):
+        """An idle batcher's wait bound is 0 (the drain gate dispatches
+        a lone query immediately), so even a sub-millisecond deadline
+        is admitted at zero load."""
+        from predictionio_tpu.serving.batcher import MicroBatcher
+        b = MicroBatcher(lambda qs: qs, max_batch=4, max_wait_ms=10)
+        try:
+            b._service_ewma_s = 30.0       # fat EWMA changes nothing idle
+            assert b.queue_wait_bound_s() == 0.0
+            assert b.submit({"q": 1}, deadline_s=0.001) == {"q": 1}
+            assert b.n_shed == 0
+        finally:
+            b.stop()
+
+    def test_no_deadline_never_sheds(self):
+        from predictionio_tpu.serving.batcher import MicroBatcher
+        b = MicroBatcher(lambda qs: qs, max_batch=4, max_wait_ms=1)
+        try:
+            b._service_ewma_s = 100.0    # wait bound is huge
+            assert b.submit({"q": 1}) == {"q": 1}
+        finally:
+            b.stop()
+
+    def test_stats_surface_shed_counters(self):
+        from predictionio_tpu.serving.batcher import MicroBatcher
+        b = MicroBatcher(lambda qs: qs, max_batch=4, max_wait_ms=1)
+        try:
+            s = b.stats()
+            assert "shedQueries" in s and "queueWaitBoundSec" in s
+        finally:
+            b.stop()
+
+
+def _swallow(fn, *a, **kw):
+    try:
+        fn(*a, **kw)
+    except Exception:
+        pass
+
+
+class TestShutdownDrain:
+    def test_collected_batch_fails_explicitly_on_stop(self):
+        """A batch already collected (but not dispatched) when stop
+        lands fails with the explicit shutdown error — no waiter ever
+        hangs, no device call races teardown."""
+        from predictionio_tpu.serving.batcher import (MicroBatcher,
+                                                      ShutdownError)
+        entered = threading.Event()
+        release = threading.Event()
+
+        def handler(qs):
+            entered.set()
+            release.wait(5)
+            return qs
+
+        b = MicroBatcher(handler, max_batch=1, max_wait_ms=1)
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(4) as ex:
+            f1 = ex.submit(b.submit, 1)
+            assert entered.wait(2)
+            f2 = ex.submit(b.submit, 2)      # queued behind the device
+            time.sleep(0.05)
+            t = threading.Thread(target=b.stop, daemon=True)
+            t.start()
+            time.sleep(0.05)
+            release.set()                    # device call finishes
+            assert f1.result(timeout=5) == 1
+            with pytest.raises(ShutdownError, match="shutting down"):
+                f2.result(timeout=5)
+            t.join(timeout=5)
+            assert b.n_shutdown_failed >= 1
+
+
+# ---------------------------------------------------------------------------
+# Crash-atomic sharded checkpoint (satellite)
+# ---------------------------------------------------------------------------
+
+class TestCheckpointAtomicity:
+    @pytest.fixture
+    def no_orbax(self, monkeypatch):
+        """Force the npz fallback path (the one the satellite hardens)."""
+        import sys
+        monkeypatch.setitem(sys.modules, "orbax", None)
+        monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+
+    def test_kill_mid_write_leaves_previous_checkpoint(
+            self, tmp_path, no_orbax, monkeypatch):
+        from predictionio_tpu.utils import checkpoint as ck
+        path = str(tmp_path / "m")
+        v1 = {"a": np.arange(8, dtype=np.float32)}
+        assert ck.save_sharded(path, v1)
+        assert np.array_equal(ck.restore_sharded(path)["a"], v1["a"])
+
+        real_savez = np.savez
+
+        def dying_savez(f, **arrays):
+            f.write(b"PK\x03\x04 torn")       # partial bytes, then die
+            raise KeyboardInterrupt("kill -9 simulation")
+
+        monkeypatch.setattr(np, "savez", dying_savez)
+        v2 = {"a": np.arange(8, dtype=np.float32) * 2}
+        with pytest.raises(KeyboardInterrupt):
+            ck.save_sharded(path, v2)
+        monkeypatch.setattr(np, "savez", real_savez)
+        # the torn write never replaced the real checkpoint
+        assert np.array_equal(ck.restore_sharded(path)["a"], v1["a"])
+        # no tmp litter, and a later save succeeds and lands
+        assert not [p for p in os.listdir(path) if ".tmp" in p]
+        assert ck.save_sharded(path, v2)
+        assert np.array_equal(ck.restore_sharded(path)["a"], v2["a"])
+
+    def test_stale_tmp_from_dead_process_is_ignored(
+            self, tmp_path, no_orbax):
+        from predictionio_tpu.utils import checkpoint as ck
+        path = str(tmp_path / "m")
+        v1 = {"a": np.ones(4, dtype=np.float32)}
+        assert ck.save_sharded(path, v1)
+        with open(os.path.join(path, ".arrays.npz.tmp.99999"), "wb") as f:
+            f.write(b"garbage from a crashed writer")
+        assert np.array_equal(ck.restore_sharded(path)["a"], v1["a"])
+
+
+# ---------------------------------------------------------------------------
+# Remote client backoff + Retry-After (satellite)
+# ---------------------------------------------------------------------------
+
+class TestRemoteClientBackoff:
+    @pytest.fixture
+    def flaky_server(self):
+        """An event-server stub whose POST /events.json answers 503 (+
+        Retry-After: 0) until `fail_remaining` hits zero."""
+        from predictionio_tpu.utils.http import (HttpServer, Response,
+                                                 Router)
+        state = {"fail_remaining": 0, "requests": 0}
+        r = Router()
+
+        def create(req):
+            state["requests"] += 1
+            if state["fail_remaining"] > 0:
+                state["fail_remaining"] -= 1
+                return Response(503, {"message": "overloaded"},
+                                headers={"Retry-After": "0"})
+            d = req.json()
+            return Response(201, {"eventId": d.get("eventId") or "e1"})
+
+        r.add("POST", "/events.json", create)
+        srv = HttpServer(r, "127.0.0.1", 0)
+        srv.start()
+        yield srv, state
+        srv.stop()
+
+    def test_503_retried_honoring_retry_after(self, flaky_server):
+        from predictionio_tpu.data.storage.eventserver_client import \
+            RemoteEvents
+        srv, state = flaky_server
+        state["fail_remaining"] = 2
+        client = RemoteEvents(f"http://127.0.0.1:{srv.port}", "k",
+                              retries=4)
+        eid = client.insert(ev(0), app_id=1)
+        assert eid
+        assert state["requests"] == 3       # two 503s + the success
+
+    def test_retries_exhausted_surface_the_503(self, flaky_server):
+        from predictionio_tpu.data.storage.eventserver_client import (
+            RemoteError, RemoteEvents)
+        srv, state = flaky_server
+        state["fail_remaining"] = 10
+        client = RemoteEvents(f"http://127.0.0.1:{srv.port}", "k",
+                              retries=2)
+        with pytest.raises(RemoteError) as ei:
+            client.insert(ev(0), app_id=1)
+        assert ei.value.status == 503
+        assert state["requests"] == 2
+
+    def test_timeout_configurable(self):
+        from predictionio_tpu.data.storage.eventserver_client import \
+            RemoteEvents
+        client = RemoteEvents("http://127.0.0.1:1", "k", timeout_s=7.5,
+                              retries=1)
+        assert client.timeout_s == 7.5
+        assert client._conn().timeout == 7.5
