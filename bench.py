@@ -810,6 +810,107 @@ def bench_ingest(full_scale: bool):
     return out
 
 
+def bench_fold_tick(full_scale: bool):
+    """Online fold-tick scenario (ISSUE 4): a deployed model absorbs a
+    ~1%-touched burst of fresh events per tick. Reports
+    ``fold_tick_p50_ms`` (tick wall, p50 over the steady-state ticks),
+    ``fold_read_rows`` (rows the entity-filtered tail read actually
+    pulled vs ``fold_read_rows_full`` = the corpus it avoided scanning)
+    and ``fold_h2d_bytes`` (per-tick instrumented upload bytes on the
+    SECOND consecutive tick, when the factor tables are device-resident
+    and only touched-row plans cross the link)."""
+    import datetime as dt
+    import tempfile
+
+    from predictionio_tpu.core import EngineParams
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.models import recommendation as R
+    from predictionio_tpu.online.scheduler import (SchedulerConfig,
+                                                   attach_scheduler)
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    from predictionio_tpu.workflow import run_train
+
+    UTC = dt.timezone.utc
+    n_users = 20_000 if full_scale else 1_500
+    per_user = 50 if full_scale else 20
+    n_items = 2_000 if full_scale else 300
+    touched_users = max(8, n_users // 100)
+    base = tempfile.mkdtemp(prefix="pio_bench_fold_")
+    out = {}
+    with bench_storage_env("sqlite", base):
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.data.storage.registry import Storage
+        app_id = Storage.get_meta_data_apps().insert(App(0, "foldapp"))
+        ev = Storage.get_events()
+        ev.init(app_id)
+        t0 = dt.datetime.now(UTC) - dt.timedelta(days=1)
+        rng = np.random.default_rng(11)
+        batch, corpus_rows = [], 0
+        for u in range(n_users):
+            for k, i in enumerate(rng.integers(0, n_items, per_user)):
+                batch.append(Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{u}", target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"rating": float(1 + (u + int(i)) % 5)}),
+                    event_time=t0 + dt.timedelta(
+                        milliseconds=corpus_rows + k)))
+            corpus_rows += per_user
+            if len(batch) >= 20_000:
+                ev.insert_batch(batch, app_id)
+                batch = []
+        if batch:
+            ev.insert_batch(batch, app_id)
+        ep = EngineParams(
+            data_source_params=("", R.DataSourceParams(
+                app_name="foldapp")),
+            preparator_params=("", R.PreparatorParams()),
+            algorithm_params_list=[("als", R.ALSAlgorithmParams(
+                rank=16 if full_scale else 8, num_iterations=2,
+                lam=0.1, seed=1))],
+            serving_params=("", None))
+        engine = R.RecommendationEngineFactory.apply()
+        run_train(engine, ep, engine_id="foldbench",
+                  engine_version="1", engine_variant="v1",
+                  engine_factory="recommendation")
+        server = EngineServer(ServerConfig(
+            ip="127.0.0.1", port=0, engine_id="foldbench",
+            engine_version="1", engine_variant="v1"))
+        server.load()
+        sched = attach_scheduler(server, SchedulerConfig(
+            app_name="foldapp", max_deltas=1))
+
+        def burst(tick_no):
+            t = dt.datetime.now(UTC)
+            for j in range(touched_users):
+                u = (tick_no * touched_users + j) % n_users
+                ev.insert(Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{u}", target_entity_type="item",
+                    target_entity_id=f"i{j % n_items}",
+                    properties=DataMap({"rating": 5.0}),
+                    event_time=t + dt.timedelta(milliseconds=j)), app_id)
+
+        walls, reads, h2ds = [], [], []
+        n_ticks = 3
+        for tick_no in range(n_ticks):
+            burst(tick_no)
+            w0 = time.perf_counter()
+            report = sched.tick(force=True)
+            walls.append((time.perf_counter() - w0) * 1000)
+            assert report and report["readPath"] == "entity_filtered", \
+                report
+            reads.append(report["readRows"])
+            h2ds.append(report["h2dBytes"])
+        out["fold_tick_p50_ms"] = round(float(np.median(walls[1:])), 2)
+        out["fold_read_rows"] = int(np.median(reads))
+        out["fold_read_rows_full"] = corpus_rows
+        # second consecutive tick: resident tables, plans-only uploads
+        out["fold_h2d_bytes"] = int(h2ds[1])
+    return out
+
+
 def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
     """p50 of POST /queries.json against the trained model via the real
     engine server (loopback HTTP). `wait_ms` sets the micro-batcher's
@@ -1337,7 +1438,13 @@ def main():
     if not os.environ.get("PIO_BENCH_SKIP_INGEST"):
         _beat("bench_ingest")
         ingest_stats = bench_ingest(full_scale)
-    _beat("assemble_output", **ingest_stats)
+    fold_stats = {}
+    if not os.environ.get("PIO_BENCH_SKIP_FOLD"):
+        # online fold-tick scenario (ISSUE 4): the BENCH_*.json
+        # trajectory finally covers the online path (schema-additive)
+        _beat("bench_fold_tick")
+        fold_stats = bench_fold_tick(full_scale)
+    _beat("assemble_output", **ingest_stats, **fold_stats)
     value = als_stats["ratings_per_sec_per_chip"]
     out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
@@ -1352,6 +1459,7 @@ def main():
         **product_stats,
         **baseline_stats,
         **ingest_stats,
+        **fold_stats,
     }
     if baseline_stats:
         # the north-star ratio computed from two numbers measured on

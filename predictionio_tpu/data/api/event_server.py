@@ -465,6 +465,47 @@ class EventServer:
                            for x in cols["prop"].astype(float).tolist()]
         return Response(200, out)
 
+    def _columnar_by_entities(self, req: Request) -> Response:
+        """POST /events/columnar.json — the entity-filtered columnar read
+        (the fold tick's O(touched) ingest over the network). The touched
+        id lists ride in the JSON body (query strings cap out around a
+        few thousand ids); scalar filters match /events.json semantics.
+        The response is the same flat column shape as the GET route."""
+        access_key, channel_id = self._authenticate(req)
+        d = req.json()
+        if not isinstance(d, dict):
+            raise ValueError("request body must be a JSON object")
+
+        def time_of(key):
+            return parse_event_time(d[key]) if d.get(key) else None
+
+        target_type = d.get("targetEntityType")
+        if target_type == "":
+            target_type = ABSENT
+        limit = d.get("limit")
+        cols = self.events.find_columnar_by_entities(
+            app_id=access_key.appid, channel_id=channel_id,
+            entity_ids=[str(x) for x in d.get("entityIds") or ()],
+            target_entity_ids=[str(x)
+                               for x in d.get("targetEntityIds") or ()],
+            property_field=d.get("propertyField"),
+            start_time=time_of("startTime"),
+            until_time=time_of("untilTime"),
+            entity_type=d.get("entityType"),
+            target_entity_type=target_type,
+            event_names=d.get("events"),
+            limit=int(limit) if limit is not None else None)
+        out = {
+            "entity_id": cols["entity_id"].tolist(),
+            "target_entity_id": cols["target_entity_id"].tolist(),
+            "event": cols["event"].tolist(),
+            "t": cols["t"].tolist(),
+        }
+        if "prop" in cols:
+            out["prop"] = [None if x != x else x
+                           for x in cols["prop"].astype(float).tolist()]
+        return Response(200, out)
+
     def _get_stats(self, req: Request) -> Response:
         access_key, _ = self._authenticate(req)
         if not self.config.stats:
@@ -560,6 +601,8 @@ class EventServer:
         r.add("POST", "/batch/events.json", guarded(self._batch_create))
         # columnar must precede the <id> route ("columnar" is not an id)
         r.add("GET", "/events/columnar.json", guarded(self._find_columnar))
+        r.add("POST", "/events/columnar.json",
+              guarded(self._columnar_by_entities))
         r.add("GET", "/events/<id>.json", guarded(self._get_event))
         r.add("DELETE", "/events/<id>.json", guarded(self._delete_event))
         r.add("GET", "/stats.json", guarded(self._get_stats))

@@ -367,6 +367,58 @@ class Events(abc.ABC):
             out["prop"] = np.array(props, dtype=np.float32)
         return out
 
+    def find_columnar_by_entities(self, app_id: int,
+                                  channel_id: Optional[int] = None,
+                                  entity_ids: Optional[Sequence[str]] = None,
+                                  target_entity_ids:
+                                      Optional[Sequence[str]] = None,
+                                  property_field: Optional[str] = None,
+                                  start_time: Optional[_dt.datetime] = None,
+                                  until_time: Optional[_dt.datetime] = None,
+                                  entity_type: Optional[str] = None,
+                                  target_entity_type=None,
+                                  event_names: Optional[Sequence[str]] = None,
+                                  limit: Optional[int] = None
+                                  ) -> Dict[str, "object"]:
+        """Entity-set-filtered columnar read — the fold tick's O(touched)
+        ingest. Returns the `find_columnar` column shape for exactly the
+        rows that pass the shared filters AND whose ``entity_id`` is in
+        ``entity_ids`` OR whose ``target_entity_id`` is in
+        ``target_entity_ids`` (union: a touched user's whole history plus
+        every event landing on a touched item — what the touched-row
+        least-squares solves consume). ``None`` for a side means that
+        side contributes nothing; both sides empty returns empty columns
+        (callers wanting the full corpus use ``find_columnar``). Rows
+        come back event-time ascending; intra-instant order is
+        backend-defined, as in ``find``.
+
+        This default streams ``find`` and filters host-side — correct
+        but O(corpus). Every registered backend overrides it with real
+        pushdown (SQL id-list predicates, the nativelog entity-index
+        sidecar, the in-memory index, the event-server batched POST);
+        the storage registry enforces the override at registration
+        (`registry.get_data_object`), so a backend cannot silently ship
+        the full-scan fallback as its "filtered" read.
+        """
+        eset = {str(x) for x in entity_ids} if entity_ids else set()
+        tset = {str(x) for x in target_entity_ids} \
+            if target_entity_ids else set()
+        out = []
+        bounded = limit is not None and limit >= 0
+        if (eset or tset) and not (bounded and limit == 0):
+            for e in self.find(
+                    app_id, channel_id=channel_id, start_time=start_time,
+                    until_time=until_time, entity_type=entity_type,
+                    target_entity_type=target_entity_type,
+                    event_names=event_names, limit=-1):
+                if e.entity_id in eset or (
+                        e.target_entity_id is not None
+                        and e.target_entity_id in tset):
+                    out.append(e)
+                    if bounded and len(out) >= limit:
+                        break
+        return events_to_columnar(out, property_field)
+
     # -- derived queries ----------------------------------------------------
     def aggregate_properties(self, app_id: int,
                              channel_id: Optional[int] = None,
@@ -396,6 +448,75 @@ class Events(abc.ABC):
 
 def aggregate_event_names():
     return ("$set", "$unset", "$delete")
+
+
+def columnar_from_union_rows(rows_by_id: Dict[str, tuple],
+                             property_field: Optional[str] = None,
+                             limit: Optional[int] = None
+                             ) -> Dict[str, "object"]:
+    """Assemble the ``find_columnar`` dict from an entity-union SQL
+    read: ``rows_by_id`` maps event id -> (entityid, targetentityid,
+    event, eventtime[, prop]) — the id keying IS the cross-side dedup
+    (a row matching both the entity and target predicates counts once).
+    Sorts time-ascending and applies ``limit`` after the merge. Shared
+    by the sqlite and pgsql/mysql pushdowns so the union semantics
+    cannot diverge."""
+    import numpy as np
+
+    rows = sorted(rows_by_id.values(), key=lambda r: int(r[3]))
+    if limit is not None and limit >= 0:
+        rows = rows[:limit]
+    if not rows:
+        out = {"entity_id": np.array([], dtype=str),
+               "target_entity_id": np.array([], dtype=str),
+               "event": np.array([], dtype=str),
+               "t": np.array([], dtype=np.int64)}
+        if property_field is not None:
+            out["prop"] = np.array([], dtype=np.float32)
+        return out
+    ents, tgts, names, ts, *rest = zip(*rows)
+    out = {
+        "entity_id": np.array(ents, dtype=str),
+        "target_entity_id": np.array([x or "" for x in tgts], dtype=str),
+        "event": np.array(names, dtype=str),
+        "t": np.array([int(t) for t in ts], dtype=np.int64),
+    }
+    if property_field is not None:
+        out["prop"] = np.array(
+            [np.nan if v is None else float(v) for v in rest[0]],
+            dtype=np.float32)
+    return out
+
+
+def events_to_columnar(events, property_field: Optional[str] = None
+                       ) -> Dict[str, "object"]:
+    """[Event] -> the ``find_columnar`` column dict (shared by backends
+    whose entity-filtered reads materialize Event objects: memory's
+    index, nativelog's sidecar seek+read, the streamed default)."""
+    import numpy as np
+
+    ents: list = []
+    tgts: list = []
+    names: list = []
+    ts: list = []
+    props: list = []
+    for e in events:
+        ents.append(e.entity_id)
+        tgts.append(e.target_entity_id or "")
+        names.append(e.event)
+        ts.append(_millis(e.event_time))
+        if property_field is not None:
+            v = e.properties.get_opt(property_field, float)
+            props.append(np.nan if v is None else v)
+    out = {
+        "entity_id": np.array(ents, dtype=str),
+        "target_entity_id": np.array(tgts, dtype=str),
+        "event": np.array(names, dtype=str),
+        "t": np.array(ts, dtype=np.int64),
+    }
+    if property_field is not None:
+        out["prop"] = np.array(props, dtype=np.float32)
+    return out
 
 
 def match_event(e: Event,

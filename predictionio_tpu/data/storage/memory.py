@@ -263,6 +263,12 @@ class MemEvents(base.Events):
     def __init__(self):
         # (app_id, channel_id) -> {event_id: Event}
         self._ns: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+        # entity-filtered-read indexes, maintained on every mutation:
+        # (app, channel) -> {entity_id -> {event_id}} / {target -> {ids}}
+        self._by_entity: Dict[Tuple[int, Optional[int]],
+                              Dict[str, set]] = {}
+        self._by_target: Dict[Tuple[int, Optional[int]],
+                              Dict[str, set]] = {}
         self._lock = threading.RLock()
 
     def _table(self, app_id, channel_id, create=False):
@@ -270,6 +276,8 @@ class MemEvents(base.Events):
         with self._lock:
             if key not in self._ns and create:
                 self._ns[key] = {}
+                self._by_entity[key] = {}
+                self._by_target[key] = {}
             return self._ns.get(key)
 
     def init(self, app_id, channel_id=None) -> bool:
@@ -278,13 +286,36 @@ class MemEvents(base.Events):
 
     def remove(self, app_id, channel_id=None) -> bool:
         with self._lock:
-            return self._ns.pop((app_id, channel_id), None) is not None
+            key = (app_id, channel_id)
+            self._by_entity.pop(key, None)
+            self._by_target.pop(key, None)
+            return self._ns.pop(key, None) is not None
+
+    def _unindex(self, key, eid, old: Event):
+        for index, k in ((self._by_entity, old.entity_id),
+                         (self._by_target, old.target_entity_id)):
+            if k:
+                ids = index[key].get(k)
+                if ids is not None:
+                    ids.discard(eid)
+                    if not ids:
+                        del index[key][k]
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
         table = self._table(app_id, channel_id, create=True)
         eid = event.event_id or new_event_id()
+        key = (app_id, channel_id)
         with self._lock:
+            old = table.get(eid)
+            if old is not None:        # overwrite-by-id re-routes indexes
+                self._unindex(key, eid, old)
             table[eid] = event.with_id(eid)
+            if event.entity_id:
+                self._by_entity[key].setdefault(
+                    event.entity_id, set()).add(eid)
+            if event.target_entity_id:
+                self._by_target[key].setdefault(
+                    event.target_entity_id, set()).add(eid)
         return eid
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
@@ -296,7 +327,10 @@ class MemEvents(base.Events):
         if table is None:
             return False
         with self._lock:
-            return table.pop(event_id, None) is not None
+            old = table.pop(event_id, None)
+            if old is not None:
+                self._unindex((app_id, channel_id), event_id, old)
+            return old is not None
 
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
@@ -311,3 +345,30 @@ class MemEvents(base.Events):
         if limit is not None and limit >= 0:
             out = out[:limit]
         return iter(out)
+
+    def find_columnar_by_entities(self, app_id, channel_id=None,
+                                  entity_ids=None, target_entity_ids=None,
+                                  property_field=None, start_time=None,
+                                  until_time=None, entity_type=None,
+                                  target_entity_type=None, event_names=None,
+                                  limit=None):
+        """Index pushdown: candidate event ids come from the per-entity
+        index union — O(touched histories), never a table scan."""
+        key = (app_id, channel_id)
+        with self._lock:
+            table = self._ns.get(key)
+            if table is None:
+                return base.events_to_columnar([], property_field)
+            candidates: set = set()
+            for iid in (entity_ids or ()):
+                candidates |= self._by_entity[key].get(str(iid), set())
+            for iid in (target_entity_ids or ()):
+                candidates |= self._by_target[key].get(str(iid), set())
+            events = [table[eid] for eid in candidates if eid in table]
+        events = [e for e in events if base.match_event(
+            e, start_time, until_time, entity_type, None, event_names,
+            target_entity_type, None)]
+        events.sort(key=lambda e: e.event_time)
+        if limit is not None and limit >= 0:
+            events = events[:limit]
+        return base.events_to_columnar(events, property_field)

@@ -226,6 +226,13 @@ class MyEvents(pgsql.PGEvents):
         client.create_index(
             f"CREATE INDEX {self.t}_entity ON {self.t} "
             "(appid, channelid, entitytype, entityid)")
+        # entity-filtered fold reads (see pgsql.PGEvents)
+        client.create_index(
+            f"CREATE INDEX {self.t}_entityid ON {self.t} "
+            "(appid, channelid, entityid)")
+        client.create_index(
+            f"CREATE INDEX {self.t}_target ON {self.t} "
+            "(appid, channelid, targetentityid)")
 
     _UPSERT = (" ON DUPLICATE KEY UPDATE "
                "event=VALUES(event), entitytype=VALUES(entitytype), "
@@ -246,6 +253,11 @@ class MyEvents(pgsql.PGEvents):
     # JSON property extraction, MySQL dialect (PG: properties::json ->>)
     _PROP_EXTRACT = ("CAST(JSON_UNQUOTE(JSON_EXTRACT(properties, "
                      "CONCAT('$.\"', {ph}, '\"'))) AS DOUBLE)")
+
+    def _prop_extract_clause(self, params: list, property_field: str) -> str:
+        # hook consumed by the shared find_columnar_by_entities (pgsql)
+        params.append(property_field)
+        return ", " + self._PROP_EXTRACT.format(ph=f"${len(params)}")
 
     def find_columnar(self, app_id, channel_id=None, property_field=None,
                       start_time=None, until_time=None, entity_type=None,

@@ -158,6 +158,162 @@ _LEGACY = -1  # partition index of a pre-partitioning single log file
 _NULL_CTX = contextlib.nullcontext()  # reentrant and reusable
 
 
+class _EntityIndex:
+    """Persisted per-entity -> event-id sidecar for one (app, channel)
+    namespace: the seek+read path behind ``find_columnar_by_entities``
+    (an entity-filtered read becomes O(touched) el_get probes instead of
+    a full log scan — the HBase-rowkey-locality role for id sets).
+
+    Layout: ``<stem>.entidx`` holds one JSON line
+    ``[entity_id, target_id, event_id]`` per append (append-only, torn
+    tail skipped on load); ``<stem>.entidx.meta`` records the total log
+    bytes at the last clean sync. On open, the index is trusted only
+    when the meta matches the current log size — any adoption of logs
+    written outside this index's watch (older build, crash before the
+    final sync, foreign writer) triggers a full-scan rebuild, after
+    which the in-process append path keeps it incremental. Index lines
+    are appended BEFORE the log append, so a mid-insert crash leaves a
+    dangling id (skipped at read: el_get misses), never a missed one.
+    Deletes are not unindexed — a dead id simply fails its el_get probe.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.meta_path = path + ".meta"
+        self.lock = threading.RLock()
+        self.loaded = False
+        self._ids_by_entity: Dict[str, List[str]] = {}
+        self._ids_by_target: Dict[str, List[str]] = {}
+        # adds arriving while unloaded (a rebuild may be scanning on
+        # another thread): queued and merged by the next load/rebuild,
+        # so sidecar-before-log ordering never loses an insert
+        self._pending: List[tuple] = []
+        self._fh = None
+
+    # -- load / rebuild -----------------------------------------------------
+    def try_load(self, log_bytes: int) -> bool:
+        """Adopt the persisted sidecar iff its meta proves it covers the
+        logs as they stand; returns False when a rebuild is needed."""
+        if not (os.path.exists(self.path)
+                and os.path.exists(self.meta_path)):
+            return False
+        try:
+            with open(self.meta_path) as f:
+                meta = json.load(f)
+            if int(meta.get("log_bytes", -1)) != int(log_bytes):
+                return False
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ent, tgt, eid = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail from a crashed append
+                    self._remember(ent, tgt, eid)
+        except (OSError, ValueError):
+            self._ids_by_entity.clear()
+            self._ids_by_target.clear()
+            return False
+        self._drain_pending()
+        self.loaded = True
+        return True
+
+    def rebuild(self, events, log_bytes: int):
+        """Full-scan rebuild (adoption): rewrite both sidecar files from
+        the namespace's live events."""
+        self._ids_by_entity.clear()
+        self._ids_by_target.clear()
+        self._close_fh()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for e in events:
+                if not e.event_id:
+                    continue
+                self._remember(e.entity_id, e.target_entity_id or "",
+                               e.event_id)
+                f.write(json.dumps(
+                    [e.entity_id, e.target_entity_id or "", e.event_id],
+                    separators=(",", ":")) + "\n")
+        os.replace(tmp, self.path)
+        self._drain_pending()
+        self.mark_clean(log_bytes)
+        self.loaded = True
+
+    def _drain_pending(self):
+        if not self._pending:
+            return
+        with open(self.path, "a") as f:
+            for ent, tgt, eid in self._pending:
+                self._remember(ent, tgt, eid)
+                f.write(json.dumps([ent, tgt, eid],
+                                   separators=(",", ":")) + "\n")
+        self._pending = []
+
+    def _remember(self, ent: str, tgt: str, eid: str):
+        if ent:
+            self._ids_by_entity.setdefault(ent, []).append(eid)
+        if tgt:
+            self._ids_by_target.setdefault(tgt, []).append(eid)
+
+    # -- incremental append -------------------------------------------------
+    def add(self, ent: str, tgt: str, eid: str):
+        with self.lock:
+            if not self.loaded:
+                self._pending.append((ent, tgt, eid))
+                return
+            if self._fh is None:
+                self._fh = open(self.path, "a")
+            self._fh.write(json.dumps([ent, tgt, eid],
+                                      separators=(",", ":")) + "\n")
+            self._fh.flush()
+            self._remember(ent, tgt, eid)
+
+    def candidate_ids(self, entity_ids, target_entity_ids) -> List[str]:
+        with self.lock:
+            out: Dict[str, None] = {}   # ordered de-dup
+            for iid in entity_ids:
+                for eid in self._ids_by_entity.get(iid, ()):
+                    out[eid] = None
+            for iid in target_entity_ids:
+                for eid in self._ids_by_target.get(iid, ()):
+                    out[eid] = None
+            return list(out)
+
+    # -- lifecycle ----------------------------------------------------------
+    def mark_clean(self, log_bytes: int):
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": 1, "log_bytes": int(log_bytes)}, f)
+        os.replace(tmp, self.meta_path)
+
+    def _close_fh(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def close(self, log_bytes: Optional[int] = None):
+        with self.lock:
+            self._close_fh()
+            if self.loaded and log_bytes is not None:
+                self.mark_clean(log_bytes)
+            self.loaded = False
+            self._ids_by_entity.clear()
+            self._ids_by_target.clear()
+
+    def drop(self):
+        with self.lock:
+            self._close_fh()
+            self.loaded = False
+            self._ids_by_entity.clear()
+            self._ids_by_target.clear()
+            self._pending = []
+            for p in (self.path, self.meta_path):
+                if os.path.exists(p):
+                    os.remove(p)
+
+
 class NativeLogEvents(base.Events):
     def __init__(self, lib, root: str, partitions: int = 1):
         self.lib = lib
@@ -204,6 +360,10 @@ class NativeLogEvents(base.Events):
         self._overwrite_locks = [threading.Lock() for _ in range(64)]
         self._pool: Optional[ThreadPoolExecutor] = None
         self._closed = False
+        # per-namespace persisted entity->ids sidecars (created lazily on
+        # the first entity-filtered read; kept incremental by insert())
+        self._entidx: Dict[Tuple[int, Optional[int]], _EntityIndex] = {}
+        self._entidx_lock = threading.RLock()
 
     def _path_of(self, app_id: int, channel_id: Optional[int],
                  part: int) -> str:
@@ -252,6 +412,46 @@ class NativeLogEvents(base.Events):
                 out.append(((app_id, channel_id, p), h, lk))
         return out
 
+    def _log_bytes(self, app_id, channel_id) -> int:
+        """Total on-disk bytes of the namespace's log files — the entity
+        index's staleness fingerprint."""
+        total = 0
+        parts = ([0] if self.partitions == 1
+                 else list(range(self.partitions)) + [_LEGACY])
+        for p in parts:
+            path = self._path_of(app_id, channel_id, p)
+            if os.path.exists(path):
+                total += os.path.getsize(path)
+        return total
+
+    def _flush_all(self, app_id, channel_id):
+        for p in range(self.partitions):
+            h, lk = self._handle_of(app_id, channel_id, p, create=False)
+            if h is not None:
+                with lk:
+                    if not self._stale((app_id, channel_id, p), h):
+                        self.lib.el_flush(h)
+
+    def _index_of(self, app_id, channel_id) -> _EntityIndex:
+        """The namespace's entity index, loading the persisted sidecar
+        when its meta matches the logs and rebuilding (one full scan —
+        the adoption cost) otherwise."""
+        key = (app_id, channel_id)
+        with self._entidx_lock:
+            idx = self._entidx.get(key)
+            if idx is None:
+                stem = f"events_{app_id}_{channel_id or 0}"
+                idx = _EntityIndex(os.path.join(self.root,
+                                                stem + ".entidx"))
+                self._entidx[key] = idx
+        with idx.lock:
+            if not idx.loaded:
+                self._flush_all(app_id, channel_id)  # sizes settle first
+                nbytes = self._log_bytes(app_id, channel_id)
+                if not idx.try_load(nbytes):
+                    idx.rebuild(self.find(app_id, channel_id), nbytes)
+        return idx
+
     def _stale(self, key, h) -> bool:
         """True when a concurrent close()/remove() freed this handle
         between our map lookup and lock acquisition (caller holds the
@@ -291,6 +491,13 @@ class NativeLogEvents(base.Events):
         for _, h, lk in items:
             with lk:                   # in-flight C calls finish first
                 self.lib.el_close(h)
+        with self._entidx_lock:
+            indexes = list(self._entidx.items())
+            self._entidx.clear()
+        for (app_id, channel_id), idx in indexes:
+            # clean close stamps the meta fingerprint: the next open
+            # adopts the sidecar instead of rebuilding
+            idx.close(self._log_bytes(app_id, channel_id))
 
     # -- Events interface ---------------------------------------------------
     def init(self, app_id, channel_id=None) -> bool:
@@ -300,6 +507,12 @@ class NativeLogEvents(base.Events):
 
     def remove(self, app_id, channel_id=None) -> bool:
         removed = False
+        with self._entidx_lock:
+            idx = self._entidx.pop((app_id, channel_id), None)
+        if idx is None:   # sidecar may exist from a prior process
+            idx = _EntityIndex(os.path.join(
+                self.root, f"events_{app_id}_{channel_id or 0}.entidx"))
+        idx.drop()
         parts = list(range(self.partitions)) + [_LEGACY]
         with self._lock:
             for p in parts:
@@ -373,6 +586,14 @@ class NativeLogEvents(base.Events):
         sweep = self.partitions > 1 and preexisting_id
         ctx = (self._overwrite_locks[_hash(self.lib, eid) & 63]
                if sweep else _NULL_CTX)
+        # incremental entity-index maintenance, sidecar line BEFORE the
+        # log append (crash ordering: a dangling indexed id is skipped at
+        # read; a missing one would be a wrong filtered result). Only a
+        # LOADED index is appended to — an unloaded sidecar goes stale
+        # and the next _index_of detects that via the meta fingerprint.
+        idx = self._entidx.get((app_id, channel_id))
+        if idx is not None:
+            idx.add(event.entity_id, event.target_entity_id or "", eid)
         with ctx:
             while True:
                 h, lk = self._handle_of(app_id, channel_id, part)
@@ -400,12 +621,12 @@ class NativeLogEvents(base.Events):
 
     def insert_batch(self, events, app_id, channel_id=None):
         eids = [self.insert(e, app_id, channel_id) for e in events]
-        for p in range(self.partitions):
-            h, lk = self._handle_of(app_id, channel_id, p, create=False)
-            if h is not None:
-                with lk:
-                    if not self._stale((app_id, channel_id, p), h):
-                        self.lib.el_flush(h)
+        self._flush_all(app_id, channel_id)
+        idx = self._entidx.get((app_id, channel_id))
+        if idx is not None and idx.loaded:
+            # batch boundaries are cheap sync points: re-anchor the meta
+            # fingerprint so a clean restart adopts without a rebuild
+            idx.mark_clean(self._log_bytes(app_id, channel_id))
         return eids
 
     def _decode(self, h, eid_bytes: bytes) -> Optional[Event]:
@@ -519,6 +740,41 @@ class NativeLogEvents(base.Events):
             events = events[:limit]
         return iter(events)
 
+
+    def find_columnar_by_entities(self, app_id, channel_id=None,
+                                  entity_ids=None, target_entity_ids=None,
+                                  property_field=None, start_time=None,
+                                  until_time=None, entity_type=None,
+                                  target_entity_type=None, event_names=None,
+                                  limit=None):
+        """Seek+read through the persisted entity-index sidecar: the
+        touched ids' event ids come from the index, each record is an
+        O(1) ``el_get`` probe — per-read cost proportional to the
+        touched histories, never the log size. The first call on an
+        adopted store pays one full-scan rebuild (see _EntityIndex)."""
+        idx = self._index_of(app_id, channel_id)
+        eset = {str(x) for x in (entity_ids or ())}
+        tset = {str(x) for x in (target_entity_ids or ())}
+        events = []
+        for eid in idx.candidate_ids(eset, tset):
+            e = self.get(eid, app_id, channel_id)
+            if e is None:
+                continue     # deleted (or dangling sidecar line)
+            # membership re-check: an overwrite-by-id may have re-routed
+            # the event to entities outside the requested sets while the
+            # old index line still names it
+            if not (e.entity_id in eset
+                    or (e.target_entity_id or "") in tset):
+                continue
+            if not base.match_event(e, start_time, until_time,
+                                    entity_type, None, event_names,
+                                    target_entity_type, None):
+                continue
+            events.append(e)
+        events.sort(key=lambda e: e.event_time)
+        if limit is not None and limit >= 0:
+            events = events[:limit]
+        return base.events_to_columnar(events, property_field)
 
     def find_columnar(self, app_id, channel_id=None, property_field=None,
                       start_time=None, until_time=None, entity_type=None,

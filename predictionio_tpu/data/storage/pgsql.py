@@ -491,6 +491,14 @@ class PGEvents(base.Events):
         client.execute(
             f"CREATE INDEX IF NOT EXISTS {self.t}_entity ON {self.t} "
             "(appid, channelid, entitytype, entityid)")
+        # entity-filtered fold reads (see sqlite.SQLEvents): id-list
+        # probes on either side need these two covering prefixes
+        client.execute(
+            f"CREATE INDEX IF NOT EXISTS {self.t}_entityid ON {self.t} "
+            "(appid, channelid, entityid)")
+        client.execute(
+            f"CREATE INDEX IF NOT EXISTS {self.t}_target ON {self.t} "
+            "(appid, channelid, targetentityid)")
 
     @staticmethod
     def _chan(channel_id) -> int:
@@ -649,6 +657,53 @@ class PGEvents(base.Events):
                 [np.nan if v is None else float(v) for v in rest[0]],
                 dtype=np.float32)
         return out
+
+    #: ids per IN-list statement (shared with the MySQL dialect)
+    _IN_CHUNK = 400
+
+    def _prop_extract_clause(self, params: list, property_field: str) -> str:
+        """Server-side numeric property extraction as a SELECT fragment;
+        the MySQL dialect overrides with JSON_EXTRACT."""
+        params.append(property_field)
+        return f", (properties::json ->> ${len(params)})::float8"
+
+    def find_columnar_by_entities(self, app_id, channel_id=None,
+                                  entity_ids=None, target_entity_ids=None,
+                                  property_field=None, start_time=None,
+                                  until_time=None, entity_type=None,
+                                  target_entity_type=None, event_names=None,
+                                  limit=None):
+        """SQL pushdown of the union read (see sqlite.SQLEvents
+        .find_columnar_by_entities): indexed ``IN`` chunks per side,
+        merged host-side on the event id via the shared
+        base.columnar_from_union_rows. Serves both the PG and MySQL
+        dialects ($n placeholders; property extraction via the
+        _prop_extract_clause hook)."""
+        rows_by_id: dict = {}
+        for column, ids in (("entityid", entity_ids),
+                            ("targetentityid", target_entity_ids)):
+            ids = [str(x) for x in (ids or ())]
+            for lo in range(0, len(ids), self._IN_CHUNK):
+                chunk = ids[lo:lo + self._IN_CHUNK]
+                where, params = self._where(
+                    app_id, channel_id, start_time, until_time,
+                    entity_type, None, event_names, target_entity_type,
+                    None)
+                cols = "id, entityid, targetentityid, event, eventtime"
+                if property_field is not None:
+                    cols += self._prop_extract_clause(params,
+                                                      property_field)
+                spots = []
+                for iid in chunk:
+                    params.append(iid)
+                    spots.append(f"${len(params)}")
+                where += f" AND {column} IN ({','.join(spots)})"
+                for r in self.c.query(
+                        f"SELECT {cols} FROM {self.t}{where}",
+                        tuple(params)):
+                    rows_by_id[r[0]] = r[1:]
+        return base.columnar_from_union_rows(rows_by_id, property_field,
+                                             limit)
 
 
 StorageClient._TRANSPORT_ERRORS = (OSError, PGProtocolError)

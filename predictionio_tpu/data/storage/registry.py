@@ -132,6 +132,7 @@ def get_data_object(repo: str, kind: str):
             namespace = repository_namespace(repo)
             obj = client.get_data_object(kind, namespace)
             if kind == "events":
+                _check_events_conformance(obj)
                 # chaos harness (ISSUE 3): when PIO_FAULTS names a
                 # storage target, every events DAO handed out is
                 # fault-wrapped — any entry point (event server,
@@ -142,6 +143,22 @@ def get_data_object(repo: str, kind: str):
                 obj = maybe_wrap_events(obj)
             _dataobjects[key] = obj
         return _dataobjects[key]
+
+
+def _check_events_conformance(obj) -> None:
+    """Refuse to register an events backend that ships the base-class
+    full-scan fallback as its entity-filtered read: every production
+    backend must push ``find_columnar_by_entities`` down (SQL id lists,
+    the nativelog sidecar, the in-memory index, the event-server batched
+    POST) — the fold tick's O(touched) contract depends on it."""
+    from predictionio_tpu.data.storage import base
+    impl = getattr(type(obj), "find_columnar_by_entities", None)
+    if impl is base.Events.find_columnar_by_entities:
+        raise StorageError(
+            f"events backend {type(obj).__module__}.{type(obj).__name__} "
+            "does not implement find_columnar_by_entities: entity-"
+            "filtered reads would silently full-scan. Override it with "
+            "real pushdown (see data/storage/base.py).")
 
 
 def clear_cache() -> None:

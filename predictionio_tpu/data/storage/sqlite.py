@@ -438,6 +438,15 @@ class SQLEvents(base.Events):
         client.execute(
             f"CREATE INDEX IF NOT EXISTS {self.t}_entity ON {self.t} "
             "(appid, channelid, entitytype, entityid)")
+        # entity-filtered fold reads: id-list predicates on either side
+        # must be index probes, not scans (the _entity index needs the
+        # entitytype prefix; targetentityid had no index at all)
+        client.execute(
+            f"CREATE INDEX IF NOT EXISTS {self.t}_entityid ON {self.t} "
+            "(appid, channelid, entityid)")
+        client.execute(
+            f"CREATE INDEX IF NOT EXISTS {self.t}_target ON {self.t} "
+            "(appid, channelid, targetentityid)")
 
     @staticmethod
     def _chan(channel_id) -> int:
@@ -594,3 +603,43 @@ class SQLEvents(base.Events):
                 [np.nan if v is None else v for v in rest[0]],
                 dtype=np.float32)
         return out
+
+    #: ids per IN-list statement (stays far under SQLite's 999-variable
+    #: floor alongside the shared filter parameters)
+    _IN_CHUNK = 400
+
+    def find_columnar_by_entities(self, app_id, channel_id=None,
+                                  entity_ids=None, target_entity_ids=None,
+                                  property_field=None, start_time=None,
+                                  until_time=None, entity_type=None,
+                                  target_entity_type=None, event_names=None,
+                                  limit=None):
+        """SQL pushdown of the union read: one indexed ``IN (...)`` query
+        per id-chunk per side (entityid via {t}_entityid, targetentityid
+        via {t}_target), merged host-side on the event id — a row
+        matching both sides counts once (base.columnar_from_union_rows
+        owns the shared merge/sort/limit semantics)."""
+        rows_by_id: dict = {}
+        for column, ids in (("entityid", entity_ids),
+                            ("targetentityid", target_entity_ids)):
+            ids = [str(x) for x in (ids or ())]
+            for lo in range(0, len(ids), self._IN_CHUNK):
+                chunk = ids[lo:lo + self._IN_CHUNK]
+                cols = "id, entityid, targetentityid, event, eventtime"
+                params_pre: list = []
+                if property_field is not None:
+                    cols += ", json_extract(properties, ?)"
+                    params_pre.append(f'$."{property_field}"')
+                where, params = self._where(
+                    app_id, channel_id, start_time, until_time,
+                    entity_type, None, event_names, target_entity_type,
+                    None)
+                where += (f" AND {column} IN "
+                          f"({','.join('?' * len(chunk))})")
+                params.extend(chunk)
+                for r in self.c.query(
+                        f"SELECT {cols} FROM {self.t}{where}",
+                        tuple(params_pre) + tuple(params)):
+                    rows_by_id[r[0]] = r[1:]
+        return base.columnar_from_union_rows(rows_by_id, property_field,
+                                             limit)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import threading
+import time
 from typing import Dict, Iterator, Optional, Sequence
 
 from predictionio_tpu.data.datamap import PropertyMap
@@ -89,6 +90,22 @@ class EventStore:
             app_id=app_id, channel_id=channel_id,
             property_field=property_field, **filters)
 
+    def find_columnar_by_entities(self, app_name: str,
+                                  channel_name: Optional[str] = None,
+                                  entity_ids=None, target_entity_ids=None,
+                                  property_field: Optional[str] = None,
+                                  **filters) -> Dict[str, "object"]:
+        """Entity-set-filtered columnar read (see
+        Events.find_columnar_by_entities): the fold tick's O(touched)
+        ingest — rows whose subject is a touched entity OR whose target
+        is a touched target, with each backend's real pushdown behind
+        it."""
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        return self.events.find_columnar_by_entities(
+            app_id=app_id, channel_id=channel_id, entity_ids=entity_ids,
+            target_entity_ids=target_entity_ids,
+            property_field=property_field, **filters)
+
     # -- property aggregation (PEventStore.aggregateProperties) ------------
     def aggregate_properties(self, app_name: str, entity_type: str,
                              channel_name: Optional[str] = None,
@@ -102,6 +119,24 @@ class EventStore:
             start_time=start_time, until_time=until_time, required=required)
 
     # -- serve-time point reads (LEventStore.findByEntity) -----------------
+
+    #: cap on concurrently outstanding deadline-guarded point reads. A
+    #: timed-out read's worker thread keeps running against the slow
+    #: backend (Python threads cannot be killed); the permit it holds is
+    #: released only when the backend finally answers, so at most this
+    #: many wedged readers can pile up — past that, new deadline reads
+    #: fail fast instead of minting another stuck thread each.
+    POINT_READ_MAX_INFLIGHT = 8
+
+    _point_read_sem = threading.BoundedSemaphore(POINT_READ_MAX_INFLIGHT)
+
+    def _timeout_counter(self):
+        from predictionio_tpu.obs import get_registry
+        return get_registry().counter(
+            "pio_event_point_read_timeout_total",
+            "Deadline-guarded event point reads that timed out (their "
+            "late results are discarded; the worker permit is bounded)")
+
     def find_by_entity(self, app_name: str, entity_type: str, entity_id: str,
                        channel_name: Optional[str] = None,
                        event_names: Optional[Sequence[str]] = None,
@@ -112,7 +147,11 @@ class EventStore:
         """Point lookup with an optional deadline (LEventStore.scala:30 — the
         reference's Duration timeout; the ecommerce template calls this with
         200 ms). Runs in a worker thread when a timeout is given so a slow
-        backend cannot stall the serving path."""
+        backend cannot stall the serving path; timed-out workers are
+        BOUNDED (POINT_READ_MAX_INFLIGHT permits — a wedged backend can
+        strand at most that many threads, after which deadline reads
+        fail fast) and counted under
+        ``pio_event_point_read_timeout_total``."""
         def _query():
             return list(self.find(
                 app_name=app_name, channel_name=channel_name,
@@ -124,19 +163,40 @@ class EventStore:
 
         if timeout_ms is None:
             return _query()
+        # the permit wait SHARES the deadline: a healthy burst past the
+        # permit count queues briefly and still answers in time, while a
+        # wedged backend (permits stranded by timed-out workers) makes
+        # new reads fail at their own deadline instead of minting more
+        # stuck threads
+        t_start = time.monotonic()
+        if not EventStore._point_read_sem.acquire(
+                timeout=timeout_ms / 1000.0):
+            self._timeout_counter().inc()
+            raise TimeoutError(
+                f"event lookup exceeded {timeout_ms} ms deadline: all "
+                f"{self.POINT_READ_MAX_INFLIGHT} deadline-read workers "
+                "are busy (backend wedged?)")
+        done = threading.Event()
         result: list = []
         error: list = []
 
         def _run():
             try:
                 result.append(_query())
-            except Exception as e:  # surfaced below
+            except Exception as e:  # surfaced below (if still awaited)
                 error.append(e)
+            finally:
+                EventStore._point_read_sem.release()
+                done.set()
 
-        t = threading.Thread(target=_run, daemon=True)
+        t = threading.Thread(target=_run, daemon=True,
+                             name="pio-point-read")
         t.start()
-        t.join(timeout_ms / 1000.0)
-        if t.is_alive():
+        remaining = timeout_ms / 1000.0 - (time.monotonic() - t_start)
+        if not done.wait(max(0.0, remaining)):
+            # the worker keeps its permit until the backend answers;
+            # its late result is dropped on the floor by design
+            self._timeout_counter().inc()
             raise TimeoutError(
                 f"event lookup exceeded {timeout_ms} ms deadline")
         if error:

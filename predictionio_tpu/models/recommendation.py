@@ -49,6 +49,11 @@ class TrainingData(SanityCheck):
     accepted and converted, so hand-built fixtures keep working."""
     ratings: RatingsData
     items: Optional[dict] = None  # id -> property dict (read_items variants)
+    # True when this payload came from an entity-filtered read
+    # (read_training_touched): it holds ONLY the touched entities'
+    # complete histories, not the corpus — valid fold-in input, never
+    # valid retrain input
+    touched_only: bool = False
 
     def __post_init__(self):
         if isinstance(self.ratings, (list, tuple)):
@@ -125,6 +130,10 @@ class RecommendationDataSource(DataSource):
             app_name=p.app_name, channel_name=p.channel_name,
             property_field="rating", entity_type="user",
             target_entity_type="item", event_names=list(p.event_names))
+        return self._ratings_from_cols(cols, p)
+
+    @staticmethod
+    def _ratings_from_cols(cols, p) -> RatingsData:
         is_rate = cols["event"] == "rate"
         missing = is_rate & np.isnan(cols["prop"])
         if missing.any():
@@ -148,6 +157,46 @@ class RecommendationDataSource(DataSource):
 
     def read_training(self) -> TrainingData:
         return TrainingData(self._read_ratings(), items=self._read_items())
+
+    def read_training_touched(self, touched_users,
+                              touched_items) -> TrainingData:
+        """Entity-filtered fold-tick read: only the touched users'
+        complete rating histories plus every rating landing on a touched
+        item — exactly the rows the touched-row least-squares solves
+        consume (their dedup and per-entity regularizers see complete
+        histories, so the folded factors match the full-scan path). Cost
+        is O(touched histories) through each backend's pushdown
+        (``find_columnar_by_entities``), not a corpus scan."""
+        p = self.params
+        cols = PEventStore.find_columnar_by_entities(
+            app_name=p.app_name, channel_name=p.channel_name,
+            entity_ids=[str(u) for u in touched_users],
+            target_entity_ids=[str(i) for i in touched_items],
+            property_field="rating", entity_type="user",
+            target_entity_type="item", event_names=list(p.event_names))
+        items = None
+        if p.read_items:
+            items = self._read_items_for([str(i) for i in touched_items])
+        return TrainingData(self._ratings_from_cols(cols, p),
+                            items=items, touched_only=True)
+
+    def _read_items_for(self, item_ids) -> dict:
+        """Aggregate $set/$unset/$delete for the given items only (k
+        indexed point reads instead of the corpus-wide property scan;
+        the app/channel names resolve ONCE, not per id)."""
+        from predictionio_tpu.data.aggregator import aggregate_properties
+        from predictionio_tpu.data.storage.base import aggregate_event_names
+        app_id, channel_id = PEventStore.resolve(
+            self.params.app_name, self.params.channel_name)
+        ev = PEventStore.events
+        events = []
+        for iid in item_ids:
+            events.extend(ev.find(
+                app_id=app_id, channel_id=channel_id,
+                entity_type="item", entity_id=iid,
+                event_names=list(aggregate_event_names())))
+        return {eid: dict(pm.fields)
+                for eid, pm in aggregate_properties(events).items()}
 
     def read_eval(self):
         """k-fold split of rating events; one query per test-fold user with
@@ -394,8 +443,13 @@ class ALSAlgorithm(P2LAlgorithm):
             lam=p.lam, sweeps=2,
             compute_dtype=p.compute_dtype or default_compute_dtype(),
             sweep_chunk=p.sweep_chunk)
-        new_als, stats = fold_in_coo(model.als, coo, tu[tu >= 0],
-                                     ti[ti >= 0], cfg)
+        # residency slot per deployed algorithm instance: consecutive
+        # ticks through the same scheduler reuse the device tables and
+        # upload only touched-row plans (fold_in_coo validates the slot
+        # against the model's host arrays, so a swapped-out model misses)
+        new_als, stats = fold_in_coo(
+            model.als, coo, tu[tu >= 0], ti[ti >= 0], cfg,
+            resident_key=f"fold:{type(self).__name__}:{id(self)}")
         item_properties = model.item_properties
         if item_properties is not None and len(item_ix) > len(item_properties):
             # new items: carry fresh $set properties when the data source
@@ -413,7 +467,7 @@ class ALSAlgorithm(P2LAlgorithm):
             "loss": als_rmse(new_als, coo),
             "userRows": stats.n_user_rows, "itemRows": stats.n_item_rows,
             "newUsers": stats.n_new_users, "newItems": stats.n_new_items,
-            "wallS": stats.wall_s,
+            "wallS": stats.wall_s, "residentHit": stats.resident_hit,
         }
         return new_model, report
 

@@ -67,6 +67,11 @@ class TrainingData(SanityCheck):
     items: Dict[str, Item]
     view_events: RatingsData
     like_events: RatingsData = None  # filled when read_like_events on
+    # True for an entity-filtered (fold-tick) read: users/items/events
+    # cover ONLY the touched entities' complete histories — fold_in
+    # merges item metadata with the deployed model's instead of
+    # rebuilding it from this partial bag
+    touched_only: bool = False
 
     def __post_init__(self):
         if isinstance(self.view_events, (list, tuple)):
@@ -176,6 +181,64 @@ class SimilarProductDataSource(DataSource):
                          ).astype(np.float32), lc["t"])
         return TrainingData(users=users, items=items, view_events=views,
                             like_events=likes)
+
+    def read_training_touched(self, touched_users,
+                              touched_items) -> TrainingData:
+        """Entity-filtered fold-tick read (see the recommendation
+        template's read_training_touched): touched users' complete view
+        histories + every view landing on a touched item through the
+        backend pushdown, and per-entity property aggregation for the
+        touched entities only."""
+        app = self.params.app_name
+        chan = self.params.channel_name
+        tu = [str(u) for u in touched_users]
+        ti = [str(i) for i in touched_items]
+        users = {u: dict(pm.fields)
+                 for u, pm in self._aggregate_for("user", tu).items()}
+        items = {}
+        for eid, pm in self._aggregate_for("item", ti).items():
+            cats = pm.get_opt("categories", list)
+            items[eid] = Item(tuple(cats) if cats is not None else None,
+                              properties=dict(pm.fields))
+        view_names = ["view", "rate"] if self.params.rate_as_view \
+            else ["view"]
+        vc = PEventStore.find_columnar_by_entities(
+            app_name=app, channel_name=chan, entity_ids=tu,
+            target_entity_ids=ti, entity_type="user",
+            event_names=view_names, target_entity_type="item")
+        views = RatingsData(vc["entity_id"], vc["target_entity_id"],
+                            np.ones(len(vc["t"]), dtype=np.float32),
+                            vc["t"])
+        likes = None
+        if self.params.read_like_events:
+            lc = PEventStore.find_columnar_by_entities(
+                app_name=app, channel_name=chan, entity_ids=tu,
+                target_entity_ids=ti, entity_type="user",
+                event_names=["like", "dislike"],
+                target_entity_type="item")
+            likes = RatingsData(
+                lc["entity_id"], lc["target_entity_id"],
+                np.where(lc["event"] == "like", 1.0, -1.0
+                         ).astype(np.float32), lc["t"])
+        return TrainingData(users=users, items=items, view_events=views,
+                            like_events=likes, touched_only=True)
+
+    def _aggregate_for(self, entity_type: str, entity_ids) -> dict:
+        """Per-entity property aggregation for an id set: k indexed
+        point reads instead of the corpus-wide $set scan; the
+        app/channel names resolve ONCE, not per id."""
+        from predictionio_tpu.data.aggregator import aggregate_properties
+        from predictionio_tpu.data.storage.base import aggregate_event_names
+        app_id, channel_id = PEventStore.resolve(
+            self.params.app_name, self.params.channel_name)
+        ev = PEventStore.events
+        events = []
+        for eid in entity_ids:
+            events.extend(ev.find(
+                app_id=app_id, channel_id=channel_id,
+                entity_type=entity_type, entity_id=eid,
+                event_names=list(aggregate_event_names())))
+        return aggregate_properties(events)
 
 
 class SimilarProductPreparator(Preparator):
@@ -360,19 +423,25 @@ class ALSAlgorithm(P2LAlgorithm):
         als = ALSModel(user_factors=model.user_factors,
                        item_factors=model.item_factors_raw,
                        rank=model.item_factors_raw.shape[1])
-        new_als, stats = fold_in_coo(als, coo, tu[tu >= 0], ti[ti >= 0],
-                                     cfg)
+        new_als, stats = fold_in_coo(
+            als, coo, tu[tu >= 0], ti[ti >= 0], cfg,
+            resident_key=f"fold:{type(self).__name__}:{id(self)}")
+        # an entity-filtered read carries only the touched items' $set
+        # state: untouched items keep the deployed metadata (categories,
+        # years) instead of being wiped by the partial bag
+        items = ({**model.items, **td.items}
+                 if getattr(td, "touched_only", False) else td.items)
         new_model = SimilarProductModel(
             item_factors_normalized=normalize_rows(new_als.item_factors),
             item_factors_raw=new_als.item_factors,
             user_factors=new_als.user_factors, user_ix=user_ix,
-            **ItemMetadataModel.metadata_kwargs(td.items, item_ix))
+            **ItemMetadataModel.metadata_kwargs(items, item_ix))
         report = {
             "algorithm": type(self).__name__,
             "loss": als_rmse(new_als, coo),
             "userRows": stats.n_user_rows, "itemRows": stats.n_item_rows,
             "newUsers": stats.n_new_users, "newItems": stats.n_new_items,
-            "wallS": stats.wall_s,
+            "wallS": stats.wall_s, "residentHit": stats.resident_hit,
         }
         return new_model, report
 

@@ -18,14 +18,20 @@ the identical ``_solve_batch`` kernel:
   explicit  — ALS-WR: x = argmin sum_S (r - x.v)^2 + lam * n |x|^2
               (per-entity regularizer lam * n ratings, MLlib 1.3).
   implicit  — Hu-Koren: (G + V_S^T (C_S - I) V_S + lam*n*I) x = V_S^T C_S p
-              with G = V^T V over the FULL counterpart table, computed once
-              per one-sided solve (the eig-SMW dual route applies
-              unchanged). Each side's solve within a sweep reads a
-              counterpart table the PREVIOUS side just updated, so the
-              Gram — and the counterpart upload — are per-solve costs by
-              necessity, not caching misses; keeping the carried tables
-              device-resident across sides is the noted future
-              optimization for tunnel-latency deployments.
+              with G = V^T V over the FULL counterpart table.
+
+Device residency (the ALX keep-shards-on-device discipline): a tick
+uploads the grown U/V tables at most once — the solve plans upload once
+per side, both solve sides and every sweep read the tables where they
+already live, and solved rows scatter on-device between sides. The
+implicit Gram is carried alongside its table and updated by the rank-k
+correction G += sum(v_new v_new^T - v_old v_old^T) over the scattered
+rows (recomputed from the table on upload and every
+``_GRAM_REFRESH_EVERY`` incremental ticks, bounding float drift).
+With a ``resident_key``, the tick's final device tables stay resident
+in ``utils/device_cache`` keyed by the published model's host arrays,
+so the NEXT tick uploads only its touched-row solve plans — per-tick
+``pio_fold_upload_bytes_total`` is O(touched), not O(model).
 
 Exactness caveat: a folded row is the exact least-squares solution GIVEN
 the current counterpart factors; counterpart rows not in the touched set
@@ -43,14 +49,15 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-from predictionio_tpu.ops.als import (ALSConfig, ALSModel, _gram, _gram_eig,
-                                      _run_side, _upload_plan,
+from predictionio_tpu.ops.als import (ALSConfig, _gram, _gram_eig,
+                                      ALSModel, _run_side, _upload_plan,
                                       default_compute_dtype,
                                       resolve_sweep_chunk)
 from predictionio_tpu.ops.ratings import RatingsCOO, build_solve_plan
 from predictionio_tpu.ops.solve import resolve_solver
 from predictionio_tpu.parallel.mesh import MeshContext, current_mesh, \
     host_fetch
+from predictionio_tpu.utils import device_cache
 
 
 @dataclass(frozen=True)
@@ -88,6 +95,14 @@ class FoldInStats:
     nnz_item_side: int = 0
     sweeps: int = 0
     wall_s: float = 0.0
+    # True when the tick reused device-resident tables from the previous
+    # tick (no full-table upload happened)
+    resident_hit: bool = False
+
+
+#: incremental Gram updates tolerated before a full recompute from the
+#: table — bounds accumulated float32 error across long tick chains
+_GRAM_REFRESH_EVERY = 64
 
 
 def _als_config(cfg: FoldInConfig, rank: int, solver: str) -> ALSConfig:
@@ -101,6 +116,64 @@ def _als_config(cfg: FoldInConfig, rank: int, solver: str) -> ALSConfig:
         solver_iters=cfg.solver_iters, dual_iters_cap=cfg.dual_iters_cap)
 
 
+# -- small jitted helpers (built lazily; donation never needed) -------------
+
+_jits: dict = {}
+
+
+def _jitted(name: str, impl):
+    fn = _jits.get(name)
+    if fn is None:
+        import jax
+        fn = jax.jit(impl)
+        _jits[name] = fn
+    return fn
+
+
+def _scatter_impl(table, solved, src, dst):
+    return table.at[dst].set(solved[src])
+
+
+def _scatter_gram_impl(table, gram, solved, src, dst):
+    rows = solved[src]
+    old = table[dst]
+    return (table.at[dst].set(rows),
+            gram + rows.T @ rows - old.T @ old)
+
+
+def _eigh_impl(G):
+    import jax.numpy as jnp
+    return jnp.linalg.eigh(G)
+
+
+def _solver_gram(G, dual_auto: bool):
+    """The solver-facing gram the sweep kernels expect: (G, w, q) when
+    the eig-SMW dual route applies, else G alone. The eigendecomposition
+    is rank x rank — recomputing it per solve from the carried G costs
+    nothing next to re-deriving G from the full table."""
+    if G is None:
+        return None
+    if dual_auto:
+        w, q = _jitted("eigh", _eigh_impl)(G)
+        return (G, w, q)
+    return G
+
+
+def _grown_dev(table, n_new: int):
+    """Zero-append rows ON DEVICE so vocabulary growth never round-trips
+    the table through the host."""
+    grow = n_new - int(table.shape[0])
+    if grow <= 0:
+        return table
+    import jax.numpy as jnp
+    return jnp.pad(table, ((0, grow), (0, 0)))
+
+
+def _record_h2d(nbytes: int):
+    from predictionio_tpu.obs import jaxmon
+    jaxmon.record_h2d(int(nbytes))
+
+
 def solve_rows(counter_factors: np.ndarray,
                owner_compact: np.ndarray,
                counter_idx: np.ndarray,
@@ -108,15 +181,16 @@ def solve_rows(counter_factors: np.ndarray,
                n_rows: int,
                cfg: FoldInConfig,
                mesh: Optional[MeshContext] = None) -> np.ndarray:
-    """One-sided normal-equation solve for ``n_rows`` entities.
+    """One-sided normal-equation solve for ``n_rows`` entities, host in /
+    host out — the per-side-upload path (the counterpart table crosses
+    the link on every call; ``fold_in_coo`` is the device-resident tick
+    built from the same kernels). Kept as the reference implementation
+    the parity tests compare against, and for ad-hoc callers.
 
     ``owner_compact`` [nnz] holds compacted 0..n_rows-1 owner ids,
     ``counter_idx``/``values`` the counterpart index and rating of each
     entry. Returns the solved [n_rows, rank] float32 rows; rows with no
     entries come back zero (callers keep the deployed row for those).
-
-    The whole call is the training half-sweep in miniature: bucketed
-    plan -> stacked upload -> one scan-sweep dispatch -> host fetch.
     """
     mesh = mesh or current_mesh()
     counter_factors = np.ascontiguousarray(counter_factors,
@@ -138,6 +212,7 @@ def solve_rows(counter_factors: np.ndarray,
     out_dev = mesh.put_replicated(
         np.zeros((n_rows + 1, rank), dtype=np.float32))
     counter_dev = mesh.put_replicated(counter_factors)
+    _record_h2d(counter_factors.nbytes)   # the per-side upload cost
     als_cfg = _als_config(cfg, rank, solver)
     gram = None
     if cfg.implicit_prefs:
@@ -156,34 +231,78 @@ def _grown_table(table: np.ndarray, n_new: int) -> np.ndarray:
     return out
 
 
-def _side(owner_idx: np.ndarray, counter_idx: np.ndarray,
-          values: np.ndarray, touched: np.ndarray,
-          counter_factors: np.ndarray, out_table: np.ndarray,
-          cfg: FoldInConfig, mesh: Optional[MeshContext]) -> Tuple[int, int]:
-    """Solve the ``touched`` rows of one side in place in ``out_table``.
-    Returns (rows_solved, nnz_consumed)."""
+@dataclass
+class _SidePrep:
+    """One side's per-tick constants: the touched-row selection, solve
+    plan and scatter targets are identical across sweeps (the satellite
+    fix for the per-sweep np.isin recompute), so they are built — and
+    their plan uploaded — exactly once per tick."""
+    groups: tuple          # device-resident stacked plan groups
+    src: np.ndarray        # rows of the solved [touched+1] table to take
+    dst: np.ndarray        # rows of the full table those land on
+    n_rows: int            # touched.size (solved-table height minus pad)
+    nnz: int
+
+
+def _prep_side(owner_idx: np.ndarray, counter_idx: np.ndarray,
+               values: np.ndarray, touched: np.ndarray,
+               cfg: FoldInConfig, mesh: MeshContext
+               ) -> Optional[_SidePrep]:
     if touched.size == 0:
-        return 0, 0
+        return None
     sel = np.isin(owner_idx, touched)
     nnz = int(np.count_nonzero(sel))
     if nnz == 0:
-        return 0, 0
+        return None
     compact = np.searchsorted(touched, owner_idx[sel])
-    solved = solve_rows(counter_factors, compact, counter_idx[sel],
-                        values[sel], touched.size, cfg, mesh)
+    plan = build_solve_plan(
+        np.asarray(compact, dtype=np.int64),
+        np.asarray(counter_idx[sel], dtype=np.int32),
+        np.asarray(values[sel], dtype=np.float32),
+        int(touched.size), work_budget=cfg.work_budget,
+        batch_multiple=mesh.data_parallelism,
+        bucket_ratio=cfg.bucket_ratio)
+    if not plan.batches:
+        return None
+    chunk = resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
+    groups = _upload_plan(mesh, plan, chunk)
     # only scatter rows that actually had data: a touched entity whose
     # entries all vanished (e.g. deleted events) keeps its deployed row
     # rather than being zeroed
     has_data = np.bincount(compact, minlength=touched.size) > 0
-    out_table[touched[has_data]] = solved[has_data]
-    return int(np.count_nonzero(has_data)), nnz
+    return _SidePrep(groups=groups,
+                     src=np.nonzero(has_data)[0].astype(np.int32),
+                     dst=touched[has_data].astype(np.int32),
+                     n_rows=int(touched.size), nnz=nnz)
+
+
+def _solve_side(prep: _SidePrep, counter_dev, counter_gram, out_dev,
+                out_gram, als_cfg: ALSConfig, cfg: FoldInConfig,
+                mesh: MeshContext, rank: int):
+    """One side of one sweep, entirely on device: solve the touched rows
+    against the resident counterpart table, scatter them into the
+    resident owned table, and (implicit) apply the rank-k Gram
+    correction for the rows that moved. Returns the updated
+    (out_dev, out_gram)."""
+    zeros = mesh.put_replicated(
+        np.zeros((prep.n_rows + 1, rank), dtype=np.float32))
+    solved = _run_side(prep.groups, zeros, counter_dev, als_cfg,
+                       _solver_gram(counter_gram,
+                                    cfg.dual_solve == "auto"))
+    if out_gram is None:
+        out_dev = _jitted("scatter", _scatter_impl)(
+            out_dev, solved, prep.src, prep.dst)
+        return out_dev, None
+    return _jitted("scatter_gram", _scatter_gram_impl)(
+        out_dev, out_gram, solved, prep.src, prep.dst)
 
 
 def fold_in_coo(als: ALSModel, coo: RatingsCOO,
                 touched_users: Sequence[int],
                 touched_items: Sequence[int],
                 cfg: FoldInConfig,
-                mesh: Optional[MeshContext] = None
+                mesh: Optional[MeshContext] = None,
+                resident_key: Optional[str] = None
                 ) -> Tuple[ALSModel, FoldInStats]:
     """Fold fresh data into a trained model: re-solve only the touched
     user/item rows against ``coo`` (the CURRENT deduped dataset, whose
@@ -195,28 +314,81 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
     vocabularies): new rows are appended zero-initialized and solved when
     touched, so existing dense indices — and the deployed factor rows
     behind them — never move.
+
+    ``resident_key`` names a device-residency slot: when the passed
+    model's host tables are the ones the previous tick published under
+    the same key, the grown tables (and implicit Grams) are reused
+    in-place on device and the tick uploads only its solve plans.
     """
     t0 = time.perf_counter()
+    mesh = mesh or current_mesh()
     rank = als.rank
     n_users = max(coo.n_users, als.n_users)
     n_items = max(coo.n_items, als.n_items)
-    U = _grown_table(als.user_factors, n_users)
-    V = _grown_table(als.item_factors, n_items)
     tu = np.unique(np.asarray(touched_users, dtype=np.int64))
     ti = np.unique(np.asarray(touched_items, dtype=np.int64))
     stats = FoldInStats(
         n_new_users=n_users - als.n_users,
         n_new_items=n_items - als.n_items)
+    implicit = cfg.implicit_prefs
+
+    # -- tables onto the device (once per tick, or not at all) --------------
+    payload = device_cache.get_resident(
+        resident_key, (als.user_factors, als.item_factors)) \
+        if resident_key else None
+    if payload is not None and payload.get("mesh") is mesh \
+            and payload.get("implicit") == implicit:
+        U_dev = _grown_dev(payload["U"], n_users)
+        V_dev = _grown_dev(payload["V"], n_items)
+        # appended zero rows contribute nothing to a Gram: carry it
+        gram_u, gram_v = payload.get("GU"), payload.get("GV")
+        incr = int(payload.get("incr", 0))
+        stats.resident_hit = True
+    else:
+        U_host = _grown_table(als.user_factors, n_users)
+        V_host = _grown_table(als.item_factors, n_items)
+        U_dev = mesh.put_replicated(U_host)
+        V_dev = mesh.put_replicated(V_host)
+        _record_h2d(U_host.nbytes + V_host.nbytes)
+        gram_u = gram_v = None
+        incr = 0
+    if implicit and (gram_u is None or gram_v is None
+                     or incr >= _GRAM_REFRESH_EVERY):
+        gram_u = _gram(U_dev)
+        gram_v = _gram(V_dev)
+        incr = 0
+
+    # -- per-tick constants, hoisted out of the sweep loop ------------------
+    solver = resolve_solver(cfg.solver, mesh.n_devices)
+    als_cfg = _als_config(cfg, rank, solver)
+    prep_u = _prep_side(coo.user_idx, coo.item_idx, coo.rating, tu,
+                        cfg, mesh)
+    prep_i = _prep_side(coo.item_idx, coo.user_idx, coo.rating, ti,
+                        cfg, mesh)
+
     sweeps = max(1, int(cfg.sweeps))
     for _ in range(sweeps):
-        nu, zu = _side(coo.user_idx, coo.item_idx, coo.rating, tu, V, U,
-                       cfg, mesh)
-        ni, zi = _side(coo.item_idx, coo.user_idx, coo.rating, ti, U, V,
-                       cfg, mesh)
-        stats.n_user_rows += nu
-        stats.n_item_rows += ni
-        stats.nnz_user_side += zu
-        stats.nnz_item_side += zi
+        if prep_u is not None:
+            U_dev, gram_u = _solve_side(
+                prep_u, V_dev, gram_v if implicit else None, U_dev,
+                gram_u if implicit else None, als_cfg, cfg, mesh, rank)
+            stats.n_user_rows += len(prep_u.dst)
+            stats.nnz_user_side += prep_u.nnz
+        if prep_i is not None:
+            V_dev, gram_v = _solve_side(
+                prep_i, U_dev, gram_u if implicit else None, V_dev,
+                gram_v if implicit else None, als_cfg, cfg, mesh, rank)
+            stats.n_item_rows += len(prep_i.dst)
+            stats.nnz_item_side += prep_i.nnz
         stats.sweeps += 1
+
+    U_host = np.asarray(host_fetch(U_dev), dtype=np.float32)
+    V_host = np.asarray(host_fetch(V_dev), dtype=np.float32)
+    if resident_key:
+        device_cache.put_resident(
+            resident_key, (U_host, V_host),
+            {"U": U_dev, "V": V_dev, "GU": gram_u, "GV": gram_v,
+             "mesh": mesh, "implicit": implicit, "incr": incr + 1})
     stats.wall_s = time.perf_counter() - t0
-    return ALSModel(user_factors=U, item_factors=V, rank=rank), stats
+    return ALSModel(user_factors=U_host, item_factors=V_host,
+                    rank=rank), stats

@@ -32,15 +32,17 @@ back-dated BEFORE an already-advanced cursor are not observed until the
 next full retrain (the same visibility rule a batch ``pio train`` run at
 the cursor instant would have had).
 
-Cost model: a fold-in re-reads the training data through the engine's
-own data source (one vectorized columnar scan — the touched rows' solves
-need their COMPLETE histories, and item columns can span the corpus), so
-per-fold cost is bounded by the bulk read, not by a retrain (no plan
-build, no full-table upload, no iteration sweeps; measured on the
-product path, the columnar read is ~22 s of a multi-minute ML-20M
-retrain). Entity-filtered reads that drop the scan to
-O(touched histories) need a filtered read API on the data sources —
-the noted next step for corpus-scale deployments (ROADMAP).
+Cost model: the touched rows' solves need their COMPLETE histories, and
+item columns can span the corpus — but nothing outside the touched
+entities. When the data source supports entity-filtered reads
+(``read_training_touched``, backed by the storage layer's
+``find_columnar_by_entities`` pushdown) and the touched set is small
+(``filtered_read_max_entities``), a tick reads O(touched histories)
+instead of running the full columnar scan (~22 s at ML-20M for a tick
+touching a handful of users); larger touched sets, or data sources
+without the hook, fall back to the full scan. The choice — and the rows
+it read — is recorded in the ``fold_tick`` trace, the fold report
+(``readPath``/``readRows``) and ``pio_fold_read_rows_total{path=...}``.
 """
 
 from __future__ import annotations
@@ -100,6 +102,14 @@ class SchedulerConfig:
     drift_ratio: float = 1.5       # post-fold loss / anchor loss escalation
     poll_interval_s: float = 2.0   # background loop cadence
     tail_batch_limit: int = 50_000  # max events consumed per tick
+    # entity-filtered tail reads (the O(touched) cutover): when the data
+    # source exposes read_training_touched and the touched entity count
+    # is at most filtered_read_max_entities, the fold reads only the
+    # touched histories; otherwise the full scan runs. The threshold is
+    # the cost-model knob: past a few thousand entities the per-id
+    # pushdown probes approach the cost of one sequential scan.
+    filtered_reads: bool = True
+    filtered_read_max_entities: int = 1024
     # supervision (ISSUE 3): consecutive tick failures back off
     # exponentially (poll_interval * 2^k, capped), and after
     # max_tick_failures the scheduler stops folding and escalates to a
@@ -178,6 +188,11 @@ class DeltaTrainingScheduler:
             "pio_fold_tick_failures_total",
             "Scheduler ticks that raised (tail read, solve, or publish "
             "failure); consecutive failures back off exponentially")
+        self._c_fold_read_rows = reg.counter(
+            "pio_fold_read_rows_total",
+            "Training-data rows read by fold ticks, by read path "
+            "(entity_filtered = O(touched) pushdown, full_scan = the "
+            "whole corpus)", labelnames=("path",))
         # breaker over the event-store tail read (ISSUE 3)
         from predictionio_tpu.resilience import CircuitBreaker
         self._tail_breaker = CircuitBreaker(
@@ -357,8 +372,47 @@ class DeltaTrainingScheduler:
 
     # -- the fold-in step ---------------------------------------------------
     def _read_training_data(self):
+        """Full-scan read through the engine's own data source (the
+        fallback path; kept zero-arg so tests and subclasses can stub
+        it)."""
         data_source = self.engine.make_data_source(self.engine_params)
         return data_source.read_training()
+
+    @staticmethod
+    def _td_rows(td) -> Optional[int]:
+        """Row count of a template's training payload (ratings for the
+        recommendation shape, view + like events for similarproduct);
+        None when the shape is unknown."""
+        total = None
+        for attr in ("ratings", "view_events", "like_events"):
+            rows = getattr(td, attr, None)
+            if rows is None:
+                continue
+            try:
+                n = int(len(rows))
+            except TypeError:
+                continue
+            total = n if total is None else total + n
+        return total
+
+    def _read_training(self, touched_users, touched_items):
+        """The cost-model cutover: entity-filtered read when the data
+        source supports it and the touched set is small, else the full
+        scan. Returns ``(td, info)`` where info carries readPath/
+        readRows for the trace, report and metrics."""
+        cfg = self.config
+        n_touched = len(touched_users) + len(touched_items)
+        if cfg.filtered_reads and 0 < n_touched \
+                <= cfg.filtered_read_max_entities:
+            data_source = self.engine.make_data_source(self.engine_params)
+            reader = getattr(data_source, "read_training_touched", None)
+            if reader is not None:
+                td = reader(touched_users, touched_items)
+                return td, {"readPath": "entity_filtered",
+                            "readRows": self._td_rows(td)}
+        td = self._read_training_data()
+        return td, {"readPath": "full_scan",
+                    "readRows": self._td_rows(td)}
 
     def fold_in(self) -> dict:
         """Run one fold-in over the accumulated deltas and publish."""
@@ -385,8 +439,11 @@ class DeltaTrainingScheduler:
         # or /reload on another thread must not inflate the fold's cost
         h2d_before = jaxmon.thread_h2d_total()
         try:
-            with TRACER.span("tail_data_read"):
-                td = self._read_training_data()
+            with TRACER.span("tail_data_read") as sp:
+                td, read_info = self._read_training(touched_users,
+                                                    touched_items)
+                if sp is not None:
+                    sp.attrs.update(read_info)
             new_models: List[Any] = []
             reports: List[dict] = []
             folded_any = False
@@ -425,8 +482,13 @@ class DeltaTrainingScheduler:
             # per-tick upload cost through instrumented paths — the
             # ROADMAP open item as a first-class number
             "h2dBytes": jaxmon.h2d_delta(h2d_before),
+            # which read path the cost model chose, and what it cost
+            **read_info,
         }
         TRACER.annotate(h2dBytes=report["h2dBytes"])
+        if read_info.get("readRows") is not None:
+            self._c_fold_read_rows.labels(
+                path=read_info["readPath"]).inc(read_info["readRows"])
         if not folded_any:
             logger.warning("no algorithm supports fold_in; deltas dropped")
             self.last_report = report

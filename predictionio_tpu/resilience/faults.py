@@ -240,6 +240,14 @@ class FaultyEvents(base.Events):
         return self.inner.find_columnar(app_id, channel_id=channel_id,
                                         **kw)
 
+    def find_columnar_by_entities(self, app_id, channel_id=None, **kw):
+        # explicit forward (not __getattr__): base.Events defines a
+        # fallback impl, so attribute lookup would otherwise run the
+        # un-faulted full-scan default instead of the backend's pushdown
+        self.injector.before("storage.read")
+        return self.inner.find_columnar_by_entities(
+            app_id, channel_id=channel_id, **kw)
+
     def aggregate_properties(self, app_id, channel_id=None, **kw):
         self.injector.before("storage.read")
         return self.inner.aggregate_properties(app_id,

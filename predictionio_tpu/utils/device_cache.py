@@ -84,3 +84,61 @@ def cache_size() -> int:
 def clear():
     with _lock:
         _cache.clear()
+        _resident.clear()
+
+
+# ---------------------------------------------------------------------------
+# Versioned residency slots (fold ticks)
+#
+# A fold tick starts from the DEPLOYED factor tables and ends by publishing
+# grown/updated tables; the next tick starts from exactly those. A named
+# slot keeps the tick's final device arrays resident, keyed by the host
+# arrays of the published model version — when the next tick presents the
+# same host arrays, it reuses the device copies and uploads only the
+# touched-row deltas (the ALX device-resident-shard discipline; ROADMAP
+# open item). One live version per name; a slot dies with its key arrays
+# (weakref callbacks), so an undeployed model never pins HBM.
+# ---------------------------------------------------------------------------
+
+_resident: Dict[str, Tuple[tuple, dict]] = {}   # name -> (key_refs, payload)
+
+
+def get_resident(name: str, key_arrays) -> "dict | None":
+    """The slot's payload iff it was stored against exactly these host
+    arrays (identity match via weakrefs); None on any mismatch."""
+    with _lock:
+        entry = _resident.get(name)
+    if entry is None:
+        return None
+    refs, payload = entry
+    if len(refs) != len(key_arrays):
+        return None
+    if all(r() is a for r, a in zip(refs, key_arrays)):
+        return payload
+    return None
+
+
+def put_resident(name: str, key_arrays, payload: dict):
+    """Store device arrays for ``name``, valid while every array in
+    ``key_arrays`` (the published model version's host tables) is alive
+    and identical; replaces the slot's previous version."""
+    # NOTE: no lock in the callback — gc may run it while this thread
+    # already holds _lock (dict pop is GIL-atomic; same discipline as
+    # cached_put's eviction callback)
+    try:
+        refs = tuple(weakref.ref(a, lambda r, k=name: _resident.pop(k, None))
+                     for a in key_arrays)
+    except TypeError:
+        return  # not weakref-able: skip residency rather than leak HBM
+    with _lock:
+        _resident[name] = (refs, payload)
+
+
+def drop_resident(name: str):
+    with _lock:
+        _resident.pop(name, None)
+
+
+def resident_count() -> int:
+    with _lock:
+        return len(_resident)

@@ -173,6 +173,55 @@ class TestRemoteColumnar:
                                event_names=["nosuch"])
         assert len(out["entity_id"]) == 0 and len(out["prop"]) == 0
 
+    def test_columnar_by_entities_roundtrip(self, remote):
+        """POST /events/columnar.json: the batched entity-filtered read
+        matches the server backend's own pushdown, id lists riding in
+        the body (no query-string cap)."""
+        ev, app_id, _ = remote
+        for i in range(40):
+            ev.insert(mk(eid=f"u{i % 8}", sec=i,
+                         target_entity_type="item",
+                         target_entity_id=f"i{i % 6}",
+                         properties=DataMap(
+                             {"rating": float(i % 5) + 0.5})), app_id)
+        eids = ["u1", "u3"]
+        tids = ["i0"]
+        got = ev.find_columnar_by_entities(
+            app_id, entity_ids=eids, target_entity_ids=tids,
+            property_field="rating")
+        ref = Storage.get_events().find_columnar_by_entities(
+            app_id, entity_ids=eids, target_entity_ids=tids,
+            property_field="rating")
+        for k in ("entity_id", "target_entity_id", "event", "t"):
+            assert got[k].tolist() == ref[k].tolist(), k
+        np.testing.assert_allclose(got["prop"], ref["prop"])
+        # a big id batch survives one POST (far past any URL length)
+        many = [f"u{i}" for i in range(3000)]
+        wide = ev.find_columnar_by_entities(app_id, entity_ids=many)
+        assert len(wide["t"]) == 40
+        # empty sets mean empty result, never a full scan
+        none = ev.find_columnar_by_entities(app_id)
+        assert len(none["t"]) == 0
+
+    def test_columnar_by_entities_falls_back_on_old_server(
+            self, remote, monkeypatch):
+        ev, app_id, _ = remote
+        ev.insert(mk(properties=DataMap({"rating": 2.0}),
+                     target_entity_type="item", target_entity_id="i1"),
+                  app_id)
+        orig = ev._request
+
+        def no_route(method, path, params=None, body=None):
+            if method == "POST" and path == "/events/columnar.json":
+                return 404, {"message": "not found"}
+            return orig(method, path, params, body)
+
+        monkeypatch.setattr(ev, "_request", no_route)
+        out = ev.find_columnar_by_entities(
+            app_id, entity_ids=["u1"], property_field="rating")
+        assert len(out["entity_id"]) == 1
+        np.testing.assert_allclose(out["prop"], [2.0])
+
     def test_columnar_falls_back_on_old_server(self, remote, monkeypatch):
         """A server without the columnar route (404) must transparently
         fall back to the streamed-find default."""

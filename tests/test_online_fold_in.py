@@ -227,3 +227,162 @@ class TestFoldInMechanics:
         before = m.user_factors[2].copy()
         mf, _ = fold_in_coo(m, coo, [2], [], FoldInConfig(lam=0.1))
         np.testing.assert_array_equal(mf.user_factors[2], before)
+
+
+def _per_side_upload_fold(als, coo, touched_users, touched_items, cfg):
+    """The pre-device-residency reference loop: per-solve counterpart
+    uploads through solve_rows, host-side scatters — the baseline the
+    device-resident tick must match bit-for-bit-ish (<=1e-5) and beat
+    on upload bytes."""
+    from predictionio_tpu.online.fold_in import _grown_table
+    n_users = max(coo.n_users, als.n_users)
+    n_items = max(coo.n_items, als.n_items)
+    U = _grown_table(als.user_factors, n_users)
+    V = _grown_table(als.item_factors, n_items)
+    tu = np.unique(np.asarray(touched_users, dtype=np.int64))
+    ti = np.unique(np.asarray(touched_items, dtype=np.int64))
+    for _ in range(max(1, int(cfg.sweeps))):
+        for owner, counter, touched, ctab, otab in (
+                (coo.user_idx, coo.item_idx, tu, V, U),
+                (coo.item_idx, coo.user_idx, ti, U, V)):
+            if touched.size == 0:
+                continue
+            sel = np.isin(owner, touched)
+            if not sel.any():
+                continue
+            compact = np.searchsorted(touched, owner[sel])
+            solved = solve_rows(ctab, compact, counter[sel],
+                                coo.rating[sel], touched.size, cfg)
+            has = np.bincount(compact, minlength=touched.size) > 0
+            otab[touched[has]] = solved[has]
+    return U, V
+
+
+class TestDeviceResidentFold:
+    """ISSUE 4 (b): the device-resident tick must match the
+    per-side-upload reference on the same inputs (<=1e-5), and a second
+    consecutive tick through a residency slot must upload >=10x fewer
+    bytes than the per-side-upload baseline."""
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_matches_per_side_upload_reference(self, mesh8, implicit):
+        ui, ii, vv, rng = _structured_ratings(80, 40, per_u=12,
+                                              implicit=implicit)
+        coo = RatingsCOO(ui, ii, vv, 80, 40)
+        lam = 0.5 if implicit else 0.1
+        m = als_train(coo, ALSConfig(rank=6, iterations=4, lam=lam,
+                                     seed=2, implicit_prefs=implicit,
+                                     alpha=2.0))
+        tu = rng.choice(80, size=7, replace=False).astype(np.int64)
+        ti = rng.choice(40, size=4, replace=False).astype(np.int64)
+        cfg = FoldInConfig(lam=lam, sweeps=2, implicit_prefs=implicit,
+                           alpha=2.0)
+        mf, stats = fold_in_coo(m, coo, tu, ti, cfg)
+        assert not stats.resident_hit
+        U_ref, V_ref = _per_side_upload_fold(m, coo, tu, ti, cfg)
+        np.testing.assert_allclose(mf.user_factors, U_ref,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(mf.item_factors, V_ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_resident_second_tick_cuts_uploads_10x(self, mesh8):
+        from predictionio_tpu.obs import jaxmon
+        from predictionio_tpu.utils import device_cache
+        rng = np.random.default_rng(7)
+        n_u, n_i, rank = 3000, 2000, 32
+        from predictionio_tpu.ops.als import ALSModel
+        als = ALSModel(
+            user_factors=rng.standard_normal((n_u, rank)
+                                             ).astype(np.float32),
+            item_factors=rng.standard_normal((n_i, rank)
+                                             ).astype(np.float32),
+            rank=rank)
+        # touched histories only (the entity-filtered read shape)
+        tu = np.arange(12, dtype=np.int64)
+        ti = np.array([5, 9], dtype=np.int64)
+        ui = np.repeat(tu, 15).astype(np.int32)
+        ii = rng.integers(0, n_i, ui.size).astype(np.int32)
+        vv = rng.uniform(1, 5, ui.size).astype(np.float32)
+        coo = RatingsCOO(ui, ii, vv, n_u, n_i)
+        cfg = FoldInConfig(lam=0.1, sweeps=2)
+        key = "test-resident-slot"
+        device_cache.drop_resident(key)
+        try:
+            b0 = jaxmon.thread_h2d_total()
+            m1, s1 = fold_in_coo(als, coo, tu, ti, cfg,
+                                 resident_key=key)
+            tick1 = jaxmon.h2d_delta(b0)
+            assert not s1.resident_hit
+            table_bytes = als.user_factors.nbytes + als.item_factors.nbytes
+            assert tick1 >= table_bytes          # first tick uploads all
+            # second consecutive tick: same slot, tables resident
+            b1 = jaxmon.thread_h2d_total()
+            m2, s2 = fold_in_coo(m1, coo, tu, ti, cfg,
+                                 resident_key=key)
+            tick2 = jaxmon.h2d_delta(b1)
+            assert s2.resident_hit
+            assert tick2 < table_bytes           # no full-table upload
+            # per-side-upload baseline on the same inputs
+            b2 = jaxmon.thread_h2d_total()
+            U_ref, V_ref = _per_side_upload_fold(m1, coo, tu, ti, cfg)
+            baseline = jaxmon.h2d_delta(b2)
+            assert baseline >= 10 * tick2, (baseline, tick2)
+            # and the resident tick's math still matches the reference
+            np.testing.assert_allclose(m2.user_factors, U_ref,
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(m2.item_factors, V_ref,
+                                       rtol=1e-5, atol=1e-5)
+        finally:
+            device_cache.drop_resident(key)
+
+    def test_resident_slot_grows_with_vocab(self, mesh8):
+        """Vocabulary growth between resident ticks zero-appends on
+        device — old rows keep their indices, new rows solve."""
+        from predictionio_tpu.utils import device_cache
+        ui, ii, vv, rng = _structured_ratings(30, 15, per_u=6)
+        coo = RatingsCOO(ui, ii, vv, 30, 15)
+        m = als_train(coo, ALSConfig(rank=4, iterations=3, lam=0.1,
+                                     seed=4))
+        key = "test-resident-grow"
+        device_cache.drop_resident(key)
+        try:
+            m1, s1 = fold_in_coo(m, coo, [3], [], FoldInConfig(lam=0.1),
+                                 resident_key=key)
+            # tick 2 grows the user vocab by one (new user 30)
+            ui2 = np.concatenate([ui, [30, 30]]).astype(np.int32)
+            ii2 = np.concatenate([ii, [0, 1]]).astype(np.int32)
+            vv2 = np.concatenate([vv, [5.0, 4.0]]).astype(np.float32)
+            grown = RatingsCOO(ui2, ii2, vv2, 31, 15)
+            m2, s2 = fold_in_coo(m1, grown, [30], [],
+                                 FoldInConfig(lam=0.1),
+                                 resident_key=key)
+            assert s2.resident_hit and s2.n_new_users == 1
+            assert m2.n_users == 31
+            np.testing.assert_array_equal(m2.user_factors[:30],
+                                          m1.user_factors)
+            assert np.abs(m2.user_factors[30]).sum() > 0
+        finally:
+            device_cache.drop_resident(key)
+
+    def test_stale_slot_misses_on_foreign_model(self, mesh8):
+        """A slot stored for one model's host arrays must not serve a
+        different model (identity-keyed residency)."""
+        from predictionio_tpu.utils import device_cache
+        ui, ii, vv, _ = _structured_ratings(20, 10, per_u=5)
+        coo = RatingsCOO(ui, ii, vv, 20, 10)
+        m = als_train(coo, ALSConfig(rank=4, iterations=2, lam=0.1,
+                                     seed=5))
+        key = "test-resident-miss"
+        device_cache.drop_resident(key)
+        try:
+            fold_in_coo(m, coo, [1], [], FoldInConfig(lam=0.1),
+                        resident_key=key)
+            # a DIFFERENT model object under the same key: must miss
+            other = als_train(coo, ALSConfig(rank=4, iterations=2,
+                                             lam=0.2, seed=6))
+            _, stats = fold_in_coo(other, coo, [1], [],
+                                   FoldInConfig(lam=0.1),
+                                   resident_key=key)
+            assert not stats.resident_hit
+        finally:
+            device_cache.drop_resident(key)

@@ -194,15 +194,16 @@ class TestSchedulerTail:
         self._post_rating(app_id, "newbie", "i0")
         sched.poll_events()
         assert sched.pending_deltas() == 1
-        # phase 1: the read blows up
-        orig_read = sched._read_training_data
-        sched._read_training_data = lambda: (_ for _ in ()).throw(
+        # phase 1: the read blows up (stub the cutover entry point so
+        # the failure hits whichever read path the cost model picks)
+        orig_read = sched._read_training
+        sched._read_training = lambda tu, ti: (_ for _ in ()).throw(
             OSError("storage hiccup"))
         with pytest.raises(OSError):
             sched.fold_in()
         assert sched.pending_deltas() == 1 and sched.fold_in_count == 0
         # phase 2: the publish blows up (swap refused)
-        sched._read_training_data = orig_read
+        sched._read_training = orig_read
         orig_swap = server.swap_models
         server.swap_models = lambda *a, **k: (_ for _ in ()).throw(
             RuntimeError("swap refused"))

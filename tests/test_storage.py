@@ -210,6 +210,83 @@ class TestEventsFind:
         row = list(cols["entity_id"]).index('u"q\\uote')
         assert cols["prop"][row] == pytest.approx(2.0)
 
+    def test_find_columnar_by_entities_contract(self):
+        """The entity-filtered read must agree with a reference filter
+        over find() on every backend: union semantics (subject in the id
+        set OR target in the target set), shared filters applied, rows
+        time-ascending. This is the backend-contract fixture the fold
+        tick's O(touched) path rides on."""
+        import numpy as np
+        self.ev.insert(mk("rate", "u3", 5, target_entity_type="item",
+                          target_entity_id="i2",
+                          properties=DataMap({"rating": 3.5})), 1)
+
+        def reference(entity_ids, target_ids, **filters):
+            eset, tset = set(entity_ids), set(target_ids)
+            rows = []
+            for e in self.ev.find(1, **filters):
+                if e.entity_id in eset or (e.target_entity_id or "") \
+                        in tset:
+                    rows.append((e.entity_id, e.target_entity_id or "",
+                                 e.event))
+            return rows
+
+        cases = [
+            (["u1"], []), ([], ["i1"]), (["u1"], ["i2"]),
+            (["u1", "u2", "u3"], ["i1", "i2"]),
+            (["nope"], ["also-nope"]), ([], []),
+        ]
+        for eids, tids in cases:
+            cols = self.ev.find_columnar_by_entities(
+                1, entity_ids=eids, target_entity_ids=tids)
+            got = list(zip(cols["entity_id"], cols["target_entity_id"],
+                           cols["event"]))
+            assert sorted(got) == sorted(reference(eids, tids)), \
+                (eids, tids)
+            assert (np.diff(cols["t"]) >= 0).all()   # time-ascending
+
+        # shared filters ride along (event names + time + target type)
+        cols = self.ev.find_columnar_by_entities(
+            1, entity_ids=["u1", "u3"], target_entity_ids=[],
+            event_names=["rate"], start_time=t(2))
+        got = list(zip(cols["entity_id"], cols["event"]))
+        assert sorted(got) == sorted(
+            [(e.entity_id, e.event) for e in self.ev.find(
+                1, event_names=["rate"], start_time=t(2))
+             if e.entity_id in ("u1", "u3")])
+        # prop column extracted where present, NaN where absent
+        cols = self.ev.find_columnar_by_entities(
+            1, entity_ids=["u3"], target_entity_ids=[],
+            property_field="rating")
+        assert cols["prop"].dtype == np.float32
+        assert cols["prop"][list(cols["entity_id"]).index("u3")] \
+            == pytest.approx(3.5)
+        # limit bounds the merged result; limit=0 is empty, not 1 row
+        cols = self.ev.find_columnar_by_entities(
+            1, entity_ids=["u1"], target_entity_ids=["i1"], limit=2)
+        assert len(cols["t"]) == 2
+        assert len(self.ev.find_columnar_by_entities(
+            1, entity_ids=["u1"], target_entity_ids=["i1"],
+            limit=0)["t"]) == 0
+
+    def test_find_columnar_by_entities_sees_mutations(self):
+        """Index-backed backends must track deletes and overwrites, not
+        serve stale candidates."""
+        eid = self.ev.insert(mk("rate", "u9", 7, target_entity_type="item",
+                                target_entity_id="i9"), 1)
+        cols = self.ev.find_columnar_by_entities(1, entity_ids=["u9"])
+        assert list(cols["entity_id"]) == ["u9"]
+        # overwrite-by-id re-routes the entity: u9 no longer matches
+        self.ev.insert(mk("rate", "u10", 7, target_entity_type="item",
+                          target_entity_id="i9", event_id=eid), 1)
+        assert len(self.ev.find_columnar_by_entities(
+            1, entity_ids=["u9"])["t"]) == 0
+        assert list(self.ev.find_columnar_by_entities(
+            1, entity_ids=["u10"])["entity_id"]) == ["u10"]
+        self.ev.delete(eid, 1)
+        assert len(self.ev.find_columnar_by_entities(
+            1, entity_ids=["u10"])["t"]) == 0
+
     def test_aggregate_properties_via_store(self):
         self.ev.insert(mk("$unset", "u1", 5,
                           properties=DataMap({"a": None})), 1)
@@ -788,3 +865,165 @@ class TestDocIndex:
         with pytest.raises(StorageError, match="metadata backend"):
             c.get_data_object("models", "ns")
         c.close()
+
+
+class TestNativeLogEntityIndex:
+    """The persisted per-entity sidecar behind nativelog's O(touched)
+    filtered reads: built incrementally on append, adopted after a clean
+    close, rebuilt after an unclean one or on a pre-sidecar store."""
+
+    def _client(self, tmp_path, partitions=1):
+        from predictionio_tpu.data.storage.nativelog import StorageClient
+        cfg = {"PATH": str(tmp_path / "log")}
+        if partitions > 1:
+            cfg["PARTITIONS"] = str(partitions)
+        return StorageClient(StorageClientConfig("T", "nativelog", cfg))
+
+    def _fill(self, ev, n=6):
+        ev.init(1)
+        ev.insert_batch([
+            mk("rate", f"u{i % 3}", i + 1, target_entity_type="item",
+               target_entity_id=f"i{i % 2}") for i in range(n)], 1)
+
+    def test_sidecar_adopted_after_clean_close(self, tmp_path):
+        c = self._client(tmp_path)
+        ev = c.get_data_object("events", "ns")
+        self._fill(ev)
+        cols = ev.find_columnar_by_entities(1, entity_ids=["u1"])
+        assert len(cols["t"]) == 2
+        c.close()     # stamps the meta fingerprint
+
+        c2 = self._client(tmp_path)
+        ev2 = c2.get_data_object("events", "ns")
+        idx = ev2._index_of(1, None)
+        assert idx.loaded                     # adopted, not rebuilt
+        assert len(ev2.find_columnar_by_entities(
+            1, entity_ids=["u1"])["t"]) == 2
+        # incremental maintenance after adoption
+        ev2.insert(mk("rate", "u1", 55, target_entity_type="item",
+                      target_entity_id="i5"), 1)
+        assert len(ev2.find_columnar_by_entities(
+            1, entity_ids=["u1"])["t"]) == 3
+        c2.close()
+
+    def test_stale_sidecar_rebuilt_on_adoption(self, tmp_path):
+        """Writes that bypassed the sidecar (old build / crash without a
+        clean close) must trigger a rebuild, never a silent miss."""
+        c = self._client(tmp_path)
+        ev = c.get_data_object("events", "ns")
+        self._fill(ev)
+        ev.find_columnar_by_entities(1, entity_ids=["u0"])
+        c.close()
+        # append events through a client that never loads the index:
+        # the sidecar on disk goes stale relative to the log
+        c2 = self._client(tmp_path)
+        ev2 = c2.get_data_object("events", "ns")
+        ev2.insert_batch([mk("rate", "u7", 50, target_entity_type="item",
+                             target_entity_id="i0")], 1)
+        # same process, index not yet loaded here -> load detects the
+        # fingerprint mismatch and rebuilds
+        cols = ev2.find_columnar_by_entities(1, entity_ids=["u7"])
+        assert list(cols["entity_id"]) == ["u7"]
+        c2.close()
+
+    def test_partitioned_store_filtered_reads(self, tmp_path):
+        c = self._client(tmp_path, partitions=4)
+        ev = c.get_data_object("events", "ns")
+        self._fill(ev, n=12)
+        cols = ev.find_columnar_by_entities(
+            1, entity_ids=["u0"], target_entity_ids=["i1"])
+        ref = [e for e in ev.find(1)
+               if e.entity_id == "u0" or e.target_entity_id == "i1"]
+        assert len(cols["t"]) == len(ref)
+        c.close()
+
+
+class TestEventsBackendConformance:
+    """A backend registering without real find_columnar_by_entities
+    pushdown must be refused (the registry gate, CI satellite)."""
+
+    def test_base_default_is_refused(self):
+        from predictionio_tpu.data.storage import base
+        from predictionio_tpu.data.storage.registry import (
+            StorageError, _check_events_conformance)
+
+        class LazyBackend(base.Events):
+            def init(self, app_id, channel_id=None):
+                return True
+
+            def remove(self, app_id, channel_id=None):
+                return True
+
+            def insert(self, event, app_id, channel_id=None):
+                return "x"
+
+            def get(self, event_id, app_id, channel_id=None):
+                return None
+
+            def delete(self, event_id, app_id, channel_id=None):
+                return False
+
+            def find(self, app_id, channel_id=None, **kw):
+                return iter(())
+
+        with pytest.raises(StorageError, match="find_columnar_by_entities"):
+            _check_events_conformance(LazyBackend())
+
+    def test_base_default_matches_pushdown_semantics(self):
+        """The base-class fallback (live on the wire via the
+        eventserver client's old-server path) must agree with the
+        pushdown implementations — union filter, time order, limit
+        (including limit=0 -> empty)."""
+        from predictionio_tpu.data.storage import base
+        from predictionio_tpu.data.storage.memory import MemEvents
+
+        mem = MemEvents()
+
+        class ViaFind(base.Events):
+            """Minimal backend: only find(), so the base default runs."""
+            init = mem.init
+            remove = mem.remove
+            insert = mem.insert
+            get = mem.get
+            delete = mem.delete
+
+            def find(self, app_id, channel_id=None, **kw):
+                return mem.find(app_id, channel_id=channel_id, **kw)
+
+        via = ViaFind()
+        via.init(1)
+        for i in range(6):
+            via.insert(mk("rate", f"u{i % 3}", i + 1,
+                          target_entity_type="item",
+                          target_entity_id=f"i{i % 2}"), 1)
+        got = via.find_columnar_by_entities(
+            1, entity_ids=["u1"], target_entity_ids=["i0"])
+        ref = mem.find_columnar_by_entities(
+            1, entity_ids=["u1"], target_entity_ids=["i0"])
+        for k in ("entity_id", "target_entity_id", "event", "t"):
+            assert got[k].tolist() == ref[k].tolist(), k
+        assert len(via.find_columnar_by_entities(
+            1, entity_ids=["u1"], limit=0)["t"]) == 0
+        assert len(via.find_columnar_by_entities(
+            1, entity_ids=["u1"], limit=1)["t"]) == 1
+
+    def test_all_registered_backends_conform(self):
+        from predictionio_tpu.data.storage import base
+        from predictionio_tpu.data.storage.eventserver_client import \
+            RemoteEvents
+        from predictionio_tpu.data.storage.memory import MemEvents
+        from predictionio_tpu.data.storage.mysql import MyEvents
+        from predictionio_tpu.data.storage.nativelog import NativeLogEvents
+        from predictionio_tpu.data.storage.pgsql import PGEvents
+        from predictionio_tpu.data.storage.sqlite import SQLEvents
+        for cls in (MemEvents, SQLEvents, PGEvents, MyEvents,
+                    NativeLogEvents, RemoteEvents):
+            assert cls.find_columnar_by_entities \
+                is not base.Events.find_columnar_by_entities, cls
+
+    def test_registry_hands_out_conformant_events(self, tmp_env):
+        from predictionio_tpu.data.storage import base
+        from predictionio_tpu.data.storage.registry import Storage
+        ev = Storage.get_events()
+        assert type(ev).find_columnar_by_entities \
+            is not base.Events.find_columnar_by_entities
