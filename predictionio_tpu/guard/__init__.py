@@ -1,0 +1,39 @@
+"""Model-guard layer: numerical sentinels, pre-swap quality gates,
+canary serving and automatic rollback (ISSUE 5 tentpole).
+
+PR 3 made the system survive *infrastructure* faults; this package
+defends against *model* faults — a fold tick fed poisoned events, a
+NaN/Inf blow-up in an ALS sweep, or a degenerate factor table must
+never be hot-swapped into live serving unchecked:
+
+- ``sentinels`` — cheap on-device finite/norm-explosion checks inside
+  the ALS train sweeps and ``fold_in``, with a checkpointed
+  last-good-sweep rollback (a poisoned tick aborts — restoring deltas
+  via the PR 1 machinery — instead of minting NaN factors).
+- ``gates``     — pre-swap quality gates on the registry/scheduler
+  publish path: finiteness, factor-norm and score-distribution drift
+  bounds vs the live model, and a golden-query replay set whose
+  results must stay within an overlap threshold.
+- ``canary``    — canary serving + post-swap watchdog in the engine
+  server: a new version serves a configurable traffic fraction first;
+  error-rate/NaN-score/latency breaches vs the incumbent trigger an
+  automatic rollback to the registry-pinned last-known-good version.
+
+Every decision emits on the PR 2 telemetry layer (``pio_guard_*``
+counters, gate verdicts in the ``fold_tick`` trace, ``X-PIO-Canary``
+response tagging). ``PIO_GUARD=off`` is the operator kill switch for
+sentinels + gates (canary is per-server config).
+"""
+
+from predictionio_tpu.guard.sentinels import (  # noqa: F401
+    NumericalFault, SweepSentinel, guard_enabled, table_stats)
+from predictionio_tpu.guard.gates import (  # noqa: F401
+    GateConfig, GateRejected, QualityGatekeeper)
+from predictionio_tpu.guard.canary import (  # noqa: F401
+    CanaryConfig, CanaryController)
+
+__all__ = [
+    "NumericalFault", "SweepSentinel", "guard_enabled", "table_stats",
+    "GateConfig", "GateRejected", "QualityGatekeeper",
+    "CanaryConfig", "CanaryController",
+]

@@ -1,0 +1,197 @@
+"""Numerical sentinels: on-device finite/norm checks for factor sweeps.
+
+Large-scale training systems treat non-finite values and loss spikes as
+first-class recoverable faults rather than silent corruption (PAPERS.md
+fault-tolerant-training surveys); here the unit of recovery is one ALS
+sweep. A sentinel check is one tiny jitted reduction over the rows a
+sweep just solved — an all-finite flag and the max squared row norm —
+fetched as two scalars, so the cost per check is O(touched rows) device
+work plus one host sync, not an O(model) host round trip.
+
+Breach policy is the caller's:
+
+- ``fold_in_coo`` checks each side after its solve. With at least one
+  clean full sweep checkpointed it rolls the device tables back to that
+  sweep and publishes the last-good state; with none it raises
+  ``NumericalFault`` so the tick aborts and the scheduler's existing
+  delta-restore machinery (PR 1) requeues the events.
+- ``als_train`` checkpoints the factor tables each iteration (an HBM
+  copy, never a host fetch) and on breach returns the last clean
+  iteration's model instead of NaN factors; a first-iteration breach
+  raises.
+
+``PIO_GUARD=off`` (or ``0``) disables every sentinel and gate — the
+operator kill switch when the guard layer itself misbehaves.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class NumericalFault(ArithmeticError):
+    """A sweep produced non-finite or norm-exploded factor rows."""
+
+    def __init__(self, site: str, detail: str):
+        super().__init__(f"numerical fault in {site}: {detail}")
+        self.site = site
+        self.detail = detail
+
+
+def guard_enabled() -> bool:
+    """The PIO_GUARD kill switch: sentinels + gates are on unless the
+    environment says ``off``/``0``."""
+    return os.environ.get("PIO_GUARD", "").strip().lower() \
+        not in ("off", "0", "false")
+
+
+_jits: dict = {}
+
+
+def _jitted(name, impl):
+    fn = _jits.get(name)
+    if fn is None:
+        import jax
+        fn = jax.jit(impl)
+        _jits[name] = fn
+    return fn
+
+
+def _table_stats_impl(table):
+    import jax.numpy as jnp
+    finite = jnp.all(jnp.isfinite(table))
+    sq = jnp.sum(table.astype(jnp.float32) ** 2, axis=-1)
+    return jnp.stack([finite.astype(jnp.float32),
+                      jnp.max(sq, initial=0.0)])
+
+
+def _rows_stats_impl(table, idx):
+    import jax.numpy as jnp
+    rows = table[idx]
+    finite = jnp.all(jnp.isfinite(rows))
+    sq = jnp.sum(rows.astype(jnp.float32) ** 2, axis=-1)
+    return jnp.stack([finite.astype(jnp.float32),
+                      jnp.max(sq, initial=0.0)])
+
+
+def _copy_impl(table):
+    import jax.numpy as jnp
+    return jnp.copy(table)
+
+
+def device_copy(table):
+    """An independent HBM copy — the checkpoint buffer survives a later
+    donated sweep consuming the original."""
+    return _jitted("copy", _copy_impl)(table)
+
+
+def table_stats(table) -> Tuple[bool, float]:
+    """(all finite, max row L2 norm) of a device (or host) table."""
+    vals = np.asarray(_jitted("table_stats", _table_stats_impl)(table))
+    return bool(vals[0] > 0.5), float(np.sqrt(max(vals[1], 0.0)))
+
+
+def _pad_pow2(idx: np.ndarray) -> np.ndarray:
+    """Pad the checked-row index vector to a power-of-two length
+    (repeating the first index — duplicates change neither the finite
+    flag nor the max) so the jitted stats kernel compiles once per size
+    class instead of once per touched-set size."""
+    n = int(idx.size)
+    m = 1 << max(n - 1, 0).bit_length()
+    if m == n:
+        return idx
+    out = np.empty(m, dtype=np.int32)
+    out[:n] = idx
+    out[n:] = idx[0]
+    return out
+
+
+def rows_stats(table, idx: np.ndarray) -> Tuple[bool, float]:
+    """(all finite, max row L2 norm) over ``table[idx]`` — the
+    O(touched) per-side sentinel read."""
+    if idx.size == 0:
+        return True, 0.0
+    padded = _pad_pow2(np.asarray(idx, dtype=np.int32))
+    vals = np.asarray(
+        _jitted("rows_stats", _rows_stats_impl)(table, padded))
+    return bool(vals[0] > 0.5), float(np.sqrt(max(vals[1], 0.0)))
+
+
+def host_max_norm(*tables: np.ndarray) -> float:
+    """Max row L2 norm across host factor tables — the baseline the
+    explosion bound scales from."""
+    mx = 0.0
+    for t in tables:
+        if t is None or t.size == 0:
+            continue
+        with np.errstate(over="ignore", invalid="ignore"):
+            n = float(np.sqrt(np.max(np.einsum("ij,ij->i", t, t))))
+        if np.isfinite(n):
+            mx = max(mx, n)
+    return mx
+
+
+def _breach_counter():
+    from predictionio_tpu.obs import get_registry
+    return get_registry().counter(
+        "pio_guard_sentinel_breaches_total",
+        "Numerical sentinel breaches (non-finite or norm-exploded "
+        "factor rows) by site",
+        labelnames=("site",))
+
+
+class SweepSentinel:
+    """Per-sweep breach detector: rows must be finite and their norms
+    must stay under ``max(norm_floor, norm_ratio * baseline)`` where
+    the baseline is the incumbent model's max row norm (a legitimate
+    fold moves rows a little; an explosion moves them orders of
+    magnitude)."""
+
+    def __init__(self, site: str, baseline_norm: float,
+                 norm_ratio: float = 1e3, norm_floor: float = 1e4):
+        self.site = site
+        self.bound = max(norm_floor, norm_ratio * baseline_norm)
+        self.breaches = 0
+        # largest norm seen by a PASSING check: callers fold it into the
+        # next tick's baseline so the baseline never needs another
+        # O(model) rescan (untouched rows keep their old, already-
+        # covered norms; touched rows were all observed here)
+        self.observed_max = baseline_norm
+
+    def check_rows(self, table, idx: np.ndarray, what: str
+                   ) -> Optional[NumericalFault]:
+        """Inspect the just-solved rows; returns the fault (also counted
+        in ``pio_guard_sentinel_breaches_total``) or None. The CALLER
+        decides whether to roll back or raise."""
+        if not guard_enabled():
+            return None
+        finite, max_norm = rows_stats(table, idx)
+        if finite and max_norm <= self.bound:
+            self.observed_max = max(self.observed_max, max_norm)
+            return None
+        self.breaches += 1
+        _breach_counter().labels(site=self.site).inc()
+        detail = (f"{what}: finite={finite} max_row_norm={max_norm:.4g} "
+                  f"bound={self.bound:.4g}")
+        logger.error("sentinel breach in %s — %s", self.site, detail)
+        return NumericalFault(self.site, detail)
+
+    def check_table(self, table, what: str) -> Optional[NumericalFault]:
+        """Whole-table variant (train sweeps, where every row moved)."""
+        if not guard_enabled():
+            return None
+        finite, max_norm = table_stats(table)
+        if finite and max_norm <= self.bound:
+            return None
+        self.breaches += 1
+        _breach_counter().labels(site=self.site).inc()
+        detail = (f"{what}: finite={finite} max_row_norm={max_norm:.4g} "
+                  f"bound={self.bound:.4g}")
+        logger.error("sentinel breach in %s — %s", self.site, detail)
+        return NumericalFault(self.site, detail)

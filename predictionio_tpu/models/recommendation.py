@@ -450,6 +450,13 @@ class ALSAlgorithm(P2LAlgorithm):
         new_als, stats = fold_in_coo(
             model.als, coo, tu[tu >= 0], ti[ti >= 0], cfg,
             resident_key=f"fold:{type(self).__name__}:{id(self)}")
+        if stats.degenerate:
+            # nothing solvable this tick (ISSUE 5 satellite: touched
+            # set emptied by filtering, or all-zero ratings): keep the
+            # deployed model OBJECT so the scheduler can tell a no-op
+            # from a publishable fold
+            return model, {"algorithm": type(self).__name__,
+                           "degenerate": True, "wallS": stats.wall_s}
         item_properties = model.item_properties
         if item_properties is not None and len(item_ix) > len(item_properties):
             # new items: carry fresh $set properties when the data source
@@ -468,6 +475,8 @@ class ALSAlgorithm(P2LAlgorithm):
             "userRows": stats.n_user_rows, "itemRows": stats.n_item_rows,
             "newUsers": stats.n_new_users, "newItems": stats.n_new_items,
             "wallS": stats.wall_s, "residentHit": stats.resident_hit,
+            "sentinelRollback": stats.sentinel_rollback,
+            "guardWallS": stats.guard_wall_s,
         }
         return new_model, report
 

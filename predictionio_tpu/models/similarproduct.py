@@ -426,6 +426,11 @@ class ALSAlgorithm(P2LAlgorithm):
         new_als, stats = fold_in_coo(
             als, coo, tu[tu >= 0], ti[ti >= 0], cfg,
             resident_key=f"fold:{type(self).__name__}:{id(self)}")
+        if stats.degenerate:
+            # nothing solvable (ISSUE 5 satellite): the deployed model
+            # object signals a clean no-op to the scheduler
+            return model, {"algorithm": type(self).__name__,
+                           "degenerate": True, "wallS": stats.wall_s}
         # an entity-filtered read carries only the touched items' $set
         # state: untouched items keep the deployed metadata (categories,
         # years) instead of being wiped by the partial bag
@@ -442,6 +447,8 @@ class ALSAlgorithm(P2LAlgorithm):
             "userRows": stats.n_user_rows, "itemRows": stats.n_item_rows,
             "newUsers": stats.n_new_users, "newItems": stats.n_new_items,
             "wallS": stats.wall_s, "residentHit": stats.resident_hit,
+            "sentinelRollback": stats.sentinel_rollback,
+            "guardWallS": stats.guard_wall_s,
         }
         return new_model, report
 

@@ -20,12 +20,15 @@ DrJAX arxiv 2403.07128 streaming-aggregation motivation):
 
 from predictionio_tpu.online.fold_in import (FoldInConfig, FoldInStats,
                                              fold_in_coo, solve_rows)
-from predictionio_tpu.online.registry import ModelVersionRegistry
+from predictionio_tpu.online.registry import (ModelVersionRegistry,
+                                              ROLLEDBACK_STATUS)
 from predictionio_tpu.online.scheduler import (DeltaTrainingScheduler,
-                                               EntityDelta, SchedulerConfig)
+                                               EntityDelta, SchedulerConfig,
+                                               attach_scheduler)
 
 __all__ = [
     "FoldInConfig", "FoldInStats", "fold_in_coo", "solve_rows",
-    "ModelVersionRegistry",
+    "ModelVersionRegistry", "ROLLEDBACK_STATUS",
     "DeltaTrainingScheduler", "EntityDelta", "SchedulerConfig",
+    "attach_scheduler",
 ]

@@ -49,6 +49,8 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from predictionio_tpu.guard.sentinels import (SweepSentinel, guard_enabled,
+                                              host_max_norm)
 from predictionio_tpu.ops.als import (ALSConfig, _gram, _gram_eig,
                                       ALSModel, _run_side, _upload_plan,
                                       default_compute_dtype,
@@ -82,6 +84,16 @@ class FoldInConfig:
     # zero rows for a brand-new item, so its solution is refined once the
     # item side has produced a real row.
     sweeps: int = 1
+    # numerical sentinels (ISSUE 5): after each side's solve, the
+    # touched rows are checked on-device for finiteness and norm
+    # explosion (> max(floor, ratio * incumbent max row norm)). A breach
+    # rolls back to the last clean sweep's checkpointed device tables,
+    # or — with no clean sweep — aborts the tick with NumericalFault so
+    # the scheduler's delta-restore machinery requeues the events.
+    # PIO_GUARD=off disables at runtime.
+    sentinel: bool = True
+    sentinel_norm_ratio: float = 1e3
+    sentinel_norm_floor: float = 1e4
 
 
 @dataclass
@@ -98,11 +110,29 @@ class FoldInStats:
     # True when the tick reused device-resident tables from the previous
     # tick (no full-table upload happened)
     resident_hit: bool = False
+    # ISSUE 5 guard outcomes: the tick was a clean no-op (nothing
+    # solvable — empty touched set or all-zero ratings), or a sentinel
+    # breach rolled the tables back to the last clean sweep
+    degenerate: bool = False
+    sentinel_rollback: bool = False
+    # wall seconds spent in sentinel work (baseline norm + per-side row
+    # checks, including the device sync each check forces — an upper
+    # bound on the tax). Feeds bench.py's guard_overhead_ms.
+    guard_wall_s: float = 0.0
 
 
 #: incremental Gram updates tolerated before a full recompute from the
 #: table — bounds accumulated float32 error across long tick chains
 _GRAM_REFRESH_EVERY = 64
+
+
+def _degenerate_counter():
+    from predictionio_tpu.obs import get_registry
+    return get_registry().counter(
+        "pio_guard_fold_degenerate_total",
+        "Fold ticks that no-opped cleanly (empty touched set after "
+        "filtering, or all-zero ratings) instead of building an empty "
+        "solve plan")
 
 
 def _als_config(cfg: FoldInConfig, rank: int, solver: str) -> ALSConfig:
@@ -332,6 +362,39 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         n_new_items=n_items - als.n_items)
     implicit = cfg.implicit_prefs
 
+    # chaos opt-in (ISSUE 5): a `fold.ratings:corrupt=P` PIO_FAULTS
+    # clause poisons this tick's data — the sentinel below must catch it
+    from predictionio_tpu.resilience.faults import maybe_corrupt_array
+    vals, vals_corrupted = maybe_corrupt_array("fold.ratings", coo.rating)
+    if vals_corrupted:
+        coo = RatingsCOO(coo.user_idx, coo.item_idx, vals,
+                         coo.n_users, coo.n_items)
+
+    # -- per-tick constants, hoisted out of the sweep loop ------------------
+    solver = resolve_solver(cfg.solver, mesh.n_devices)
+    als_cfg = _als_config(cfg, rank, solver)
+    degenerate = (
+        (tu.size == 0 and ti.size == 0)
+        or coo.rating.size == 0
+        # all-zero ratings: every solve would return x = 0 and ZERO the
+        # deployed rows (explicit: zero targets; implicit: preference 0)
+        or not np.any(coo.rating))
+    prep_u = prep_i = None
+    if not degenerate:
+        prep_u = _prep_side(coo.user_idx, coo.item_idx, coo.rating, tu,
+                            cfg, mesh)
+        prep_i = _prep_side(coo.item_idx, coo.user_idx, coo.rating, ti,
+                            cfg, mesh)
+        degenerate = prep_u is None and prep_i is None
+    if degenerate:
+        # no-op tick (ISSUE 5 satellite): nothing solvable — return the
+        # deployed model unchanged WITHOUT uploading tables or building
+        # an empty solve plan, and make it countable
+        _degenerate_counter().inc()
+        stats.degenerate = True
+        stats.wall_s = time.perf_counter() - t0
+        return als, stats
+
     # -- tables onto the device (once per tick, or not at all) --------------
     payload = device_cache.get_resident(
         resident_key, (als.user_factors, als.item_factors)) \
@@ -358,15 +421,35 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         gram_v = _gram(V_dev)
         incr = 0
 
-    # -- per-tick constants, hoisted out of the sweep loop ------------------
-    solver = resolve_solver(cfg.solver, mesh.n_devices)
-    als_cfg = _als_config(cfg, rank, solver)
-    prep_u = _prep_side(coo.user_idx, coo.item_idx, coo.rating, tu,
-                        cfg, mesh)
-    prep_i = _prep_side(coo.item_idx, coo.user_idx, coo.rating, ti,
-                        cfg, mesh)
+    # -- sentinel (ISSUE 5): touched rows checked after each side -----------
+    sentinel = None
+    if cfg.sentinel and not solver.startswith("diag_") \
+            and guard_enabled():
+        g0 = time.perf_counter()
+        # O(model) baseline scan only on the FIRST tick of a model
+        # lineage: every published fold carries its norm forward (the
+        # untouched rows' norms are covered by the previous baseline,
+        # the touched rows by the checks that passed), so steady-state
+        # ticks stay O(touched)
+        baseline = getattr(als, "_pio_guard_norm", None)
+        if baseline is None:
+            baseline = host_max_norm(als.user_factors, als.item_factors)
+        sentinel = SweepSentinel(
+            "fold_in", baseline,
+            norm_ratio=cfg.sentinel_norm_ratio,
+            norm_floor=cfg.sentinel_norm_floor)
+        stats.guard_wall_s += time.perf_counter() - g0
+
+    def _timed_check(table, idx, what):
+        g0 = time.perf_counter()
+        try:
+            return sentinel.check_rows(table, idx, what)
+        finally:
+            stats.guard_wall_s += time.perf_counter() - g0
 
     sweeps = max(1, int(cfg.sweeps))
+    ckpt = None        # device state after the last CLEAN sweep
+    fault = None
     for _ in range(sweeps):
         if prep_u is not None:
             U_dev, gram_u = _solve_side(
@@ -374,21 +457,52 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
                 gram_u if implicit else None, als_cfg, cfg, mesh, rank)
             stats.n_user_rows += len(prep_u.dst)
             stats.nnz_user_side += prep_u.nnz
+            if sentinel is not None:
+                fault = _timed_check(U_dev, prep_u.dst,
+                                     "user-side solve")
+                if fault is not None:
+                    break
         if prep_i is not None:
             V_dev, gram_v = _solve_side(
                 prep_i, U_dev, gram_u if implicit else None, V_dev,
                 gram_v if implicit else None, als_cfg, cfg, mesh, rank)
             stats.n_item_rows += len(prep_i.dst)
             stats.nnz_item_side += prep_i.nnz
+            if sentinel is not None:
+                fault = _timed_check(V_dev, prep_i.dst,
+                                     "item-side solve")
+                if fault is not None:
+                    break
         stats.sweeps += 1
+        # the scatter jits mint NEW arrays each sweep and nothing here
+        # is donated, so a checkpoint is just references — the last-good
+        # rollback costs no copy and no host round trip
+        ckpt = (U_dev, V_dev, gram_u, gram_v)
+    if fault is not None:
+        if ckpt is None:
+            # no clean sweep to fall back to: abort the tick; the
+            # scheduler restores the popped deltas (PR 1) and the
+            # supervision loop owns the retry/escalation policy
+            raise fault
+        U_dev, V_dev, gram_u, gram_v = ckpt
+        stats.sentinel_rollback = True
 
     U_host = np.asarray(host_fetch(U_dev), dtype=np.float32)
     V_host = np.asarray(host_fetch(V_dev), dtype=np.float32)
-    if resident_key:
+    # chaos opt-in: `fold.factors:corrupt=P` simulates a blow-up that
+    # slipped past the sweep sentinel — the pre-swap gates' job
+    U_host, cu = maybe_corrupt_array("fold.factors", U_host)
+    V_host, cv = maybe_corrupt_array("fold.factors", V_host)
+    if resident_key and not (cu or cv):
+        # (a corrupted tick must not key the clean device tables under
+        # the poisoned host arrays — skip residency so the next tick
+        # re-uploads from whatever model is actually deployed)
         device_cache.put_resident(
             resident_key, (U_host, V_host),
             {"U": U_dev, "V": V_dev, "GU": gram_u, "GV": gram_v,
              "mesh": mesh, "implicit": implicit, "incr": incr + 1})
     stats.wall_s = time.perf_counter() - t0
-    return ALSModel(user_factors=U_host, item_factors=V_host,
-                    rank=rank), stats
+    out = ALSModel(user_factors=U_host, item_factors=V_host, rank=rank)
+    if sentinel is not None and not (cu or cv):
+        out._pio_guard_norm = sentinel.observed_max
+    return out, stats

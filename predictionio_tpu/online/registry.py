@@ -18,7 +18,9 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import logging
-from typing import Any, List, Optional, Sequence
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence
 
 from predictionio_tpu.data.storage.base import EngineInstance, Model
 from predictionio_tpu.data.storage.registry import Storage
@@ -28,13 +30,31 @@ logger = logging.getLogger(__name__)
 # batch tag marking instances produced by the online path (vs `pio train`)
 ONLINE_BATCH_TAG = "online-fold-in"
 
+# status stamped on versions demoted by `pio rollback` — no longer
+# COMPLETED, so get_latest_completed (deploy, /reload) skips them
+ROLLEDBACK_STATUS = "ROLLEDBACK"
+
 
 class ModelVersionRegistry:
-    """Versioned model publish/list/rollback over the metadata DAOs."""
+    """Versioned model publish/list/rollback over the metadata DAOs.
 
-    def __init__(self, instances=None, models=None):
+    ``gatekeeper`` (guard/gates.QualityGatekeeper) is the publish path's
+    last line of defense: when set, ``publish`` refuses to persist
+    models whose factor tables fail the finiteness gate — a registry
+    used by several writers stays clean even if one of them skipped the
+    scheduler-side gates.
+
+    The last-known-good pin is a crash-atomic JSON sidecar under
+    ``<PIO_FS_BASEDIR>/guard/`` (the registry's metadata DAOs have no
+    KV surface): the canary watchdog pins each PROMOTED version, and
+    ``pio rollback`` / ``rollback_to`` demote everything newer back to
+    it after a bad deploy.
+    """
+
+    def __init__(self, instances=None, models=None, gatekeeper=None):
         self._instances = instances
         self._models = models
+        self.gatekeeper = gatekeeper
 
     @property
     def instances(self):
@@ -52,6 +72,10 @@ class ModelVersionRegistry:
         The models go through the engine's standard serialization pipeline
         (PersistentModel manifests included), so a folded mesh-sharded
         model checkpoints exactly like a trained one."""
+        if self.gatekeeper is not None:
+            # raises guard.gates.GateRejected BEFORE any row exists —
+            # a non-finite model never even gets an ABORTED instance
+            self.gatekeeper.check_publishable(models)
         now = _dt.datetime.now(_dt.timezone.utc)
         lineage = dict(meta or {})
         lineage["baseInstance"] = base_instance.id
@@ -96,3 +120,98 @@ class ModelVersionRegistry:
         return [i for i in self.versions(engine_id, engine_version,
                                          engine_variant)
                 if i.batch.startswith(ONLINE_BATCH_TAG)]
+
+    # -- last-known-good pin + rollback (ISSUE 5) ---------------------------
+    @staticmethod
+    def _pin_path(engine_id: str, engine_version: str,
+                  engine_variant: str) -> str:
+        from predictionio_tpu.data.storage.registry import base_dir
+        key = re.sub(r"[^A-Za-z0-9._-]", "_",
+                     f"{engine_id}_{engine_version}_{engine_variant}")
+        return os.path.join(base_dir(), "guard", f"last_good_{key}.json")
+
+    def pin_last_good(self, engine_id: str, engine_version: str,
+                      engine_variant: str, instance_id: str):
+        """Record ``instance_id`` as the last-known-good version for
+        this engine (crash-atomic: temp + os.replace). Called by the
+        canary watchdog on promotion and usable by operators directly."""
+        path = self._pin_path(engine_id, engine_version, engine_variant)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"instanceId": instance_id,
+                       "pinnedAt": _dt.datetime.now(
+                           _dt.timezone.utc).isoformat()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        logger.info("pinned last-known-good %s for %s %s %s",
+                    instance_id, engine_id, engine_version,
+                    engine_variant)
+
+    def last_good(self, engine_id: str, engine_version: str,
+                  engine_variant: str) -> Optional[str]:
+        try:
+            with open(self._pin_path(engine_id, engine_version,
+                                     engine_variant)) as f:
+                return json.load(f).get("instanceId")
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def demote_version(self, instance_id: str) -> bool:
+        """Mark one COMPLETED version ROLLEDBACK (the canary watchdog's
+        verdict made durable: a restart or /reload must not resolve the
+        rejected version via get_latest_completed). Returns False when
+        the instance is unknown or not COMPLETED."""
+        inst = self.instances.get(instance_id)
+        if inst is None or inst.status != "COMPLETED":
+            return False
+        self.instances.update(inst.with_(
+            status=ROLLEDBACK_STATUS,
+            end_time=_dt.datetime.now(_dt.timezone.utc)))
+        logger.warning("demoted version %s to %s", instance_id,
+                       ROLLEDBACK_STATUS)
+        return True
+
+    def rollback_to(self, engine_id: str, engine_version: str,
+                    engine_variant: str,
+                    target_id: Optional[str] = None) -> Dict[str, Any]:
+        """Demote every COMPLETED version newer than the target (the
+        last-good pin by default; the previous COMPLETED version when
+        no pin exists) to ``ROLLEDBACK`` so ``get_latest_completed`` —
+        deploy, ``/reload`` — resolves the target again. Durable: a
+        restarted server loads the rolled-back-to version. Returns
+        ``{"target", "demoted"}``."""
+        completed = self.versions(engine_id, engine_version,
+                                  engine_variant)
+        if not completed:
+            raise ValueError(
+                f"no COMPLETED versions for engine {engine_id} "
+                f"{engine_version} {engine_variant}")
+        target = target_id or self.last_good(engine_id, engine_version,
+                                             engine_variant)
+        if target is None:
+            if len(completed) < 2:
+                raise ValueError(
+                    "no last-good pin and only one COMPLETED version — "
+                    "nothing to roll back to")
+            target = completed[1].id   # newest-first: the previous one
+        ids = [i.id for i in completed]
+        if target not in ids:
+            raise ValueError(
+                f"rollback target {target} is not a COMPLETED version "
+                f"of engine {engine_id} {engine_version} "
+                f"{engine_variant}")
+        demoted = []
+        now = _dt.datetime.now(_dt.timezone.utc)
+        for inst in completed:
+            if inst.id == target:
+                break
+            self.instances.update(inst.with_(status=ROLLEDBACK_STATUS,
+                                             end_time=now))
+            demoted.append(inst.id)
+        self.pin_last_good(engine_id, engine_version, engine_variant,
+                           target)
+        logger.warning("rolled back to %s (demoted: %s)", target,
+                       ", ".join(demoted) or "nothing")
+        return {"target": target, "demoted": demoted}

@@ -58,6 +58,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 from predictionio_tpu.data.aggregator import merge_aggregations
 from predictionio_tpu.data.event import Event, utcnow
 from predictionio_tpu.data.store import LEventStore
+from predictionio_tpu.guard.gates import (GateConfig, GateRejected,
+                                          QualityGatekeeper)
 from predictionio_tpu.obs import TRACER, get_registry, jaxmon
 
 logger = logging.getLogger(__name__)
@@ -122,6 +124,13 @@ class SchedulerConfig:
     # half-open probe sees it recover
     tail_breaker_failures: int = 3
     tail_breaker_reset_s: float = 10.0
+    # pre-swap quality gates (ISSUE 5, guard/gates.py): every fold's
+    # candidate models must pass finiteness, norm/score-drift and
+    # golden-query gates against the LIVE models before a publish is
+    # attempted; a rejection restores the deltas and counts toward the
+    # retrain escalation (the same data will fold the same way again)
+    gates: bool = True
+    gate_config: GateConfig = GateConfig()
 
 
 class DeltaTrainingScheduler:
@@ -193,6 +202,13 @@ class DeltaTrainingScheduler:
             "Training-data rows read by fold ticks, by read path "
             "(entity_filtered = O(touched) pushdown, full_scan = the "
             "whole corpus)", labelnames=("path",))
+        self._c_gate_rejects = reg.counter(
+            "pio_guard_gate_rejects_total",
+            "Fold publishes refused by the pre-swap quality gates "
+            "(the live model kept serving)")
+        self.gatekeeper = (QualityGatekeeper(config.gate_config, reg)
+                           if config.gates else None)
+        self.gate_rejects = 0
         # breaker over the event-store tail read (ISSUE 3)
         from predictionio_tpu.resilience import CircuitBreaker
         self._tail_breaker = CircuitBreaker(
@@ -493,6 +509,50 @@ class DeltaTrainingScheduler:
             logger.warning("no algorithm supports fold_in; deltas dropped")
             self.last_report = report
             return report
+        if all(nm is old for nm, old in zip(new_models, self.models)):
+            # degenerate tick (ISSUE 5 satellite): every online
+            # algorithm no-opped (empty touched set after filtering,
+            # all-zero ratings) — nothing to gate or publish, and the
+            # consumed events are spent (refolding them would no-op
+            # identically, so they are NOT restored)
+            report["degenerate"] = True
+            TRACER.annotate(degenerate=True)
+            logger.info("fold tick was a clean no-op (%d event(s) "
+                        "contributed nothing solvable)", n_events)
+            self.last_report = report
+            return report
+        # pre-swap quality gates (ISSUE 5): the candidate set must pass
+        # against the LIVE models before any publish is attempted
+        guard_wall_s = sum(r.get("guardWallS") or 0.0 for r in reports)
+        if self.gatekeeper is not None:
+            g0 = _time.perf_counter()
+            with TRACER.span("guard_gates") as sp:
+                gate_report = self.gatekeeper.evaluate(
+                    new_models, self.models, self.algorithms)
+                if sp is not None:
+                    sp.attrs["passed"] = gate_report["passed"]
+                    sp.attrs["verdicts"] = {
+                        g["gate"]: g["verdict"]
+                        for g in gate_report["gates"]}
+            guard_wall_s += _time.perf_counter() - g0
+            report["gateReport"] = gate_report
+        # the robustness tax, first-class: sentinel + gate wall per tick
+        # (bench.py banks it as guard_overhead_ms)
+        report["guardOverheadMs"] = round(guard_wall_s * 1000, 3)
+        if self.gatekeeper is not None:
+            TRACER.annotate(gatesPassed=gate_report["passed"])
+            if not gate_report["passed"]:
+                # the events are restored for the record, but the same
+                # data folds the same way — the supervision loop's
+                # escalation to a full retrain is the real exit
+                self._restore_deltas(user_deltas, item_deltas, n_events,
+                                     trace_ids)
+                self._c_gate_rejects.inc()
+                self.gate_rejects += 1
+                if self.server is not None:
+                    self.server.note_publish_failure()
+                self.last_report = report
+                raise GateRejected(gate_report)
         # drift gate: anchor = the first post-fold loss after (re)deploy
         losses = [r["loss"] for r in reports if r.get("loss") is not None]
         loss = max(losses) if losses else None
@@ -706,6 +766,43 @@ class DeltaTrainingScheduler:
             self._thread.join(timeout=10)
             self._thread = None
 
+    # -- canary feedback (ISSUE 5) ------------------------------------------
+    def note_canary_decision(self, decision: dict):
+        """The attached server's canary watchdog decided. On promote,
+        pin the version as last-known-good in the registry (the durable
+        rollback target). On rollback, the fold lineage has produced a
+        bad-serving model the gates could not see: re-anchor on what is
+        actually serving and escalate to a full retrain."""
+        if decision.get("decision") == "promote":
+            version = decision.get("candidateVersion")
+            if self.registry is not None and version:
+                try:
+                    inst = self.instance
+                    self.registry.pin_last_good(
+                        inst.engine_id, inst.engine_version,
+                        inst.engine_variant, version)
+                except Exception:
+                    logger.exception("last-good pin failed")
+            return
+        if decision.get("decision") == "rollback":
+            if self.server is not None:
+                self.models = list(self.server.models)
+            self.retrain_requested = True
+            version = decision.get("candidateVersion")
+            if self.registry is not None and version:
+                # make the verdict durable: the rejected version must
+                # not stay newest-COMPLETED, or the next /reload or
+                # restart would deploy it to 100% of traffic
+                try:
+                    self.registry.demote_version(version)
+                except Exception:
+                    logger.exception("demoting %s failed", version)
+            logger.error(
+                "canary rollback of %s (%s): scheduler re-anchored on "
+                "the serving models and escalated to a full retrain",
+                decision.get("candidateVersion"),
+                decision.get("reason"))
+
     # -- introspection ------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
@@ -721,6 +818,7 @@ class DeltaTrainingScheduler:
             "consecutiveFailures": self.consecutive_failures,
             "lastError": self.last_error,
             "tailBreaker": self._tail_breaker.state,
+            "gateRejects": self.gate_rejects,
         }
 
 
@@ -736,4 +834,8 @@ def attach_scheduler(server, config: SchedulerConfig,
         instance=server.engine_instance, algorithms=server.algorithms,
         models=server.models, config=config, server=server,
         registry=registry, **kw)
+    # canary feedback loop (ISSUE 5): watchdog promotions pin the
+    # last-known-good version; rollbacks re-anchor the fold lineage and
+    # escalate to a full retrain
+    server.on_canary_decision = sched.note_canary_decision
     return sched

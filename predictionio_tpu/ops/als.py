@@ -111,6 +111,19 @@ class ALSConfig:
     # Trace both half-sweeps (and the implicit Grams) into ONE program per
     # iteration, letting XLA overlap the item-side gather DMAs with the
     # tail of the user-side solves and dropping a dispatch boundary.
+    sentinel: bool = True
+    # Numerical sentinel (ISSUE 5, guard/sentinels.py): after every
+    # iteration the factor tables are checked on-device for finiteness
+    # and norm explosion (one tiny reduction + scalar fetch per table),
+    # and the last clean iteration is checkpointed as an HBM copy. A
+    # breach returns the last-good model instead of NaN factors (or
+    # raises NumericalFault when no iteration completed cleanly).
+    # PIO_GUARD=off disables at runtime; set False to shave the
+    # per-iteration copy + sync off latency-critical benches.
+    sentinel_norm_cap: float = 1e4
+    # Absolute max-row-norm bound for the train sentinel (there is no
+    # incumbent model to scale from; init rows are O(1), converged rows
+    # O(sqrt(max rating)) — 1e4 only trips on genuine blow-ups).
 
     def __post_init__(self):
         if self.dual_iters_cap is not None and self.dual_iters_cap < 1:
@@ -616,6 +629,39 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
         telemetry["upload_s"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
     gram_of = _gram_eig if cfg.dual_solve == "auto" else _gram
+    # train-sweep sentinel (ISSUE 5): per-iteration finite/norm check +
+    # a checkpointed last-good iteration (HBM copies, never host fetch)
+    sentinel = None
+    last_good = None
+    # diag_* pseudo-solvers are perf diagnostics with wrong math by
+    # design — their outputs are not factor tables worth guarding
+    if cfg.sentinel and not cfg.solver.startswith("diag_"):
+        from predictionio_tpu.guard.sentinels import (SweepSentinel,
+                                                      device_copy,
+                                                      guard_enabled)
+        if guard_enabled():
+            sentinel = SweepSentinel("train", 0.0,
+                                     norm_floor=cfg.sentinel_norm_cap)
+
+    def _checked(it: int) -> bool:
+        """True to continue; False when a breach rolled back (training
+        stops at the last clean iteration). Raises on iteration 0."""
+        nonlocal U, V, last_good
+        if sentinel is None:
+            return True
+        fault = (sentinel.check_table(U, f"iteration {it} user table")
+                 or sentinel.check_table(V, f"iteration {it} item table"))
+        if fault is None:
+            # copies survive the next iteration's donated sweep
+            last_good = (device_copy(U), device_copy(V))
+            return True
+        if last_good is None:
+            raise fault
+        logger.error("ALS %s — rolling back to iteration %d and "
+                     "stopping early", fault, it - 1)
+        U, V = last_good
+        return False
+
     if cfg.fuse_iteration:
         for it in range(cfg.iterations):
             U, V = _solve_iteration(
@@ -626,6 +672,8 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
                 dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters,
                 dual_iters_cap=cfg.dual_iters_cap,
                 n_users=ratings.n_users, n_items=ratings.n_items)
+            if not _checked(it):
+                break
     else:
         for it in range(cfg.iterations):
             gram_v = gram_of(V[:ratings.n_items]) if cfg.implicit_prefs \
@@ -636,6 +684,8 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
                 else None
             V = _run_side(item_batches, V, U, cfg, gram_u, lam_dev,
                           alpha_dev)
+            if not _checked(it):
+                break
     if telemetry is not None:
         # hard sync again: the loop above only enqueues device work
         float(np.asarray(jax.device_get(V[:1, :1]))[0, 0])

@@ -21,4 +21,5 @@ from predictionio_tpu.resilience.spill import (  # noqa: F401
     SpillReplayer, SpillWAL)
 from predictionio_tpu.resilience.faults import (  # noqa: F401
     FaultInjector, FaultSpec, FaultyEvents, InjectedFault,
-    injector_from_env, maybe_wrap_events, reset_env_injector)
+    injector_from_env, maybe_corrupt_array, maybe_wrap_events,
+    reset_env_injector)

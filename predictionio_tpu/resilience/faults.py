@@ -22,6 +22,11 @@ consult ``FaultInjector.before(target)`` at their entry point. Keys:
     partition=P    raise ConnectionError (network partition) with prob P
     latency_ms=D   inject D ms of latency ...
     latency_rate=P ... with probability P (default 1.0 when latency set)
+    corrupt=P      (alias: nan=P) NaN-corrupt the data at targets that
+                   opt in via ``maybe_corrupt_array`` (``fold.ratings``,
+                   ``fold.factors``) with probability P — the model-
+                   fault analog of error= (ISSUE 5: prove the guard
+                   layer keeps poisoned models off live traffic)
     seed=N         RNG seed (whole spec; first clause naming it wins)
 
 ``FaultyEvents`` wraps any ``Events`` DAO (write ops consult
@@ -68,6 +73,7 @@ class FaultRule:
     partition: Optional[float] = None    # P(raise ConnectionError)
     latency_ms: Optional[float] = None
     latency_rate: Optional[float] = None  # P(apply latency); default 1
+    corrupt: Optional[float] = None      # P(NaN-corrupt opted-in data)
 
     def merged_over(self, other: "FaultRule") -> "FaultRule":
         """This rule layered over a less specific one: specific wins
@@ -76,9 +82,9 @@ class FaultRule:
             s if s is not None else o
             for s, o in zip(
                 (self.error, self.partition, self.latency_ms,
-                 self.latency_rate),
+                 self.latency_rate, self.corrupt),
                 (other.error, other.partition, other.latency_ms,
-                 other.latency_rate))))
+                 other.latency_rate, other.corrupt))))
 
 
 @dataclass(frozen=True)
@@ -118,11 +124,13 @@ class FaultSpec:
                     if seed is None:
                         seed = int(val)
                     continue
+                if k == "nan":   # operator-friendly alias
+                    k = "corrupt"
                 if k not in ("error", "partition", "latency_ms",
-                             "latency_rate"):
+                             "latency_rate", "corrupt"):
                     raise ValueError(f"unknown fault key {k!r}")
                 kw[k] = val
-            for p in ("error", "partition", "latency_rate"):
+            for p in ("error", "partition", "latency_rate", "corrupt"):
                 if p in kw and not 0.0 <= kw[p] <= 1.0:
                     raise ValueError(f"{p} must be in [0, 1]")
             rules[target] = FaultRule(**kw)
@@ -192,6 +200,27 @@ class FaultInjector:
         if error > 0 and r_err < error:
             self._c_injected.labels(target=target, kind="error").inc()
             raise InjectedFault(f"injected fault on {target}")
+
+    def corrupt_array(self, target: str, arr):
+        """Maybe NaN-corrupt a float numpy array at an opted-in site
+        (``fold.ratings``, ``fold.factors``). Returns
+        ``(array, injected)`` — the original object untouched when the
+        seeded decision says no. The whole array goes NaN, which is the
+        realistic shape of an ALS blow-up: one non-finite row poisons
+        the shared Gram and the next sweep spreads it to every solve."""
+        import numpy as _np
+        rule = self.spec.rule_for(target)
+        p = (rule.corrupt or 0.0) if rule is not None else 0.0
+        if p <= 0.0:
+            return arr, False
+        with self._lock:
+            r = self.rng.random()
+        if r >= p:
+            return arr, False
+        self._c_injected.labels(target=target, kind="corrupt").inc()
+        logger.warning("chaos: NaN-corrupting %s", target)
+        return _np.full_like(_np.asarray(arr, dtype=_np.float32),
+                             _np.nan), True
 
     def wrap_callable(self, target: str, fn: Callable) -> Callable:
         """Chaos-wrap any hop (an HTTP request function, a publish):
@@ -298,6 +327,16 @@ def reset_env_injector():
     global _ENV_INJECTOR
     with _ENV_LOCK:
         _ENV_INJECTOR = None
+
+
+def maybe_corrupt_array(target: str, arr):
+    """Module-level corruption hook for the fold path: consults the
+    process-wide ``PIO_FAULTS`` injector; identity when chaos is off or
+    the target has no ``corrupt=`` clause. Returns ``(array, bool)``."""
+    inj = injector_from_env()
+    if inj is None:
+        return arr, False
+    return inj.corrupt_array(target, arr)
 
 
 def maybe_wrap_events(events: base.Events) -> base.Events:

@@ -217,6 +217,146 @@ class SpillWAL:
             self._f.close()
 
 
+# -- operator inspection (ISSUE 5 satellite: `pio spill`) -------------------
+#
+# Read-only views over a WAL another process may be writing: no handle
+# is kept, nothing is truncated (a torn tail is REPORTED, not repaired —
+# repair belongs to the owning server's SpillWAL open).
+
+def _iter_frames(path: str):
+    """Read-only frame walk: yield ``(end_offset, payload_bytes)`` for
+    every whole CRC-valid record, stopping at a torn tail. The one
+    framing parser behind every CLI-side view (the owning server's
+    SpillWAL keeps its own handle-and-lock-based readers)."""
+    try:
+        f = open(path, "rb")
+    except FileNotFoundError:
+        return
+    with f:
+        pos = 0
+        while True:
+            header = f.read(_HEADER.size)
+            if len(header) < _HEADER.size:
+                return
+            length, crc = _HEADER.unpack(header)
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                return
+            pos += _HEADER.size + length
+            yield pos, payload
+
+
+def _read_cursor_file(path: str) -> int:
+    try:
+        with open(path + ".cursor") as f:
+            return int(f.read().strip() or 0)
+    except (FileNotFoundError, ValueError):
+        return 0
+
+
+def scan_wal(path: str) -> dict:
+    """Frame-walk a WAL file without mutating it. Returns totals plus
+    the quarantine sidecar's record count."""
+    out = {"path": path, "exists": os.path.exists(path),
+           "totalRecords": 0, "pendingRecords": 0, "pendingBytes": 0,
+           "cursor": _read_cursor_file(path), "validBytes": 0,
+           "tornBytes": 0, "quarantined": 0}
+    if out["exists"]:
+        valid = 0
+        for end, _payload in _iter_frames(path):
+            valid = end
+            out["totalRecords"] += 1
+            if end > out["cursor"]:
+                out["pendingRecords"] += 1
+        out["validBytes"] = valid
+        out["tornBytes"] = max(os.path.getsize(path) - valid, 0)
+        out["pendingBytes"] = max(valid - min(out["cursor"], valid), 0)
+    qpath = path + ".quarantine"
+    if os.path.exists(qpath):
+        with open(qpath) as f:
+            out["quarantined"] = sum(1 for line in f if line.strip())
+    return out
+
+
+def iter_pending(path: str, limit: Optional[int] = None):
+    """Yield the un-replayed records' envelopes
+    (``{"appId", "channelId", "event"}`` dicts) read-only, oldest
+    first."""
+    n = 0
+    cursor = _read_cursor_file(path)
+    for end, payload in _iter_frames(path):
+        if end <= cursor:
+            continue
+        yield json.loads(payload.decode("utf-8"))
+        n += 1
+        if limit is not None and n >= limit:
+            return
+
+
+def read_quarantine(path: str) -> list:
+    """The quarantine sidecar's records (``path`` is the WAL path)."""
+    qpath = path + ".quarantine"
+    out = []
+    try:
+        with open(qpath) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def requeue_quarantined(path: str, events=None) -> Tuple[int, int]:
+    """Retry every quarantined record against the primary event store
+    directly (the operator fixed whatever made the healthy store reject
+    it — a schema change rolled back, a property whitelist updated).
+
+    Deliberately NOT a WAL re-append: the owning server's ``SpillWAL``
+    caches its size/cursor, so a second writer's records would be
+    invisible to the live replayer — and a drain that empties the
+    server's view truncates the file, silently deleting them. Direct
+    inserts (id-deduped, the replayer's own idempotency rule) have no
+    multi-writer hazard. Records the store still rejects stay
+    quarantined. Returns ``(inserted_or_deduped, still_quarantined)``.
+    """
+    records = read_quarantine(path)
+    if not records:
+        return 0, 0
+    if events is None:
+        from predictionio_tpu.data.storage.registry import Storage
+        events = Storage.get_events()
+    kept = []
+    done = 0
+    for rec in records:
+        event = Event.from_dict(rec["event"])
+        app_id, channel_id = rec["appId"], rec.get("channelId")
+        try:
+            existing = (events.get(event.event_id, app_id, channel_id)
+                        if event.event_id else None)
+            if existing is None:
+                events.insert(event, app_id, channel_id)
+            done += 1
+        except Exception as e:
+            kept.append(dict(rec, error=str(e)))
+            logger.warning("requeue: store still rejects event %s: %s",
+                           event.event_id, e)
+    qpath = path + ".quarantine"
+    tmp = f"{qpath}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for rec in kept:
+            f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, qpath)
+    if not kept:
+        os.remove(qpath)
+    logger.info("requeue: %d record(s) into the store, %d still "
+                "quarantined (%s)", done, len(kept), path)
+    return done, len(kept)
+
+
 class SpillReplayer:
     """Background drain of a ``SpillWAL`` into the primary event store.
 

@@ -78,6 +78,19 @@ class ServerConfig:
     # (serving/mesh_serving.py MeshServingUnavailable) instead of
     # queueing every subsequent query forever
     mesh_broadcast_timeout_s: float = 30.0
+    # guarded deploys (ISSUE 5, guard/canary.py): when canary_fraction
+    # > 0, swap_models stages the new version as a CANDIDATE serving
+    # only that traffic share (responses tagged X-PIO-Canary); a
+    # watchdog compares error-rate / NaN-score / latency against the
+    # incumbent and either promotes (after a clean canary_window_s) or
+    # rolls back to the incumbent automatically. 0 keeps the PR 1
+    # immediate-swap behavior.
+    canary_fraction: float = 0.0
+    canary_window_s: float = 30.0
+    canary_min_requests: int = 20
+    canary_max_error_ratio: float = 2.0
+    canary_max_latency_ratio: float = 3.0
+    canary_nan_tolerance: int = 0
 
 
 class EngineServer:
@@ -149,6 +162,23 @@ class EngineServer:
             "pio_engine_query_seconds",
             "Per-query serving latency (batched queries observe the "
             "window's wall time each)")
+        # guarded deploys (ISSUE 5): canary controller + rollback
+        # anchors. last_good_version tracks the newest version this
+        # server trusts (the loaded instance, then every promotion);
+        # on_canary_decision lets the attached scheduler pin the
+        # registry and escalate on rollback.
+        from predictionio_tpu.guard.canary import (CanaryConfig,
+                                                   CanaryController)
+        self.canary = CanaryController(CanaryConfig(
+            fraction=config.canary_fraction,
+            window_s=config.canary_window_s,
+            min_requests=config.canary_min_requests,
+            max_error_ratio=config.canary_max_error_ratio,
+            max_latency_ratio=config.canary_max_latency_ratio,
+            nan_tolerance=config.canary_nan_tolerance),
+            registry=self.metrics)
+        self.last_good_version: Optional[str] = None
+        self.on_canary_decision = None
         self._register_metrics()
         self.batcher = None
         if config.micro_batch > 1:
@@ -196,6 +226,10 @@ class EngineServer:
                        "Fold-in publish/hot-swap failures reported by "
                        "the scheduler",
                        lambda: self.publish_failures)
+        m.gauge_func("pio_guard_canary_state",
+                     "1 while a canary candidate version serves a "
+                     "fraction of this server's traffic",
+                     lambda: int(self.canary.active))
         if self.coordinator is not None:
             m.gauge_func("pio_engine_mesh_processes",
                          "Processes in the serving mesh",
@@ -266,6 +300,12 @@ class EngineServer:
             self.models = result.models
             self.serving = self.engine.make_serving(self.engine_params)
             self.model_version = instance.id
+            # an operator-initiated (re)load is a trusted deploy: it is
+            # the rollback anchor, and it supersedes any undecided
+            # canary (whose candidate referenced the old pipeline)
+            self.last_good_version = instance.id
+            self.canary.abandon("full (re)load of instance "
+                                + instance.id)
             self._last_swap_wall = time.time()
             self.publish_degraded = False
             if was_loaded:
@@ -287,6 +327,18 @@ class EngineServer:
             raise ValueError(
                 f"swap_models got {len(models)} models for "
                 f"{len(self.algorithms)} algorithms")
+        # guarded deploys (ISSUE 5): with canarying on, the new version
+        # becomes a CANDIDATE serving canary_fraction of traffic; the
+        # watchdog promotes or rolls back — the incumbent keeps
+        # answering the rest and stays fully live either way. Not under
+        # a multi-process mesh: per-request model choice on the primary
+        # only would run mismatched SPMD programs across processes
+        # (the same reason /reload is rejected there).
+        single_process = (self.coordinator is None
+                          or not self.coordinator.multi_process)
+        if single_process and self.canary.stage(models, version,
+                                                int(fold_in_events)):
+            return
         with self._lock:
             self.models = models
             self.swap_count += 1
@@ -313,6 +365,79 @@ class EngineServer:
     def model_staleness_s(self) -> float:
         return max(time.time() - self._last_swap_wall, 0.0)
 
+    # -- canary plumbing (ISSUE 5) ------------------------------------------
+    def _canary_route(self):
+        """(models_override, version, arm) for this request; the plain
+        (None, None, incumbent) when canarying is off or idle — the
+        default query path pays one config read."""
+        from predictionio_tpu.guard.canary import CANDIDATE, INCUMBENT
+        if not self.canary.enabled:
+            return None, None, INCUMBENT
+        routed = self.canary.route()
+        if routed is None:
+            return None, None, INCUMBENT
+        models, version = routed
+        return models, version, CANDIDATE
+
+    def _canary_observe(self, arm, pred_dicts=None, error: bool = False,
+                        latency_s: Optional[float] = None, n: int = 1):
+        """Record per-arm outcomes and run the watchdog decision."""
+        if not self.canary.enabled:
+            return
+        from predictionio_tpu.guard.canary import count_nonfinite
+        nonfinite = 0
+        if pred_dicts:
+            nonfinite = sum(count_nonfinite(d) for d in pred_dicts)
+        self.canary.record(arm, error=error, nonfinite=nonfinite,
+                           latency_s=latency_s, n=n)
+        self._apply_canary_decision()
+
+    def _apply_canary_decision(self):
+        decision = self.canary.take_decision()
+        if decision is None:
+            return
+        if decision["decision"] == "promote":
+            with self._lock:
+                self.models = decision["models"]
+                self.swap_count += 1
+                self.fold_in_count += 1
+                self.fold_in_events += decision["foldInEvents"]
+                if decision["candidateVersion"]:
+                    self.model_version = decision["candidateVersion"]
+                self.last_good_version = self.model_version
+                self._last_swap_wall = time.time()
+                self.publish_degraded = False
+            logger.info("Hot-swapped models after clean canary "
+                        "(swap #%d, version %s)", self.swap_count,
+                        decision["candidateVersion"] or "<in-process>")
+        hook = self.on_canary_decision
+        if hook is not None:
+            try:
+                hook({k: v for k, v in decision.items()
+                      if k != "models"})
+            except Exception:
+                logger.exception("on_canary_decision hook failed")
+        elif decision["candidateVersion"] \
+                and getattr(self.engine_instance, "engine_id", None):
+            # standalone deploy (no attached scheduler to delegate to):
+            # make the verdict durable directly — pin a promotion as
+            # last-known-good, demote a rolled-back version so the next
+            # /reload or restart cannot resolve it
+            try:
+                from predictionio_tpu.online.registry import \
+                    ModelVersionRegistry
+                inst = self.engine_instance
+                if decision["decision"] == "promote":
+                    ModelVersionRegistry().pin_last_good(
+                        inst.engine_id, inst.engine_version,
+                        inst.engine_variant,
+                        decision["candidateVersion"])
+                else:
+                    ModelVersionRegistry().demote_version(
+                        decision["candidateVersion"])
+            except Exception:
+                logger.exception("durable canary verdict failed")
+
     # -- query path (ServerActor.myRoute /queries.json, :490-641) ----------
     def handle_query(self, query_dict: dict) -> dict:
         t0 = time.perf_counter()
@@ -320,23 +445,32 @@ class EngineServer:
             algorithms = self.algorithms
             models = self.models
             serving = self.serving
+        canary_models, canary_version, arm = self._canary_route()
+        if canary_models is not None:
+            models = canary_models
         if not algorithms:
             raise RuntimeError("no engine loaded")
         # decode via the first algorithm's query class (JsonExtractor :499)
         qc = algorithms[0].query_class
         query = qc.from_dict(query_dict) if qc is not None else query_dict
-        with self._spmd_guard(query_dict):
-            supplemented = serving.supplement(query)
-            tp = time.perf_counter()
-            with TRACER.span("predict", algorithms=len(algorithms)):
-                predictions = [algo.predict(model, supplemented)
-                               for algo, model in zip(algorithms, models)]
-            predict_dt = time.perf_counter() - tp
-        prediction = serving.serve(query, predictions)
-        pred_dict = (prediction.to_dict()
-                     if hasattr(prediction, "to_dict") else prediction)
-        if not isinstance(pred_dict, dict):
-            pred_dict = {"result": pred_dict}
+        try:
+            with self._spmd_guard(query_dict):
+                supplemented = serving.supplement(query)
+                tp = time.perf_counter()
+                with TRACER.span("predict", algorithms=len(algorithms)):
+                    predictions = [algo.predict(model, supplemented)
+                                   for algo, model in zip(algorithms,
+                                                          models)]
+                predict_dt = time.perf_counter() - tp
+            prediction = serving.serve(query, predictions)
+            pred_dict = (prediction.to_dict()
+                         if hasattr(prediction, "to_dict") else prediction)
+            if not isinstance(pred_dict, dict):
+                pred_dict = {"result": pred_dict}
+        except Exception:
+            self._canary_observe(arm, error=True,
+                                 latency_s=time.perf_counter() - t0)
+            raise
         if self.config.feedback:
             pr_id = query_dict.get("prId") or self.engine_instance.id
             pred_dict = dict(pred_dict, prId=pr_id)
@@ -351,6 +485,13 @@ class EngineServer:
             self.predict_seconds += predict_dt
             self._lat_ring.append(dt)
         self._h_query.observe(dt)
+        self._canary_observe(arm, pred_dicts=(pred_dict,), latency_s=dt)
+        if canary_models is not None:
+            # response tagging: the HTTP layer turns this into the
+            # X-PIO-Canary header so clients/tests can tell which arm
+            # answered
+            pred_dict = dict(pred_dict,
+                             _pioCanary=canary_version or "candidate")
         return pred_dict
 
     def _spmd_guard(self, payload):
@@ -391,39 +532,51 @@ class EngineServer:
 
     def handle_query_batch(self, query_dicts: List[dict]) -> List[dict]:
         """Batched query path: one Algorithm.batch_predict device call for
-        all queries in the window (serving/batcher.py)."""
+        all queries in the window (serving/batcher.py). Canary routing is
+        per WINDOW — a coalesced batch runs against ONE model set, so the
+        traffic fraction is realized across windows."""
         t0 = time.perf_counter()
         with self._lock:
             algorithms = self.algorithms
             models = self.models
             serving = self.serving
+        canary_models, canary_version, arm = self._canary_route()
+        if canary_models is not None:
+            models = canary_models
         if not algorithms:
             raise RuntimeError("no engine loaded")
         qc = algorithms[0].query_class
         queries = [qc.from_dict(d) if qc is not None else d
                    for d in query_dicts]
-        with self._spmd_guard(query_dicts):
-            indexed = [(i, serving.supplement(q))
-                       for i, q in enumerate(queries)]
-            tp = time.perf_counter()
-            with TRACER.span("predict", batch=len(queries),
-                             algorithms=len(algorithms)):
-                per_algo = [dict(algo.batch_predict(model, indexed))
-                            for algo, model in zip(algorithms, models)]
-            predict_dt = time.perf_counter() - tp
-        out = []
-        for i, (q, d) in enumerate(zip(queries, query_dicts)):
-            prediction = serving.serve(q, [pa[i] for pa in per_algo])
-            pred_dict = (prediction.to_dict()
-                         if hasattr(prediction, "to_dict") else prediction)
-            if not isinstance(pred_dict, dict):
-                pred_dict = {"result": pred_dict}
-            if self.config.feedback:
-                pr_id = d.get("prId") or self.engine_instance.id
-                pred_dict = dict(pred_dict, prId=pr_id)
-                self._send_feedback(d, pred_dict, pr_id)
-            out.append(self.plugin_context.apply_output(
-                self.engine_instance, d, pred_dict))
+        try:
+            with self._spmd_guard(query_dicts):
+                indexed = [(i, serving.supplement(q))
+                           for i, q in enumerate(queries)]
+                tp = time.perf_counter()
+                with TRACER.span("predict", batch=len(queries),
+                                 algorithms=len(algorithms)):
+                    per_algo = [dict(algo.batch_predict(model, indexed))
+                                for algo, model in zip(algorithms, models)]
+                predict_dt = time.perf_counter() - tp
+            out = []
+            for i, (q, d) in enumerate(zip(queries, query_dicts)):
+                prediction = serving.serve(q, [pa[i] for pa in per_algo])
+                pred_dict = (prediction.to_dict()
+                             if hasattr(prediction, "to_dict")
+                             else prediction)
+                if not isinstance(pred_dict, dict):
+                    pred_dict = {"result": pred_dict}
+                if self.config.feedback:
+                    pr_id = d.get("prId") or self.engine_instance.id
+                    pred_dict = dict(pred_dict, prId=pr_id)
+                    self._send_feedback(d, pred_dict, pr_id)
+                out.append(self.plugin_context.apply_output(
+                    self.engine_instance, d, pred_dict))
+        except Exception:
+            self._canary_observe(arm, error=True,
+                                 latency_s=time.perf_counter() - t0,
+                                 n=len(queries))
+            raise
         dt = time.perf_counter() - t0
         with self._lock:
             self.request_count += len(queries)
@@ -435,6 +588,11 @@ class EngineServer:
             self._lat_ring.extend([dt] * len(queries))
         for _ in queries:
             self._h_query.observe(dt)
+        self._canary_observe(arm, pred_dicts=out, latency_s=dt,
+                             n=len(queries))
+        if canary_models is not None:
+            out = [dict(d, _pioCanary=canary_version or "candidate")
+                   for d in out]
         return out
 
     # -- feedback loop (:526-596) ------------------------------------------
@@ -541,7 +699,16 @@ class EngineServer:
                 out = self.batcher.submit(d, deadline_s=deadline_s)
             else:
                 out = self.handle_query(d)
-            return Response(200, out, headers=self._degraded_headers())
+            headers = self._degraded_headers()
+            if isinstance(out, dict) and "_pioCanary" in out:
+                # the canary tag rides the result dict out of the (
+                # possibly batched) predict path; surface it as the
+                # X-PIO-Canary response header instead of body noise
+                out = dict(out)
+                version = out.pop("_pioCanary")
+                headers = dict(headers or {})
+                headers["X-PIO-Canary"] = str(version)
+            return Response(200, out, headers=headers)
 
     def _reload(self, req: Request) -> Response:
         """Hot-swap to the latest COMPLETED instance (:337-358)."""
@@ -572,6 +739,10 @@ class EngineServer:
         """JSON serving counters with the predict/total latency split: how
         much of the serving time is the algorithm's device scoring vs
         serve/HTTP overhead."""
+        if self.canary.enabled:
+            # idle-traffic watchdog kick: a stats poll can land the
+            # promote/rollback decision when no query has since
+            self._apply_canary_decision()
         with self._lock:
             n = self.request_count
             out = {
@@ -593,6 +764,10 @@ class EngineServer:
                 "publishDegraded": self.publish_degraded,
                 "publishFailures": self.publish_failures,
                 "modelStalenessSec": self.model_staleness_s(),
+                # guarded deploys (ISSUE 5): canary arm state and the
+                # in-memory rollback anchor
+                "canary": self.canary.stats(),
+                "lastGoodVersion": self.last_good_version,
             }
             pct = self._ring_percentiles()
             if pct is not None:

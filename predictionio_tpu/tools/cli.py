@@ -10,7 +10,8 @@ parallel.mesh.init_distributed).
 Verbs: version, status, build, train, eval, deploy, undeploy, eventserver,
 dashboard, adminserver, app {new,list,show,delete,data-delete,channel-new,
 channel-delete}, accesskey {new,list,delete}, template {list,get}, export,
-import, trim, run.
+import, trim, run; beyond-parity: update, servers, snapshot, faults,
+rollback, spill {status,peek,requeue}.
 """
 
 from __future__ import annotations
@@ -278,7 +279,9 @@ def cmd_deploy(args) -> int:
         event_server_ip=args.event_server_ip,
         event_server_port=args.event_server_port,
         accesskey=args.accesskey or "",
-        mesh_broadcast_bytes=args.mesh_broadcast_bytes)
+        mesh_broadcast_bytes=args.mesh_broadcast_bytes,
+        canary_fraction=args.canary_fraction,
+        canary_window_s=args.canary_window)
     server = EngineServer(config)
     server.load()
     if server.coordinator is not None and not server.coordinator.is_primary:
@@ -684,6 +687,8 @@ def cmd_faults(args) -> int:
         if rule.latency_ms:
             rate = 1.0 if rule.latency_rate is None else rule.latency_rate
             bits.append(f"latency={rule.latency_ms:g}ms@{rate:g}")
+        if rule.corrupt:
+            bits.append(f"corrupt={rule.corrupt:g}")
         _print(f"  {target:16s} {', '.join(bits) or '(no-op)'}")
     if args.preview:
         inj = FaultInjector(spec, sleep=lambda s: None)
@@ -702,6 +707,109 @@ def cmd_faults(args) -> int:
            + (f"ACTIVE in this environment: {active}" if active
               else "not set (pass it to the server process to arm)"))
     return 0
+
+
+def cmd_rollback(args) -> int:
+    """`pio rollback` (ISSUE 5): demote every COMPLETED model version
+    newer than the last-known-good pin (or an explicit --to instance)
+    to ROLLEDBACK, so deploy//reload resolve the good version again,
+    then POST /reload to the running engine server. The durable
+    counterpart of the canary watchdog's in-memory rollback."""
+    from predictionio_tpu.online import ModelVersionRegistry
+    reg = ModelVersionRegistry()
+    engine_id = args.engine_id or "default"
+    engine_version = args.engine_version or "0"
+    try:
+        result = reg.rollback_to(engine_id, engine_version,
+                                 args.engine_json, target_id=args.to)
+    except ValueError as e:
+        _print(f"Rollback failed: {e}")
+        return 1
+    _print(f"Rolled back to instance {result['target']}.")
+    for iid in result["demoted"]:
+        _print(f"  demoted {iid} -> ROLLEDBACK")
+    if not args.engine_port:
+        _print("No engine server to reload (--engine-port 0).")
+        return 0
+    url = f"http://{args.engine_ip}:{args.engine_port}/reload"
+    try:
+        req = urllib.request.Request(url, method="POST", data=b"")
+        urllib.request.urlopen(req, timeout=30).read()
+        _print(f"Reloaded engine server at {url}.")
+    except Exception as e:
+        _print(f"Reload failed ({e}); the server keeps its current "
+               "model until it restarts or /reload succeeds.")
+        return 1
+    return 0
+
+
+def _default_spill_path() -> str:
+    import os as _os
+    from predictionio_tpu.data.storage.registry import base_dir
+    return _os.path.join(base_dir(), "ingest_spill", "events.wal")
+
+
+def cmd_spill(args) -> int:
+    """`pio spill` (ISSUE 5 satellite): inspect the ingest spill WAL
+    and its quarantine sidecar without reading raw files by hand —
+    pending counts, peek at the oldest records, requeue quarantined
+    ones after fixing their root cause."""
+    import json as _json
+
+    from predictionio_tpu.resilience.spill import (iter_pending,
+                                                   read_quarantine,
+                                                   requeue_quarantined,
+                                                   scan_wal)
+    path = args.wal or _default_spill_path()
+    if args.spill_command == "status":
+        s = scan_wal(path)
+        if not s["exists"]:
+            _print(f"No spill WAL at {path} (nothing ever spilled).")
+            return 0
+        _print(f"Spill WAL {path}:")
+        _print(f"  records total/pending: {s['totalRecords']} / "
+               f"{s['pendingRecords']}")
+        _print(f"  bytes valid/pending:   {s['validBytes']} / "
+               f"{s['pendingBytes']}")
+        if s["tornBytes"]:
+            _print(f"  torn tail: {s['tornBytes']} byte(s) (repaired on "
+                   "the owning server's next open)")
+        _print(f"  drain cursor: {s['cursor']}")
+        _print(f"  quarantined:  {s['quarantined']} record(s)"
+               + (f" in {path}.quarantine" if s["quarantined"] else ""))
+        return 0
+    if args.spill_command == "peek":
+        shown = 0
+        if args.quarantine:
+            for rec in read_quarantine(path)[:args.n]:
+                _print("QUARANTINED " + _json.dumps(rec, sort_keys=True))
+                shown += 1
+        else:
+            for rec in iter_pending(path, limit=args.n):
+                _print(_json.dumps(rec, sort_keys=True))
+                shown += 1
+        if shown == 0:
+            _print("No pending spill records."
+                   if not args.quarantine else "Quarantine is empty.")
+        return 0
+    if args.spill_command == "requeue":
+        q = read_quarantine(path)
+        if not q:
+            _print("Quarantine is empty; nothing to requeue.")
+            return 0
+        if not args.force and not _confirm(
+                f"Retry {len(q)} quarantined record(s) against the "
+                "primary event store?"):
+            return 1
+        done, kept = requeue_quarantined(path)
+        _print(f"Requeued {done} record(s) directly into the event "
+               "store (id-deduped)."
+               + (f" {kept} still-rejected record(s) remain "
+                  f"quarantined in {path}.quarantine." if kept
+                  else " Quarantine cleared."))
+        return 0 if not kept else 1
+    _print("spill command must be status|peek|requeue")
+    return 1
 
 
 def cmd_upgrade(args) -> int:
@@ -787,6 +895,13 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--accesskey")
     d.add_argument("--mesh-broadcast-bytes", type=int, default=1 << 16,
                    help="multi-process mesh query broadcast buffer size")
+    d.add_argument("--canary-fraction", type=float, default=0.0,
+                   help="guarded deploys (ISSUE 5): serve hot-swapped "
+                        "model versions to this traffic fraction first "
+                        "and auto-rollback on watchdog breach "
+                        "(0 = swap immediately)")
+    d.add_argument("--canary-window", type=float, default=30.0,
+                   help="watchdog decision window seconds")
     d.set_defaults(func=cmd_deploy)
 
     u = sub.add_parser("undeploy")
@@ -966,6 +1081,42 @@ def build_parser() -> argparse.ArgumentParser:
 
     up = sub.add_parser("upgrade")
     up.set_defaults(func=cmd_upgrade)
+
+    rb = sub.add_parser(
+        "rollback", help="guarded deploys (ISSUE 5): demote model "
+        "versions newer than the last-known-good pin and /reload the "
+        "serving process")
+    _add_variant_arg(rb)
+    rb.add_argument("--engine-id")
+    rb.add_argument("--engine-version")
+    rb.add_argument("--to", metavar="INSTANCE_ID",
+                    help="explicit rollback target (default: the "
+                         "last-good pin, else the previous COMPLETED "
+                         "version)")
+    rb.add_argument("--engine-ip", default="127.0.0.1")
+    rb.add_argument("--engine-port", type=int, default=8000,
+                    help="deployed engine server to POST /reload to "
+                         "(0 = registry-only, no reload)")
+    rb.set_defaults(func=cmd_rollback)
+
+    spl = sub.add_parser(
+        "spill", help="inspect the durable ingest-spill WAL and its "
+        "quarantine sidecar (ISSUE 3 spill, ISSUE 5 tooling)")
+    spsub = spl.add_subparsers(dest="spill_command", required=True)
+    sps = spsub.add_parser("status")
+    sps.add_argument("--wal", help="WAL path (default: "
+                     "<PIO_FS_BASEDIR>/ingest_spill/events.wal)")
+    spp = spsub.add_parser("peek")
+    spp.add_argument("n", type=int, nargs="?", default=10,
+                     help="records to show (default 10)")
+    spp.add_argument("--wal")
+    spp.add_argument("--quarantine", action="store_true",
+                     help="peek the quarantine sidecar instead of the "
+                          "pending WAL records")
+    spr = spsub.add_parser("requeue")
+    spr.add_argument("--wal")
+    spr.add_argument("-f", "--force", action="store_true")
+    spl.set_defaults(func=cmd_spill)
 
     fl = sub.add_parser(
         "faults", help="chaos-harness control: validate a PIO_FAULTS "

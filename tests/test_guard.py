@@ -1,0 +1,910 @@
+"""Guarded deploys (ISSUE 5): table-driven unit tests for the numerical
+sentinels, each pre-swap quality gate (pass / fail / boundary), the
+canary controller + watchdog, the registry last-good pin + rollback,
+the degenerate-tick no-op, and the `pio spill` / `pio rollback` CLI
+verbs. The injected-corruption end-to-end lives in
+tests/test_guard_chaos.py (`-m chaos`)."""
+
+import dataclasses
+import datetime as dt
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.guard.canary import (CanaryConfig, CanaryController,
+                                           count_nonfinite)
+from predictionio_tpu.guard.gates import (GateConfig, GateRejected,
+                                          QualityGatekeeper)
+from predictionio_tpu.guard.sentinels import (NumericalFault, SweepSentinel,
+                                              host_max_norm, rows_stats,
+                                              table_stats)
+from predictionio_tpu.models.common import ItemScore, ItemScoreResult
+from predictionio_tpu.obs import MetricsRegistry
+from predictionio_tpu.ops.als import ALSModel
+from predictionio_tpu.ops.ratings import RatingsCOO
+
+
+def _als(u, v, rank=None):
+    u = np.asarray(u, dtype=np.float32)
+    v = np.asarray(v, dtype=np.float32)
+    return ALSModel(u, v, rank or u.shape[1])
+
+
+def _reg():
+    return MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Sentinels
+# ---------------------------------------------------------------------------
+
+class TestSentinels:
+    def test_table_stats_finite_and_norm(self):
+        finite, mx = table_stats(np.full((4, 3), 2.0, np.float32))
+        assert finite
+        assert mx == pytest.approx(np.sqrt(12.0), rel=1e-5)
+        finite, _ = table_stats(
+            np.array([[1.0, np.nan]], dtype=np.float32))
+        assert not finite
+
+    def test_rows_stats_checks_only_selected_rows(self):
+        t = np.ones((8, 2), dtype=np.float32)
+        t[5] = np.inf   # poisoned row OUTSIDE the touched set
+        finite, _ = rows_stats(t, np.array([0, 1, 2], dtype=np.int32))
+        assert finite
+        finite, _ = rows_stats(t, np.array([5], dtype=np.int32))
+        assert not finite
+
+    @pytest.mark.parametrize("scale,breaches", [
+        (1.0, False),          # untouched norms pass
+        (0.5, False),          # shrinking passes
+        (np.nan, True),        # non-finite fails
+        (1e9, True),           # explosion fails
+    ])
+    def test_sweep_sentinel_cases(self, scale, breaches):
+        base = np.ones((6, 4), dtype=np.float32)
+        s = SweepSentinel("test", host_max_norm(base), norm_ratio=10.0,
+                          norm_floor=0.0)
+        fault = s.check_rows(base * np.float32(scale),
+                             np.arange(6, dtype=np.int32), "case")
+        assert (fault is not None) == breaches
+
+    def test_sweep_sentinel_boundary_is_inclusive(self):
+        base = np.ones((4, 4), dtype=np.float32)   # row norm 2.0
+        s = SweepSentinel("test", 2.0, norm_ratio=10.0, norm_floor=0.0)
+        # exactly AT the bound (2.0 * 10) passes; just past it fails
+        at = np.full((4, 4), 10.0, dtype=np.float32)       # norm 20
+        above = np.full((4, 4), 10.5, dtype=np.float32)    # norm 21
+        idx = np.arange(4, dtype=np.int32)
+        assert s.check_rows(at, idx, "at") is None
+        assert s.check_rows(above, idx, "above") is not None
+
+    def test_pio_guard_off_disables(self, monkeypatch):
+        monkeypatch.setenv("PIO_GUARD", "off")
+        s = SweepSentinel("test", 1.0, norm_floor=0.0)
+        bad = np.full((2, 2), np.nan, dtype=np.float32)
+        assert s.check_rows(bad, np.arange(2, dtype=np.int32),
+                            "poison") is None
+
+
+class TestFoldSentinelAndDegenerate:
+    def _model(self, n_u=6, n_i=5, rank=3):
+        rng = np.random.default_rng(0)
+        return _als(rng.standard_normal((n_u, rank)) * 0.3,
+                    rng.standard_normal((n_i, rank)) * 0.3)
+
+    def test_nan_ratings_abort_with_numerical_fault(self, mesh8):
+        from predictionio_tpu.online.fold_in import (FoldInConfig,
+                                                     fold_in_coo)
+        als = self._model()
+        coo = RatingsCOO(np.array([0, 1, 2]), np.array([0, 1, 2]),
+                         np.array([np.nan, 1.0, 2.0], dtype=np.float32),
+                         6, 5)
+        with pytest.raises(NumericalFault):
+            fold_in_coo(als, coo, [0, 1, 2], [0, 1, 2],
+                        FoldInConfig(sweeps=1))
+
+    def test_second_sweep_breach_rolls_back_to_first(self, mesh8,
+                                                     monkeypatch):
+        """A breach AFTER a clean full sweep publishes the checkpointed
+        last-good state instead of aborting."""
+        from predictionio_tpu.guard import sentinels as S
+        from predictionio_tpu.online.fold_in import (FoldInConfig,
+                                                     fold_in_coo)
+        als = self._model()
+        coo = RatingsCOO(np.array([0, 1, 2]), np.array([0, 1, 2]),
+                        np.array([1.0, 2.0, 3.0], dtype=np.float32),
+                        6, 5)
+        calls = {"n": 0}
+        real = S.rows_stats
+
+        def flaky(table, idx):
+            calls["n"] += 1
+            if calls["n"] >= 3:    # sweep 2, user side
+                return False, np.inf
+            return real(table, idx)
+
+        monkeypatch.setattr(S, "rows_stats", flaky)
+        new_als, stats = fold_in_coo(als, coo, [0, 1, 2], [0, 1, 2],
+                                     FoldInConfig(sweeps=2))
+        assert stats.sentinel_rollback
+        assert stats.sweeps == 1          # only the clean sweep counts
+        assert np.isfinite(new_als.user_factors).all()
+        assert np.isfinite(new_als.item_factors).all()
+
+    def test_empty_touched_set_noops(self, mesh8):
+        from predictionio_tpu.online.fold_in import (FoldInConfig,
+                                                     fold_in_coo)
+        als = self._model()
+        coo = RatingsCOO(np.array([0]), np.array([0]),
+                         np.array([1.0], dtype=np.float32), 6, 5)
+        out, stats = fold_in_coo(als, coo, [], [], FoldInConfig())
+        assert stats.degenerate
+        assert out is als                 # the deployed model, untouched
+
+    def test_all_zero_ratings_noop_instead_of_zeroing_rows(self, mesh8):
+        from predictionio_tpu.online.fold_in import (FoldInConfig,
+                                                     fold_in_coo)
+        als = self._model()
+        coo = RatingsCOO(np.array([0, 1]), np.array([0, 1]),
+                         np.zeros(2, dtype=np.float32), 6, 5)
+        out, stats = fold_in_coo(als, coo, [0, 1], [0, 1], FoldInConfig())
+        assert stats.degenerate
+        assert out is als
+
+    def test_train_sentinel_raises_on_poisoned_ratings(self, mesh8):
+        from predictionio_tpu.ops.als import ALSConfig, als_train
+        coo = RatingsCOO(np.array([0, 1, 2, 0]), np.array([0, 1, 0, 2]),
+                         np.array([1.0, np.inf, 2.0, 3.0],
+                                  dtype=np.float32), 3, 3)
+        with pytest.raises(NumericalFault):
+            als_train(coo, ALSConfig(rank=2, iterations=2, seed=1))
+
+
+# ---------------------------------------------------------------------------
+# Quality gates (table-driven pass/fail/boundary per gate)
+# ---------------------------------------------------------------------------
+
+class TestFiniteGate:
+    @pytest.mark.parametrize("bad_value,verdict", [
+        (0.5, "pass"), (np.nan, "fail"), (np.inf, "fail"),
+    ])
+    def test_cases(self, bad_value, verdict):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        t = np.ones((4, 2), dtype=np.float32)
+        t[2, 1] = bad_value
+        out = gk._gate_finite({"user_factors": t})
+        assert out["verdict"] == verdict
+
+    def test_no_tables_skips(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        assert gk._gate_finite({})["verdict"] == "skip"
+
+
+class TestNormDriftGate:
+    CFG = GateConfig(max_norm_ratio=4.0, norm_floor=0.0)
+
+    @staticmethod
+    def _run(gk, cand_tables, live_tables):
+        import types
+        return gk._gate_norm_drift(types.SimpleNamespace(),
+                                   types.SimpleNamespace(),
+                                   cand_tables, live_tables)
+
+    @pytest.mark.parametrize("factor,verdict", [
+        (1.0, "pass"),       # unchanged
+        (4.0, "pass"),       # exactly at the ratio bound (inclusive)
+        (4.01, "fail"),      # just past it
+        (100.0, "fail"),     # explosion
+    ])
+    def test_cases(self, factor, verdict):
+        gk = QualityGatekeeper(self.CFG, registry=_reg())
+        live = {"user_factors": np.ones((5, 3), dtype=np.float32)}
+        cand = {"user_factors": live["user_factors"] * np.float32(factor)}
+        assert self._run(gk, cand, live)["verdict"] == verdict
+
+    def test_floor_allows_growth_from_tiny_live_norms(self):
+        gk = QualityGatekeeper(GateConfig(max_norm_ratio=2.0,
+                                          norm_floor=100.0),
+                               registry=_reg())
+        live = {"t": np.full((3, 2), 1e-4, dtype=np.float32)}
+        cand = {"t": np.ones((3, 2), dtype=np.float32)}
+        assert self._run(gk, cand, live)["verdict"] == "pass"
+
+    def test_live_norm_is_memoized_on_the_model(self):
+        import types
+        gk = QualityGatekeeper(self.CFG, registry=_reg())
+        live_m = types.SimpleNamespace()
+        tables = {"t": np.ones((4, 2), dtype=np.float32)}
+        gk._gate_norm_drift(types.SimpleNamespace(), live_m,
+                            dict(tables), dict(tables))
+        assert live_m._pio_guard_norms["t"] == pytest.approx(
+            np.sqrt(2.0), rel=1e-6)
+
+
+class TestScoreDriftGate:
+    def _tables(self, shift=0.0, spread=1.0, seed=7):
+        rng = np.random.default_rng(seed)
+        u = rng.standard_normal((40, 4)).astype(np.float32)
+        v = rng.standard_normal((30, 4)).astype(np.float32)
+        live = {"user_factors": u, "item_factors": v}
+        cu = u * np.float32(spread) + np.float32(shift)
+        cand = {"user_factors": cu.astype(np.float32), "item_factors": v}
+        return cand, live
+
+    def test_identical_passes(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        cand, live = self._tables()
+        assert gk._gate_score_drift(live, live)["verdict"] == "pass"
+
+    def test_large_mean_shift_fails(self):
+        gk = QualityGatekeeper(GateConfig(max_score_shift=3.0),
+                               registry=_reg())
+        cand, live = self._tables(shift=50.0)
+        assert gk._gate_score_drift(cand, live)["verdict"] == "fail"
+
+    def test_spread_explosion_fails(self):
+        gk = QualityGatekeeper(
+            GateConfig(max_score_spread_ratio=5.0), registry=_reg())
+        cand, live = self._tables(spread=1e4)
+        assert gk._gate_score_drift(cand, live)["verdict"] == "fail"
+
+    def test_nonfinite_probe_fails(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        cand, live = self._tables()
+        cand["user_factors"] = np.full_like(cand["user_factors"], np.nan)
+        assert gk._gate_score_drift(cand, live)["verdict"] == "fail"
+
+    def test_missing_pair_skips(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        assert gk._gate_score_drift(
+            {"x": np.ones((2, 2), np.float32)},
+            {"x": np.ones((2, 2), np.float32)})["verdict"] == "skip"
+
+
+@dataclasses.dataclass(frozen=True)
+class _GoldenQuery:
+    user: str
+    num: int
+
+    @staticmethod
+    def from_dict(d):
+        return _GoldenQuery(user=str(d["user"]), num=int(d["num"]))
+
+
+class _RankedModel:
+    """Fake model: a fixed item ranking (and optional score override)."""
+
+    def __init__(self, ranking, score=1.0):
+        self.ranking = list(ranking)
+        self.score = score
+
+
+class _GoldenAlgo:
+    query_class = _GoldenQuery
+
+    def predict(self, model, q):
+        return ItemScoreResult(tuple(
+            ItemScore(item, model.score) for item in
+            model.ranking[:q.num]))
+
+
+class TestGoldenQueryGate:
+    CFG = GateConfig(golden_queries=({"user": "u1", "num": 4},),
+                     golden_min_overlap=0.5)
+
+    @pytest.mark.parametrize("cand_ranking,verdict", [
+        (list("abcd"), "pass"),    # identical top-k
+        (list("abxy"), "pass"),    # overlap 0.5 — boundary inclusive
+        (list("wxyz"), "fail"),    # disjoint
+    ])
+    def test_overlap_cases(self, cand_ranking, verdict):
+        gk = QualityGatekeeper(self.CFG, registry=_reg())
+        live = _RankedModel(list("abcd"))
+        cand = _RankedModel(cand_ranking)
+        out = gk._gate_golden(cand, live, _GoldenAlgo())
+        assert out["verdict"] == verdict
+
+    def test_nan_scores_fail(self):
+        gk = QualityGatekeeper(self.CFG, registry=_reg())
+        live = _RankedModel(list("abcd"))
+        cand = _RankedModel(list("abcd"), score=float("nan"))
+        out = gk._gate_golden(cand, live, _GoldenAlgo())
+        assert out["verdict"] == "fail"
+
+    def test_no_queries_skips(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        out = gk._gate_golden(_RankedModel("ab"), _RankedModel("ab"),
+                              _GoldenAlgo())
+        assert out["verdict"] == "skip"
+
+
+class TestGatekeeperAggregation:
+    def test_clean_candidate_passes(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        rng = np.random.default_rng(1)
+        live = _als(rng.standard_normal((10, 4)),
+                    rng.standard_normal((8, 4)))
+        cand = _als(live.user_factors * 1.01, live.item_factors)
+        report = gk.evaluate([cand], [live])
+        assert report["passed"]
+
+    def test_nan_candidate_fails_fast(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        live = _als(np.ones((10, 4)), np.ones((8, 4)))
+        cand = _als(np.full((10, 4), np.nan), np.ones((8, 4)))
+        report = gk.evaluate([cand], [live])
+        assert not report["passed"]
+        assert [g["gate"] for g in report["gates"]] == ["finite"]
+
+    def test_unchanged_model_objects_are_not_gated(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        live = _als(np.full((4, 2), np.nan), np.ones((3, 2)))
+        # same object on both sides == not refreshed: nothing to gate
+        report = gk.evaluate([live], [live])
+        assert report["passed"]
+        assert report["gates"] == []
+
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_GUARD", "off")
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        cand = _als(np.full((4, 2), np.nan), np.ones((3, 2)))
+        live = _als(np.ones((4, 2)), np.ones((3, 2)))
+        assert gk.evaluate([cand], [live])["passed"]
+
+    def test_check_publishable_raises(self):
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        bad = _als(np.full((4, 2), np.nan), np.ones((3, 2)))
+        with pytest.raises(GateRejected):
+            gk.check_publishable([bad])
+        gk.check_publishable([_als(np.ones((4, 2)), np.ones((3, 2)))])
+
+
+# ---------------------------------------------------------------------------
+# Canary controller + watchdog
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _controller(**kw):
+    clock = _Clock()
+    cfg = CanaryConfig(**{"fraction": 0.25, "window_s": 10.0,
+                          "min_requests": 4, "nan_tolerance": 0, **kw})
+    return CanaryController(cfg, registry=_reg(), clock=clock), clock
+
+
+class TestCanaryController:
+    def test_disabled_fraction_stages_nothing(self):
+        c, _ = _controller(fraction=0.0)
+        assert c.stage(["m"], "v1") is False
+        assert not c.active
+
+    def test_routing_realizes_fraction_evenly(self):
+        c, _ = _controller(fraction=0.25)
+        assert c.stage(["cand"], "v2")
+        hits = [c.route() is not None for _ in range(100)]
+        assert sum(hits) == 25
+        # evenly spread: no window of 4 consecutive all-candidate
+        assert max(len(list(g)) for g in _runs(hits)) <= 1 or True
+        # candidate never serves two requests in a row at 25%
+        assert all(not (a and b) for a, b in zip(hits, hits[1:]))
+
+    def test_nan_scores_roll_back_immediately(self):
+        c, _ = _controller()
+        c.stage(["cand"], "v2")
+        c.record("candidate", nonfinite=3, latency_s=0.01)
+        d = c.take_decision()
+        assert d["decision"] == "rollback"
+        assert d["reason"] == "nan_scores"
+        assert not c.active
+
+    def test_error_rate_breach_rolls_back(self):
+        c, _ = _controller(min_requests=4)
+        c.stage(["cand"], "v2")
+        for _ in range(20):
+            c.record("incumbent", latency_s=0.01)
+        for _ in range(4):
+            c.record("candidate", error=True, latency_s=0.01)
+        d = c.take_decision()
+        assert d["decision"] == "rollback"
+        assert d["reason"] == "error_rate"
+
+    def test_clean_window_promotes(self):
+        c, clock = _controller(window_s=10.0, min_requests=4)
+        c.stage(["cand"], "v2", fold_in_events=7)
+        for _ in range(6):
+            c.record("incumbent", latency_s=0.01)
+            c.record("candidate", latency_s=0.01)
+        assert c.take_decision() is None      # window still open
+        clock.t += 11.0
+        d = c.take_decision()
+        assert d["decision"] == "promote"
+        assert d["models"] == ["cand"]
+        assert d["foldInEvents"] == 7
+        assert not c.active
+
+    def test_latency_breach_rolls_back_at_window_end(self):
+        c, clock = _controller(window_s=10.0, min_requests=4,
+                               max_latency_ratio=3.0)
+        c.stage(["cand"], "v2")
+        for _ in range(6):
+            c.record("incumbent", latency_s=0.010)
+            c.record("candidate", latency_s=0.200)
+        clock.t += 11.0
+        d = c.take_decision()
+        assert d["decision"] == "rollback"
+        assert d["reason"] == "latency"
+
+    def test_idle_candidate_keeps_window_open(self):
+        c, clock = _controller(min_requests=4)
+        c.stage(["cand"], "v2")
+        clock.t += 100.0
+        assert c.take_decision() is None
+        assert c.active
+
+    def test_staging_supersedes_undecided_candidate(self):
+        c, _ = _controller()
+        c.stage(["cand1"], "v1")
+        c.stage(["cand2"], "v2")
+        assert c.superseded == 1
+        assert c.stats()["candidateVersion"] == "v2"
+
+    def test_count_nonfinite(self):
+        assert count_nonfinite({"itemScores": [
+            {"item": "a", "score": 1.0},
+            {"item": "b", "score": float("nan")},
+            {"item": "c", "score": float("inf")}]}) == 2
+        assert count_nonfinite({"ok": True, "n": 3}) == 0
+
+
+def _runs(bools):
+    run = []
+    for b in bools:
+        if b:
+            run.append(b)
+        elif run:
+            yield run
+            run = []
+    if run:
+        yield run
+
+
+# ---------------------------------------------------------------------------
+# EngineServer integration: staging, tagging, rollback, promote
+# ---------------------------------------------------------------------------
+
+class _FakeServing:
+    def supplement(self, q):
+        return q
+
+    def serve(self, q, predictions):
+        return predictions[0]
+
+
+class _ScoreAlgo:
+    """Scores every query with the model's value — NaN models produce
+    NaN responses, exactly like poisoned factors would."""
+    query_class = None
+
+    def predict(self, model, q):
+        return {"itemScores": [{"item": "i1", "score": float(model)}]}
+
+    def batch_predict(self, model, indexed):
+        return [(i, self.predict(model, q)) for i, q in indexed]
+
+
+class _FakeInstance:
+    id = "fake-instance"
+    engine_factory = "fake"
+
+
+def _guarded_server(micro_batch=0, **canary_kw):
+    from predictionio_tpu.serving.plugins import EngineServerPluginContext
+    from predictionio_tpu.serving.server import EngineServer, ServerConfig
+    kw = {"canary_fraction": 0.5, "canary_window_s": 1.0,
+          "canary_min_requests": 2, **canary_kw}
+    cfg = ServerConfig(ip="127.0.0.1", port=0, micro_batch=micro_batch,
+                       **kw)
+    s = EngineServer(cfg, plugin_context=EngineServerPluginContext())
+    s.algorithms = [_ScoreAlgo()]
+    s.models = [1.0]
+    s.serving = _FakeServing()
+    s.engine_instance = _FakeInstance()
+    return s
+
+
+class TestServerCanaryIntegration:
+    def test_stage_keeps_incumbent_serving_and_tags_candidate(self):
+        s = _guarded_server()
+        s.swap_models([2.0], version="v2")
+        assert s.models == [1.0]          # not swapped yet
+        assert s.canary.active
+        tags = []
+        for _ in range(8):
+            out = s.handle_query({"q": 1})
+            tags.append("_pioCanary" in out)
+        assert 0 < sum(tags) < 8          # both arms answered
+
+    def test_nan_candidate_rolls_back_and_notifies(self):
+        s = _guarded_server()
+        decisions = []
+        s.on_canary_decision = decisions.append
+        s.swap_models([float("nan")], version="v-bad")
+        served = [s.handle_query({"q": i}) for i in range(8)]
+        # rollback landed: canary cleared, incumbent untouched
+        assert not s.canary.active
+        assert s.models == [1.0]
+        assert decisions and decisions[0]["decision"] == "rollback"
+        assert decisions[0]["reason"] == "nan_scores"
+        # every response AFTER the rollback is from the incumbent
+        assert all("_pioCanary" not in d for d in
+                   [s.handle_query({"q": 99}) for _ in range(4)])
+        # and the poisoned answers were only ever canary-tagged
+        for d in served:
+            if not np.isfinite(d["itemScores"][0]["score"]):
+                assert "_pioCanary" in d
+
+    def test_clean_candidate_promotes_after_window(self):
+        s = _guarded_server(canary_window_s=0.2)
+        decisions = []
+        s.on_canary_decision = decisions.append
+        swaps_before = s.swap_count
+        s.swap_models([2.0], version="v2", fold_in_events=5)
+        for _ in range(8):
+            s.handle_query({"q": 1})
+        time.sleep(0.25)
+        s.handle_query({"q": 1})          # decision lands on query path
+        assert s.models == [2.0]
+        assert s.model_version == "v2"
+        assert s.last_good_version == "v2"
+        assert s.swap_count == swaps_before + 1
+        assert s.fold_in_events == 5
+        assert decisions and decisions[-1]["decision"] == "promote"
+
+    def test_fraction_zero_swaps_immediately(self):
+        s = _guarded_server(canary_fraction=0.0)
+        s.swap_models([3.0], version="v3")
+        assert s.models == [3.0]
+        assert s.model_version == "v3"
+
+    def test_stats_and_header_over_http(self):
+        s = _guarded_server()
+        s.start()
+        try:
+            port = s.config.port
+            s.swap_models([2.0], version="v2")
+            seen_canary = 0
+            for _ in range(8):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps({"q": 1}).encode(), method="POST")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    body = json.loads(resp.read())
+                    if resp.headers.get("X-PIO-Canary"):
+                        seen_canary += 1
+                        assert resp.headers["X-PIO-Canary"] == "v2"
+                    assert "_pioCanary" not in body
+            assert seen_canary > 0
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stats.json",
+                    timeout=10) as resp:
+                stats = json.loads(resp.read())
+            assert stats["canary"]["enabled"]
+            assert stats["lastGoodVersion"] is None  # no load() ran
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as resp:
+                metrics = resp.read().decode()
+            assert "pio_guard_canary_state" in metrics
+            assert "pio_guard_canary_requests_total" in metrics
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Registry: last-good pin + rollback
+# ---------------------------------------------------------------------------
+
+class TestRegistryRollback:
+    def _seed_versions(self, n=3):
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.data.storage.registry import Storage
+        instances = Storage.get_meta_data_engine_instances()
+        ids = []
+        t0 = dt.datetime.now(dt.timezone.utc)
+        for k in range(n):
+            iid = instances.insert(EngineInstance(
+                id="", status="INIT",
+                start_time=t0 + dt.timedelta(seconds=k),
+                end_time=t0 + dt.timedelta(seconds=k),
+                engine_id="guard", engine_version="1",
+                engine_variant="v1", engine_factory="recommendation"))
+            instances.update(instances.get(iid).with_(status="COMPLETED"))
+            ids.append(iid)
+        return instances, ids
+
+    def test_pin_roundtrip(self, tmp_env):
+        from predictionio_tpu.online import ModelVersionRegistry
+        reg = ModelVersionRegistry()
+        assert reg.last_good("guard", "1", "v1") is None
+        reg.pin_last_good("guard", "1", "v1", "abc123")
+        assert reg.last_good("guard", "1", "v1") == "abc123"
+
+    def test_rollback_to_pin_demotes_newer(self, tmp_env):
+        from predictionio_tpu.online import (ModelVersionRegistry,
+                                             ROLLEDBACK_STATUS)
+        instances, ids = self._seed_versions(3)
+        reg = ModelVersionRegistry()
+        reg.pin_last_good("guard", "1", "v1", ids[0])
+        result = reg.rollback_to("guard", "1", "v1")
+        assert result["target"] == ids[0]
+        assert set(result["demoted"]) == {ids[1], ids[2]}
+        assert instances.get(ids[1]).status == ROLLEDBACK_STATUS
+        assert instances.get_latest_completed(
+            "guard", "1", "v1").id == ids[0]
+
+    def test_rollback_without_pin_targets_previous(self, tmp_env):
+        from predictionio_tpu.online import ModelVersionRegistry
+        _, ids = self._seed_versions(3)
+        result = ModelVersionRegistry().rollback_to("guard", "1", "v1")
+        assert result["target"] == ids[1]
+        assert result["demoted"] == [ids[2]]
+
+    def test_rollback_rejects_unknown_target(self, tmp_env):
+        from predictionio_tpu.online import ModelVersionRegistry
+        self._seed_versions(2)
+        with pytest.raises(ValueError):
+            ModelVersionRegistry().rollback_to("guard", "1", "v1",
+                                               target_id="nope")
+
+    def test_demote_version_hides_it_from_latest_completed(self,
+                                                           tmp_env):
+        from predictionio_tpu.online import (ModelVersionRegistry,
+                                             ROLLEDBACK_STATUS)
+        instances, ids = self._seed_versions(2)
+        reg = ModelVersionRegistry()
+        assert reg.demote_version(ids[1])
+        assert instances.get(ids[1]).status == ROLLEDBACK_STATUS
+        assert instances.get_latest_completed(
+            "guard", "1", "v1").id == ids[0]
+        assert not reg.demote_version("nope")
+
+    def test_publish_gate_refuses_nonfinite(self, tmp_env):
+        from predictionio_tpu.online import ModelVersionRegistry
+        gk = QualityGatekeeper(GateConfig(), registry=_reg())
+        reg = ModelVersionRegistry(gatekeeper=gk)
+        bad = _als(np.full((3, 2), np.nan), np.ones((2, 2)))
+        with pytest.raises(GateRejected):
+            reg.publish(None, None, None, [bad])
+
+
+# ---------------------------------------------------------------------------
+# CLI: pio spill / pio rollback
+# ---------------------------------------------------------------------------
+
+class TestSpillCli:
+    def _wal(self, tmp_path, n=3, quarantined=1):
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.resilience.spill import SpillWAL
+        path = str(tmp_path / "events.wal")
+        wal = SpillWAL(path)
+        for i in range(n):
+            wal.append(Event(event="rate", entity_type="user",
+                             entity_id=f"u{i}"), app_id=7)
+        wal.close()
+        for i in range(quarantined):
+            with open(path + ".quarantine", "a") as f:
+                f.write(json.dumps({
+                    "appId": 7, "channelId": None,
+                    "event": Event(event="bad", entity_type="user",
+                                   entity_id=f"q{i}").to_dict(),
+                    "error": "rejected"}) + "\n")
+        return path
+
+    def test_status(self, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main
+        path = self._wal(tmp_path, n=3, quarantined=2)
+        assert main(["spill", "status", "--wal", path]) == 0
+        out = capsys.readouterr().out
+        assert "records total/pending: 3 / 3" in out
+        assert "quarantined:  2" in out
+
+    def test_status_missing_wal(self, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main
+        assert main(["spill", "status", "--wal",
+                     str(tmp_path / "absent.wal")]) == 0
+        assert "nothing ever spilled" in capsys.readouterr().out
+
+    def test_peek(self, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main
+        path = self._wal(tmp_path, n=3)
+        assert main(["spill", "peek", "2", "--wal", path]) == 0
+        lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.strip()]
+        assert len(lines) == 2
+        assert json.loads(lines[0])["event"]["entityId"] == "u0"
+
+    def test_peek_quarantine(self, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main
+        path = self._wal(tmp_path, quarantined=2)
+        assert main(["spill", "peek", "5", "--wal", path,
+                     "--quarantine"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("QUARANTINED") == 2
+
+    def test_requeue_inserts_directly_into_store(self, tmp_env,
+                                                 tmp_path, capsys):
+        # NOT a WAL re-append: a second writer would be invisible to
+        # (and truncatable by) the owning server's live SpillWAL — the
+        # records go straight into the now-healthy store instead
+        from predictionio_tpu.data.storage.registry import Storage
+        from predictionio_tpu.resilience.spill import scan_wal
+        from predictionio_tpu.tools.cli import main
+        path = self._wal(tmp_path, n=2, quarantined=2)
+        ev = Storage.get_events()
+        ev.init(7)
+        assert main(["spill", "requeue", "--wal", path, "-f"]) == 0
+        assert {e.entity_id for e in ev.find(app_id=7)} == {"q0", "q1"}
+        s = scan_wal(path)
+        assert s["pendingRecords"] == 2       # WAL untouched
+        assert s["quarantined"] == 0
+        assert not os.path.exists(path + ".quarantine")
+
+    def test_requeue_keeps_still_rejected_records(self, tmp_path):
+        from predictionio_tpu.resilience.spill import (read_quarantine,
+                                                       requeue_quarantined)
+
+        class _Rejecting:
+            @staticmethod
+            def get(*a, **kw):
+                return None
+
+            @staticmethod
+            def insert(*a, **kw):
+                raise ValueError("still bad")
+
+        path = self._wal(tmp_path, quarantined=2)
+        done, kept = requeue_quarantined(path, events=_Rejecting())
+        assert (done, kept) == (0, 2)
+        assert len(read_quarantine(path)) == 2
+
+
+class TestRollbackCli:
+    def test_rollback_cli_demotes_and_skips_reload(self, tmp_env,
+                                                   capsys):
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.data.storage.registry import Storage
+        from predictionio_tpu.tools.cli import main
+        instances = Storage.get_meta_data_engine_instances()
+        t0 = dt.datetime.now(dt.timezone.utc)
+        ids = []
+        for k in range(2):
+            iid = instances.insert(EngineInstance(
+                id="", status="INIT",
+                start_time=t0 + dt.timedelta(seconds=k),
+                end_time=t0 + dt.timedelta(seconds=k),
+                engine_id="cliguard", engine_version="1",
+                engine_variant="engine.json",
+                engine_factory="recommendation"))
+            instances.update(instances.get(iid).with_(
+                status="COMPLETED"))
+            ids.append(iid)
+        rc = main(["rollback", "--engine-id", "cliguard",
+                   "--engine-version", "1", "--engine-port", "0"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"Rolled back to instance {ids[0]}" in out
+        assert instances.get_latest_completed(
+            "cliguard", "1", "engine.json").id == ids[0]
+
+    def test_rollback_cli_reports_nothing_to_do(self, tmp_env, capsys):
+        from predictionio_tpu.tools.cli import main
+        assert main(["rollback", "--engine-id", "empty",
+                     "--engine-port", "0"]) == 1
+        assert "Rollback failed" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Scheduler gate wiring (fake models/algos, no storage)
+# ---------------------------------------------------------------------------
+
+class _FoldAlgo:
+    """fold_in returns a preset candidate (or the same model = no-op)."""
+    query_class = None
+
+    def __init__(self, candidate=None):
+        self.candidate = candidate
+
+    def fold_in(self, model, td, tu, ti, preparator_params=None):
+        if self.candidate is None:
+            return model, {"degenerate": True}
+        return self.candidate, {"loss": 0.1}
+
+
+def _gated_scheduler(candidate, live, gates=True):
+    from predictionio_tpu.online.scheduler import (DeltaTrainingScheduler,
+                                                   SchedulerConfig)
+
+    class _Store:
+        @staticmethod
+        def find(**kw):
+            return iter(())
+
+    class _Params:
+        data_source_params = ("", None)
+        preparator_params = ("", None)
+
+    sched = DeltaTrainingScheduler(
+        engine=None, engine_params=_Params(), instance=_FakeInstance(),
+        algorithms=[_FoldAlgo(candidate)], models=[live],
+        config=SchedulerConfig(app_name="x", gates=gates),
+        event_store=_Store())
+    sched._read_training = lambda tu, ti: (None, {"readPath": "stub",
+                                                  "readRows": 0})
+    sched._user_deltas = {"u1": None}
+    sched._pending_events = 1
+    return sched
+
+
+class TestSchedulerGateWiring:
+    def test_gate_rejection_blocks_publish_and_restores_deltas(self):
+        live = _als(np.ones((6, 3)), np.ones((5, 3)))
+        bad = _als(np.full((6, 3), np.nan), np.ones((5, 3)))
+        sched = _gated_scheduler(bad, live)
+        with pytest.raises(GateRejected):
+            sched.fold_in()
+        assert sched.fold_in_count == 0
+        assert sched.models == [live]          # live set untouched
+        assert sched.pending_deltas() == 1     # restored for the record
+        assert sched.gate_rejects == 1
+        assert sched.last_report["gateReport"]["passed"] is False
+
+    def test_clean_candidate_passes_gates_and_publishes(self):
+        live = _als(np.ones((6, 3)), np.ones((5, 3)))
+        cand = _als(np.ones((6, 3)) * 1.01, np.ones((5, 3)))
+        sched = _gated_scheduler(cand, live)
+        report = sched.fold_in()
+        assert report["gateReport"]["passed"]
+        assert sched.models == [cand]
+        assert sched.fold_in_count == 1
+
+    def test_canary_rollback_demotes_version_in_registry(self):
+        demoted = []
+
+        class _Reg:
+            @staticmethod
+            def demote_version(v):
+                demoted.append(v)
+                return True
+
+        live = _als(np.ones((6, 3)), np.ones((5, 3)))
+        sched = _gated_scheduler(None, live)
+        sched.registry = _Reg()
+        sched.server = None
+        sched.note_canary_decision({
+            "decision": "rollback", "candidateVersion": "v-bad",
+            "reason": "nan_scores"})
+        # the rejected version must not stay newest-COMPLETED, and the
+        # fold lineage escalates to a full retrain
+        assert demoted == ["v-bad"]
+        assert sched.retrain_requested
+
+    def test_degenerate_tick_noops_without_publish(self):
+        live = _als(np.ones((6, 3)), np.ones((5, 3)))
+        sched = _gated_scheduler(None, live)   # fold returns same model
+        report = sched.fold_in()
+        assert report["degenerate"] is True
+        assert "gateReport" not in report
+        assert sched.fold_in_count == 0
+        assert sched.pending_deltas() == 0     # events consumed, not
+        #                                        requeued (they no-op)

@@ -1,0 +1,244 @@
+"""Guarded-deploys chaos acceptance (ISSUE 5, `-m chaos`): a `nan`
+corruption fault injected into a fold tick must never reach full
+traffic. Three layers, each proven end-to-end against the REAL train ->
+serve -> fold -> swap stack:
+
+- sentinel:  `fold.ratings:corrupt=1` poisons the tick's data — the
+             on-device sweep sentinel aborts the tick (NumericalFault)
+             and the deltas are restored for retry/escalation.
+- gates:     `fold.factors:corrupt=1` poisons the produced factors —
+             the pre-swap gates refuse the publish (GateRejected); the
+             serving model set is never touched.
+- canary:    same corruption with gates disabled — the poisoned version
+             serves ONLY the canary fraction (every poisoned response
+             is X-PIO-Canary-tagged), the watchdog rolls back to the
+             incumbent within one window, and non-canary traffic sees
+             zero 5xx and zero NaN scores throughout.
+"""
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.core import EngineParams
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.guard.gates import GateRejected
+from predictionio_tpu.guard.sentinels import NumericalFault
+from predictionio_tpu.models import recommendation as R
+from predictionio_tpu.online.scheduler import (SchedulerConfig,
+                                               attach_scheduler)
+from predictionio_tpu.resilience.faults import reset_env_injector
+from predictionio_tpu.serving import EngineServer, ServerConfig
+from predictionio_tpu.workflow import run_train
+
+pytestmark = pytest.mark.chaos
+
+CANARY_FRACTION = 0.25
+WATCHDOG_WINDOW_S = 3.0
+
+
+def _query(port, user="u1", num=3):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps({"user": user, "num": num}).encode(),
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return (resp.status, json.loads(resp.read()),
+                    resp.headers.get("X-PIO-Canary"))
+    except urllib.error.HTTPError as e:
+        return e.code, {}, None
+
+
+def _has_nan_scores(body) -> bool:
+    return any(not math.isfinite(s.get("score", 0.0))
+               for s in body.get("itemScores", ()))
+
+
+@pytest.fixture
+def guarded_stack(tmp_env, mesh8, monkeypatch, request):
+    """Trained recommendation engine + canarying EngineServer +
+    attached fold scheduler (gates per-test via indirect param)."""
+    gates = getattr(request, "param", {}).get("gates", True)
+    app_id = Storage.get_meta_data_apps().insert(App(0, "guardapp"))
+    ev = Storage.get_events()
+    ev.init(app_id)
+    for u in range(6):
+        for i in range(6):
+            ev.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(1 + (u + i) % 5)})),
+                app_id)
+    ep = EngineParams(
+        data_source_params=("", R.DataSourceParams(app_name="guardapp")),
+        preparator_params=("", R.PreparatorParams()),
+        algorithm_params_list=[("als", R.ALSAlgorithmParams(
+            rank=4, num_iterations=2, lam=0.1, seed=1))],
+        serving_params=("", None))
+    engine = R.RecommendationEngineFactory.apply()
+    run_train(engine, ep, engine_id="guard", engine_version="1",
+              engine_variant="v1", engine_factory="recommendation")
+    server = EngineServer(ServerConfig(
+        ip="127.0.0.1", port=0, engine_id="guard", engine_version="1",
+        engine_variant="v1",
+        micro_batch=0,                 # per-query canary routing: the
+        #                                realized fraction is exact
+        canary_fraction=CANARY_FRACTION,
+        canary_window_s=WATCHDOG_WINDOW_S,
+        canary_min_requests=4,
+        canary_nan_tolerance=0))
+    server.load()
+    server.start()
+    sched = attach_scheduler(server, SchedulerConfig(
+        app_name="guardapp", max_deltas=1, gates=gates))
+    try:
+        yield {"server": server, "sched": sched, "events": ev,
+               "app_id": app_id}
+    finally:
+        server.stop()
+        reset_env_injector()
+
+
+def _burst(ev, app_id, n=4):
+    for j in range(n):
+        ev.insert(Event(
+            event="rate", entity_type="user", entity_id=f"u{j % 6}",
+            target_entity_type="item", target_entity_id=f"i{j % 6}",
+            properties=DataMap({"rating": 5.0})), app_id)
+
+
+class TestSentinelAbortsPoisonedTick:
+    def test_nan_ratings_abort_and_restore_deltas(self, guarded_stack,
+                                                  monkeypatch):
+        monkeypatch.setenv("PIO_FAULTS", "fold.ratings:corrupt=1,seed=1")
+        reset_env_injector()
+        sched = guarded_stack["sched"]
+        server = guarded_stack["server"]
+        version_before = server.model_version
+        _burst(guarded_stack["events"], guarded_stack["app_id"])
+        with pytest.raises(NumericalFault):
+            sched.tick(force=True)
+        # the poisoned events are requeued, nothing was published, and
+        # the serving model never moved
+        assert sched.pending_deltas() > 0
+        assert sched.fold_in_count == 0
+        assert server.model_version == version_before
+        assert not server.canary.active
+
+
+class TestGatesRefusePoisonedPublish:
+    def test_nan_factors_rejected_before_swap(self, guarded_stack,
+                                              monkeypatch):
+        monkeypatch.setenv("PIO_FAULTS", "fold.factors:corrupt=1,seed=1")
+        reset_env_injector()
+        sched = guarded_stack["sched"]
+        server = guarded_stack["server"]
+        _burst(guarded_stack["events"], guarded_stack["app_id"])
+        with pytest.raises(GateRejected):
+            sched.tick(force=True)
+        assert sched.gate_rejects == 1
+        gates = sched.last_report["gateReport"]["gates"]
+        assert gates[0] == {"gate": "finite", "verdict": "fail",
+                            "detail": gates[0]["detail"]}
+        assert not server.canary.active     # never even staged
+        st, body, _ = _query(server.config.port)
+        assert st == 200 and not _has_nan_scores(body)
+
+
+@pytest.mark.parametrize("guarded_stack", [{"gates": False}],
+                         indirect=True)
+class TestCanaryContainsAndRollsBack:
+    """The last line of defense: gates off, corruption reaches
+    swap_models — the canary keeps it to <= the configured fraction and
+    the watchdog rolls back to last-known-good within one window."""
+
+    def test_poisoned_model_never_exceeds_canary_fraction(
+            self, guarded_stack, monkeypatch):
+        monkeypatch.setenv("PIO_FAULTS", "fold.factors:corrupt=1,seed=3")
+        reset_env_injector()
+        server = guarded_stack["server"]
+        sched = guarded_stack["sched"]
+        port = server.config.port
+        incumbent_version = server.model_version
+        incumbent_models = list(server.models)
+
+        responses = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                responses.append(_query(port))
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            _burst(guarded_stack["events"], guarded_stack["app_id"])
+            report = sched.tick(force=True)
+            assert report is not None        # published (gates off)
+            staged_at = time.time()
+            # watchdog: rollback must land within one window
+            while server.canary.active \
+                    and time.time() - staged_at < WATCHDOG_WINDOW_S:
+                time.sleep(0.02)
+            rolled_back_in = time.time() - staged_at
+            # keep serving a little longer: post-rollback traffic must
+            # be 100% clean
+            time.sleep(0.5)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+
+        assert rolled_back_in < WATCHDOG_WINDOW_S, \
+            "watchdog did not roll back within one window"
+        decision = server.canary.last_decision
+        assert decision["decision"] == "rollback"
+        assert decision["reason"] == "nan_scores"
+        # rollback target: the incumbent (last-known-good) model set
+        assert server.models == incumbent_models
+        assert server.model_version == incumbent_version
+        assert server.last_good_version == incumbent_version
+        # the scheduler re-anchored and escalated
+        assert sched.retrain_requested
+
+        total = len(responses)
+        assert total > 50
+        canary_tagged = sum(1 for _, _, tag in responses if tag)
+        poisoned = [r for r in responses if _has_nan_scores(r[1])]
+        # 1) zero 5xx anywhere — golden traffic never failed
+        assert all(st < 500 for st, _, _ in responses)
+        # 2) every poisoned response was canary-tagged: the corrupt
+        #    model NEVER answered as the incumbent
+        assert all(tag for _, _, tag in poisoned)
+        # 3) the poisoned version served at most the canary fraction
+        #    (+ absolute slack for the tiny denominators early on)
+        assert canary_tagged <= CANARY_FRACTION * total + 3, \
+            (canary_tagged, total)
+        # 4) after the rollback, zero canary-tagged or NaN responses
+        #    (scan the tail half; the rollback landed well before it)
+        tail = responses[-(total // 4):]
+        assert not any(tag for _, _, tag in tail)
+        assert not any(_has_nan_scores(b) for _, b, _ in tail)
+
+        # the breach is observable: rollback + canary counters on
+        # /metrics, canary verdict on /stats.json
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            metrics = resp.read().decode()
+        assert 'pio_guard_rollbacks_total{reason="nan_scores"} 1' \
+            in metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats.json",
+                timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["canary"]["lastDecision"]["decision"] == "rollback"
+        assert stats["modelVersion"] == incumbent_version
