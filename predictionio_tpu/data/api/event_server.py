@@ -32,7 +32,10 @@ from predictionio_tpu.data.event import (Event, EventValidation,
                                          parse_event_time)
 from predictionio_tpu.data.storage.base import ABSENT
 from predictionio_tpu.data.storage.registry import Storage
-from predictionio_tpu.obs import (MetricsRegistry, TRACER, get_registry,
+from predictionio_tpu.obs import (FLIGHT, MetricsRegistry, SLOEngine,
+                                  TRACER, default_event_specs,
+                                  flight_response, get_incidents,
+                                  get_registry, health_response,
                                   traces_response)
 from predictionio_tpu.utils.http import HttpServer, Request, Response, Router
 
@@ -121,6 +124,14 @@ class EventServer:
             "pio_ingest_spill_pending_bytes",
             "Un-replayed bytes in the spill WAL",
             lambda: (self._wal.pending_bytes() if self._wal else 0))
+        # diagnostics plane (ISSUE 6): flight-recorder metric context
+        # from this server's families, burn-rate SLOs at /health.json,
+        # and WAL/quarantine state frozen into incident bundles
+        FLIGHT.add_source(self.metrics)
+        self.slo = SLOEngine(default_event_specs(),
+                             registries=[self.metrics])
+        get_incidents().register_provider("ingest_wal",
+                                          self._incident_state)
         self._register_metrics()
         self.router = self._build_router()
         self.server: Optional[HttpServer] = None
@@ -342,10 +353,37 @@ class EventServer:
         self.breaker.record_success()
         return eid, False
 
+    def _incident_state(self) -> dict:
+        """Ingest durability state frozen into incident bundles: WAL
+        pending/quarantine counts + breaker state (obs/incidents.py)."""
+        out = {"breaker": self.breaker.state,
+               "spilledCount": self.spilled_count}
+        wal = self._wal
+        if wal is not None:
+            try:
+                out["pendingRecords"] = wal.pending_count()
+                out["pendingBytes"] = wal.pending_bytes()
+                # sidecar line count only — a scan_wal() here would
+                # frame-walk + CRC the whole WAL on the disk the
+                # incident is about, mid-outage
+                from predictionio_tpu.resilience.spill import \
+                    count_quarantined
+                out["quarantined"] = count_quarantined(wal.path)
+            except Exception as e:
+                out["walError"] = str(e)
+        return out
+
     def _spill(self, event, app_id, channel_id) -> str:
         with TRACER.span("spill_append"):
             eid = self._get_wal().append(event, app_id, channel_id)
         self.spilled_count += 1
+        # lifecycle record (ISSUE 6): coalesced — a 2k ev/s outage is
+        # one spill record per second (+ suppressed count), not a ring
+        # flood that evicts the breaker/replay narrative an incident
+        # bundle needs
+        FLIGHT.record("spill", coalesce_s=1.0, eventId=eid,
+                      pending=self._wal.pending_count()
+                      if self._wal else None)
         return eid
 
     def _batch_create(self, req: Request) -> Response:
@@ -544,6 +582,24 @@ class EventServer:
                            "--stats argument."})
         return Response(200, traces_response(req.params))
 
+    def _flight(self, req: Request) -> Response:
+        """GET /flight.json — lifecycle wide events (?n=, ?kind=,
+        ?trace_id=). Gated like /traces.json: spill records carry
+        event ids, so a server launched without --stats exposes
+        nothing."""
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To expose flight records, launch Event "
+                           "Server with --stats argument."})
+        return Response(200, flight_response(req.params))
+
+    def _health(self, req: Request) -> Response:
+        """GET /health.json — SLO verdicts (ingest write p99, ingest
+        rate, spill budget). Ungated: aggregate liveness only, no
+        per-app detail."""
+        return Response(200, health_response(self.slo, extra={
+            "breaker": self.breaker.state}))
+
     def _webhook_json(self, req: Request) -> Response:
         access_key, channel_id = self._authenticate(req)
         name = req.path_args[0]
@@ -608,6 +664,8 @@ class EventServer:
         r.add("GET", "/stats.json", guarded(self._get_stats))
         r.add("GET", "/metrics", self._metrics)
         r.add("GET", "/traces.json", self._traces)
+        r.add("GET", "/flight.json", self._flight)
+        r.add("GET", "/health.json", self._health)
         r.add("POST", "/webhooks/<name>.json", guarded(self._webhook_json))
         r.add("GET", "/webhooks/<name>.json", guarded(self._webhook_get))
         r.add("POST", "/webhooks/<name>", guarded(self._webhook_form))

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from predictionio_tpu.data.event import Event, new_event_id, to_millis
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import ABSENT
+from predictionio_tpu.obs.slo import lock_probe, timed_acquire
 
 _LIB_LOCK = threading.Lock()
 _LIB = None
@@ -364,6 +365,11 @@ class NativeLogEvents(base.Events):
         # the first entity-filtered read; kept incremental by insert())
         self._entidx: Dict[Tuple[int, Optional[int]], _EntityIndex] = {}
         self._entidx_lock = threading.RLock()
+        # contention probe (ISSUE 6): writer wait on the per-handle
+        # lock, as pio_lock_wait_seconds{lock=nativelog_append} — the
+        # instrument that localizes BENCH_r05's concurrent-8 ingest
+        # regression (slower than serial) to this lock or below it
+        self._append_lock_wait = lock_probe("nativelog_append")
 
     def _path_of(self, app_id: int, channel_id: Optional[int],
                  part: int) -> str:
@@ -597,7 +603,7 @@ class NativeLogEvents(base.Events):
         with ctx:
             while True:
                 h, lk = self._handle_of(app_id, channel_id, part)
-                with lk:
+                with timed_acquire(lk, self._append_lock_wait):
                     if self._stale(hkey, h):
                         continue       # lost a race with remove(): reopen
                     rc = self.lib.el_append(

@@ -254,6 +254,27 @@ class CanaryController:
             logger.error(
                 "canary ROLLBACK of %s: %s — incumbent keeps serving",
                 decision["candidateVersion"], why)
+        # diagnostics plane (ISSUE 6): the verdict is a flight record;
+        # a rollback additionally freezes a postmortem bundle (flight
+        # tail + traces + registry scrape + provider states) the
+        # operator replays via `pio incidents show`
+        try:
+            from predictionio_tpu.obs.flight import FLIGHT
+            FLIGHT.record("canary_" + kind,
+                          model_version=decision["candidateVersion"],
+                          reason=why,
+                          windowSec=decision["windowSec"],
+                          arms=decision["arms"])
+            if kind == "rollback":
+                from predictionio_tpu.obs.incidents import INCIDENTS
+                INCIDENTS.capture(
+                    "canary_rollback",
+                    f"canary rollback of "
+                    f"{decision['candidateVersion']} ({why})",
+                    context={k: v for k, v in decision.items()
+                             if k != "models"})
+        except Exception:
+            logger.debug("canary forensics failed", exc_info=True)
         return decision
 
     # -- introspection ------------------------------------------------------

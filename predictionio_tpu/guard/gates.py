@@ -319,12 +319,16 @@ class QualityGatekeeper:
         """Answer every golden query against one model — one
         ``batch_predict`` device call when the algorithm has it (the
         per-query jit-dispatch overhead dominated the gate's cost),
-        else a predict loop."""
-        bp = getattr(algo, "batch_predict", None)
-        if bp is not None:
-            by_ix = dict(bp(model, list(enumerate(qs))))
-            return [by_ix.get(i) for i in range(len(qs))]
-        return [algo.predict(model, q) for q in qs]
+        else a predict loop. Runs under the ``gates_probe`` compile
+        label (obs/costmon) so a probe-induced recompile is charged to
+        the gates, not to serving."""
+        from predictionio_tpu.obs import costmon
+        with costmon.executable(costmon.GATES_PROBE):
+            bp = getattr(algo, "batch_predict", None)
+            if bp is not None:
+                by_ix = dict(bp(model, list(enumerate(qs))))
+                return [by_ix.get(i) for i in range(len(qs))]
+            return [algo.predict(model, q) for q in qs]
 
     # -- aggregation --------------------------------------------------------
     def _count(self, gates: Sequence[dict]):

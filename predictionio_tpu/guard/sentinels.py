@@ -146,6 +146,21 @@ def _breach_counter():
         labelnames=("site",))
 
 
+def _breach_forensics(site: str, detail: str):
+    """Diagnostics plane (ISSUE 6): a breach is both a flight record
+    and an incident bundle — the postmortem evidence an operator
+    reconstructs the poisoned tick from. Never raises."""
+    try:
+        from predictionio_tpu.obs.flight import FLIGHT
+        from predictionio_tpu.obs.incidents import INCIDENTS
+        FLIGHT.record("sentinel_breach", site=site, detail=detail)
+        INCIDENTS.capture("sentinel_breach",
+                          f"numerical fault in {site}",
+                          context={"site": site, "detail": detail})
+    except Exception:
+        logger.debug("breach forensics failed", exc_info=True)
+
+
 class SweepSentinel:
     """Per-sweep breach detector: rows must be finite and their norms
     must stay under ``max(norm_floor, norm_ratio * baseline)`` where
@@ -180,6 +195,7 @@ class SweepSentinel:
         detail = (f"{what}: finite={finite} max_row_norm={max_norm:.4g} "
                   f"bound={self.bound:.4g}")
         logger.error("sentinel breach in %s — %s", self.site, detail)
+        _breach_forensics(self.site, detail)
         return NumericalFault(self.site, detail)
 
     def check_table(self, table, what: str) -> Optional[NumericalFault]:
@@ -194,4 +210,5 @@ class SweepSentinel:
         detail = (f"{what}: finite={finite} max_row_norm={max_norm:.4g} "
                   f"bound={self.bound:.4g}")
         logger.error("sentinel breach in %s — %s", self.site, detail)
+        _breach_forensics(self.site, detail)
         return NumericalFault(self.site, detail)

@@ -506,9 +506,15 @@ class ALSAlgorithm(P2LAlgorithm):
             b = 1 << (len(plain) - 1).bit_length()
             user_ixs = np.zeros(b, dtype=np.int32)
             user_ixs[:len(plain)] = [uix for _, _, uix, _ in plain]
-            scores, idx = _users_topk(
-                cached_put(model.als.user_factors),
-                cached_put(model.als.item_factors), user_ixs, k_max)
+            # compile attribution (obs/costmon): a gates golden-query
+            # replay keeps its gates_probe label; live serving books
+            # under batch_predict
+            from predictionio_tpu.obs import costmon
+            with costmon.executable(costmon.BATCH_PREDICT,
+                                    defer_to_outer=True):
+                scores, idx = _users_topk(
+                    cached_put(model.als.user_factors),
+                    cached_put(model.als.item_factors), user_ixs, k_max)
             scores = np.asarray(scores)
             idx = np.asarray(idx)
             for row, (ix, q, _, _) in enumerate(plain):

@@ -10,6 +10,17 @@ counters (ISSUE 2).
   process-wide ``TRACER``.
 - ``obs.jaxmon`` — compile counts, host<->device transfer bytes,
   device-memory gauges.
+
+The diagnostics plane (ISSUE 6) layers on those primitives:
+
+- ``obs.flight`` — bounded crash-safe lifecycle wide-event log
+  (``GET /flight.json``).
+- ``obs.incidents`` — automatic postmortem bundles under
+  ``base_dir()/incidents/`` (``pio incidents``).
+- ``obs.costmon`` — per-executable compile/cost attribution and
+  per-resident-table HBM gauges.
+- ``obs.slo`` — burn-rate SLO engine (``GET /health.json``) and
+  lock-wait contention probes.
 """
 
 from predictionio_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
@@ -19,10 +30,22 @@ from predictionio_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
 from predictionio_tpu.obs.trace import (Span, Trace, Tracer, TRACER,
                                         traces_response)
 from predictionio_tpu.obs import jaxmon
+from predictionio_tpu.obs.flight import (FLIGHT, FlightRecorder,
+                                         flight_response, get_flight)
+from predictionio_tpu.obs.incidents import (INCIDENTS, IncidentManager,
+                                            get_incidents)
+from predictionio_tpu.obs.slo import (SLOEngine, SLOSpec,
+                                      default_engine_specs,
+                                      default_event_specs,
+                                      health_response)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "FuncCollector", "Gauge", "Histogram",
     "MetricsRegistry", "REGISTRY", "get_registry",
     "Span", "Trace", "Tracer", "TRACER", "traces_response",
     "jaxmon",
+    "FLIGHT", "FlightRecorder", "flight_response", "get_flight",
+    "INCIDENTS", "IncidentManager", "get_incidents",
+    "SLOEngine", "SLOSpec", "default_engine_specs",
+    "default_event_specs", "health_response",
 ]

@@ -1,0 +1,224 @@
+"""Per-executable compile/cost attribution (extends obs/jaxmon).
+
+ISSUE 6 tentpole piece 3. jaxmon counts compiles process-wide; that
+tells an operator THAT the 231.6 s warmup (BENCH_r01) exists, not
+where it goes. This module attributes compile wall time to a stable
+**executable label** — the handful of jitted programs the system
+actually runs (``als_sweep``, ``fold_side``, ``batch_predict``,
+``gates_probe``) — which is the evidence base for the AOT/compile-
+cache ROADMAP item: the label whose seconds dominate is the one to
+AOT-lower first.
+
+Mechanics: call sites wrap their jit dispatch in ``executable(label)``.
+jax.monitoring fires compile-duration events synchronously on the
+compiling thread, so a contextvar label + a thread-local accumulator
+attribute each event to the scope that triggered it:
+
+- ``pio_compile_executable_seconds_total{executable}`` — compile wall;
+- ``pio_compile_cache_hits_total{executable}`` /
+  ``pio_compile_cache_misses_total{executable}`` — a scope that
+  triggered no backend compile was answered by XLA's jit cache (a
+  climbing miss count in steady state = shape churn on that
+  executable, the classic silent TPU perf bug).
+
+``analyze_jit`` banks XLA ``cost_analysis()`` FLOPs/bytes per label
+(``pio_executable_flops{executable}`` /
+``pio_executable_bytes_accessed{executable}``) — explicit lowering,
+meant for bench/smoke paths that accept paying one compile.
+
+``install()`` also mounts ``pio_hbm_table_bytes{table}``: per-resident-
+table device bytes sampled from ``utils/device_cache``'s residency
+slots at scrape time — the per-tenant HBM accounting the multi-tenant
+ROADMAP item builds on (ALX-style per-core memory budgeting).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from predictionio_tpu.obs.metrics import get_registry
+
+logger = logging.getLogger(__name__)
+
+#: the canonical labels (call sites may add more; these are the ones
+#: bench artifacts and docs talk about)
+ALS_SWEEP = "als_sweep"
+FOLD_SIDE = "fold_side"
+BATCH_PREDICT = "batch_predict"
+GATES_PROBE = "gates_probe"
+
+_label_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "pio_exec_label", default=None)
+_tls = threading.local()
+
+_lock = threading.Lock()
+_installed = False
+_c_seconds = None
+_c_hits = None
+_c_misses = None
+_g_flops = None
+_g_bytes = None
+
+
+def _is_backend_compile(name: str) -> bool:
+    # only the actual XLA compile: trace/lowering durations fire on
+    # cache hits too and would misclassify every hit as a miss
+    return "backend_compile" in name
+
+
+def install(registry=None):
+    """Register the listener + gauges. Idempotent; never raises."""
+    global _installed, _c_seconds, _c_hits, _c_misses, _g_flops, _g_bytes
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+        reg = registry or get_registry()
+        _c_seconds = reg.counter(
+            "pio_compile_executable_seconds_total",
+            "XLA backend-compile wall time attributed to the "
+            "executable label whose dispatch triggered it",
+            labelnames=("executable",))
+        _c_hits = reg.counter(
+            "pio_compile_cache_hits_total",
+            "executable() scopes answered without a backend compile "
+            "(XLA jit cache hit)", labelnames=("executable",))
+        _c_misses = reg.counter(
+            "pio_compile_cache_misses_total",
+            "executable() scopes that triggered a backend compile",
+            labelnames=("executable",))
+        _g_flops = reg.gauge(
+            "pio_executable_flops",
+            "XLA cost_analysis() FLOPs of the last analyzed "
+            "executable per label", labelnames=("executable",))
+        _g_bytes = reg.gauge(
+            "pio_executable_bytes_accessed",
+            "XLA cost_analysis() bytes accessed of the last analyzed "
+            "executable per label", labelnames=("executable",))
+        reg.gauge_func(
+            "pio_hbm_table_bytes",
+            "Device bytes held by each named residency slot in "
+            "utils/device_cache (per-table HBM accounting)",
+            _hbm_table_samples)
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:
+        logger.debug("costmon monitoring listener unavailable: %s", e)
+
+
+def _on_duration(name, secs, *a, **kw):
+    if not _is_backend_compile(name):
+        return
+    try:
+        secs = float(secs)
+    except (TypeError, ValueError):
+        return
+    _tls.compile_s = getattr(_tls, "compile_s", 0.0) + secs
+    label = _label_ctx.get() or "unlabeled"
+    _c_seconds.labels(executable=label).inc(secs)
+
+
+def _hbm_table_samples():
+    from predictionio_tpu.utils import device_cache
+    sizes = device_cache.resident_sizes()
+    return [({"table": name}, float(nbytes))
+            for name, nbytes in sorted(sizes.items())]
+
+
+@contextmanager
+def executable(label: str, defer_to_outer: bool = False):
+    """Attribute any compile triggered inside this scope to ``label``
+    and count the scope as a cache hit/miss. Cheap enough for per-
+    window dispatch paths (~1-2 µs; one contextvar set/reset and two
+    float reads).
+
+    ``defer_to_outer``: a shared kernel dispatched from several
+    higher-level executables (the ALS sweep under train vs fold)
+    defers entirely to the caller's scope when one is active —
+    attribution AND the hit/miss count follow the executable the
+    OPERATOR names (counting in both scopes would double every
+    hit/miss under the adopted label)."""
+    if not _installed:
+        install()
+    if defer_to_outer and _label_ctx.get() is not None:
+        yield                      # the outer scope owns all accounting
+        return
+    token = _label_ctx.set(label)
+    before = getattr(_tls, "compile_s", 0.0)
+    ok = False
+    try:
+        yield
+        ok = True
+    finally:
+        _label_ctx.reset(token)
+        # clean exits only: a body that raises before dispatching
+        # (fault injection, malformed golden query) compiled nothing —
+        # counting it as a "hit" would inflate the ratio the AOT /
+        # shape-churn diagnosis reads
+        if ok:
+            try:
+                if getattr(_tls, "compile_s", 0.0) > before:
+                    _c_misses.labels(executable=label).inc()
+                else:
+                    _c_hits.labels(executable=label).inc()
+            except Exception:
+                pass
+
+
+def record_cost_analysis(label: str, compiled) -> Optional[dict]:
+    """Bank ``compiled.cost_analysis()`` FLOPs/bytes under ``label``.
+    Accepts a jax ``Compiled`` (or anything exposing cost_analysis);
+    returns the extracted {"flops", "bytes_accessed"} or None."""
+    if not _installed:
+        install()
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        nbytes = float(cost.get("bytes accessed", 0.0))
+    except Exception as e:
+        logger.debug("cost_analysis unavailable for %s: %s", label, e)
+        return None
+    _g_flops.labels(executable=label).set(flops)
+    _g_bytes.labels(executable=label).set(nbytes)
+    return {"flops": flops, "bytes_accessed": nbytes}
+
+
+def analyze_jit(label: str, fn, *args, **kwargs) -> Optional[dict]:
+    """Lower+compile ``jax.jit(fn)`` for ``args`` under ``label`` and
+    bank its cost analysis. Pays one explicit compile — bench/smoke
+    only, never a serving path."""
+    import jax
+    try:
+        with executable(label):
+            compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    except Exception as e:
+        logger.debug("analyze_jit(%s) failed: %s", label, e)
+        return None
+    return record_cost_analysis(label, compiled)
+
+
+# -- bench/JSON views ---------------------------------------------------
+def _labeled_values(counter) -> Dict[str, float]:
+    if counter is None:
+        return {}
+    return {labels["executable"]: v
+            for labels, v in counter.samples() if labels}
+
+
+def compile_seconds_by_executable() -> Dict[str, float]:
+    return {k: round(v, 4)
+            for k, v in _labeled_values(_c_seconds).items()}
+
+
+def cache_counts() -> Dict[str, Dict[str, float]]:
+    """{"hits": {label: n}, "misses": {label: n}}."""
+    return {"hits": _labeled_values(_c_hits),
+            "misses": _labeled_values(_c_misses)}

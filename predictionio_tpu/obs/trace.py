@@ -253,6 +253,26 @@ class Tracer:
             traces.sort(key=lambda t: t.root.t_wall, reverse=True)
         return [t.to_dict() for t in traces[:max(0, int(limit))]]
 
+    def related(self, trace_id: str, limit: int = 50) -> List[dict]:
+        """The trace plus its causal neighborhood, for incident
+        correlation (ISSUE 6 satellite): the trace itself, every
+        committed trace it links, and every committed trace linking
+        it — so one ``?trace_id=`` query walks an ingest event to the
+        fold tick that absorbed it (or back) without client-side grep
+        over whole rings."""
+        with self._lock:
+            target = self._by_id.get(trace_id)
+            linked = set(target.links) if target is not None else set()
+            out = [] if target is None else [target]
+            for ring in self._done.values():
+                for t in ring:
+                    if t is target:
+                        continue
+                    if t.trace_id in linked or trace_id in t.links:
+                        out.append(t)
+        out.sort(key=lambda t: t.root.t_wall, reverse=True)
+        return [t.to_dict() for t in out[:max(0, int(limit))]]
+
     def clear(self):
         with self._lock:
             self._done.clear()
@@ -266,8 +286,15 @@ TRACER = Tracer()
 
 def traces_response(params: dict):
     """Shared ``GET /traces.json`` handler body for every HTTP server:
-    ``?n=`` limit (default 50), ``?kind=`` filter, ``?sort=slowest``."""
+    ``?n=``/``?limit=`` (default 50), ``?kind=`` filter,
+    ``?sort=slowest``, and ``?trace_id=`` — which returns the named
+    trace plus its linked neighborhood (ISSUE 6 satellite: correlating
+    one incident no longer means dumping whole rings and grepping
+    client-side)."""
     limit = int(params.get("n", params.get("limit", 50)))
+    trace_id = params.get("trace_id") or params.get("traceId")
+    if trace_id:
+        return {"traces": TRACER.related(trace_id, limit=limit)}
     return {"traces": TRACER.snapshot(
         limit=limit, kind=params.get("kind"),
         slowest=params.get("sort") == "slowest")}

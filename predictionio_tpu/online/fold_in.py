@@ -248,7 +248,9 @@ def solve_rows(counter_factors: np.ndarray,
     if cfg.implicit_prefs:
         gram_of = _gram_eig if cfg.dual_solve == "auto" else _gram
         gram = gram_of(counter_dev)
-    solved = _run_side(groups, out_dev, counter_dev, als_cfg, gram)
+    from predictionio_tpu.obs import costmon
+    with costmon.executable(costmon.FOLD_SIDE):
+        solved = _run_side(groups, out_dev, counter_dev, als_cfg, gram)
     return np.asarray(host_fetch(solved)[:n_rows], dtype=np.float32)
 
 
@@ -314,11 +316,13 @@ def _solve_side(prep: _SidePrep, counter_dev, counter_gram, out_dev,
     resident owned table, and (implicit) apply the rank-k Gram
     correction for the rows that moved. Returns the updated
     (out_dev, out_gram)."""
+    from predictionio_tpu.obs import costmon
     zeros = mesh.put_replicated(
         np.zeros((prep.n_rows + 1, rank), dtype=np.float32))
-    solved = _run_side(prep.groups, zeros, counter_dev, als_cfg,
-                       _solver_gram(counter_gram,
-                                    cfg.dual_solve == "auto"))
+    with costmon.executable(costmon.FOLD_SIDE):
+        solved = _run_side(prep.groups, zeros, counter_dev, als_cfg,
+                           _solver_gram(counter_gram,
+                                        cfg.dual_solve == "auto"))
     if out_gram is None:
         out_dev = _jitted("scatter", _scatter_impl)(
             out_dev, solved, prep.src, prep.dst)

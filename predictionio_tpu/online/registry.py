@@ -214,4 +214,20 @@ class ModelVersionRegistry:
                            target)
         logger.warning("rolled back to %s (demoted: %s)", target,
                        ", ".join(demoted) or "nothing")
+        # diagnostics plane (ISSUE 6): an operator rollback is a
+        # lifecycle transition AND an incident worth a bundle — the
+        # durable counterpart of the canary watchdog's capture
+        try:
+            from predictionio_tpu.obs.flight import FLIGHT
+            from predictionio_tpu.obs.incidents import INCIDENTS
+            FLIGHT.record("registry_rollback", model_version=target,
+                          demoted=demoted)
+            INCIDENTS.capture(
+                "registry_rollback",
+                f"rolled back to {target} "
+                f"({len(demoted)} version(s) demoted)",
+                context={"target": target, "demoted": demoted,
+                         "engineId": engine_id})
+        except Exception:
+            logger.debug("rollback forensics failed", exc_info=True)
         return {"target": target, "demoted": demoted}

@@ -227,6 +227,10 @@ class DeltaTrainingScheduler:
         self.anchor_loss: Optional[float] = None
         self.last_loss: Optional[float] = None
         self.last_report: Optional[dict] = None
+        # incident forensics (ISSUE 6): bundles capture the fold
+        # lineage (cursor, counts, breaker state) at incident time
+        from predictionio_tpu.obs.incidents import get_incidents
+        get_incidents().register_provider("scheduler", self.stats)
 
     @staticmethod
     def _instance_cursor(instance) -> Optional[_dt.datetime]:
@@ -541,6 +545,17 @@ class DeltaTrainingScheduler:
         report["guardOverheadMs"] = round(guard_wall_s * 1000, 3)
         if self.gatekeeper is not None:
             TRACER.annotate(gatesPassed=gate_report["passed"])
+            # flight record (ISSUE 6): every gate verdict is a
+            # lifecycle transition — the pass that precedes a publish
+            # as much as the reject that blocks one
+            from predictionio_tpu.obs.flight import FLIGHT
+            FLIGHT.record(
+                "gate_verdict",
+                model_version=getattr(self.instance, "id", None),
+                passed=gate_report["passed"],
+                verdicts={g["gate"]: g["verdict"]
+                          for g in gate_report["gates"]},
+                events=n_events)
             if not gate_report["passed"]:
                 # the events are restored for the record, but the same
                 # data folds the same way — the supervision loop's
@@ -552,6 +567,21 @@ class DeltaTrainingScheduler:
                 if self.server is not None:
                     self.server.note_publish_failure()
                 self.last_report = report
+                # incident bundle (ISSUE 6): a refused publish is a
+                # postmortem-worthy event — freeze the gate report,
+                # the tick's trace and the fold lineage now
+                from predictionio_tpu.obs.incidents import INCIDENTS
+                tick = TRACER.current_trace()
+                INCIDENTS.capture(
+                    "gate_rejected",
+                    "fold publish refused by quality gate(s): "
+                    + ", ".join(g["gate"] for g in gate_report["gates"]
+                                if g["verdict"] == "fail"),
+                    context={"gateReport": gate_report,
+                             "events": n_events,
+                             "baseInstance": getattr(self.instance,
+                                                     "id", None)},
+                    trace_ids=(tick.trace_id,) if tick else ())
                 raise GateRejected(gate_report)
         # drift gate: anchor = the first post-fold loss after (re)deploy
         losses = [r["loss"] for r in reports if r.get("loss") is not None]
@@ -626,6 +656,11 @@ class DeltaTrainingScheduler:
                     models, meta=meta)
             TRACER.annotate(version=version)
             report["publishedVersion"] = version
+        from predictionio_tpu.obs.flight import FLIGHT
+        FLIGHT.record("fold_publish", model_version=version,
+                      events=report["events"],
+                      foldIn=report["foldIn"],
+                      readPath=report.get("readPath"))
         if self.server is not None:
             with TRACER.span("hot_swap", version=version or ""):
                 self.server.swap_models(models, version=version,
@@ -749,6 +784,11 @@ class DeltaTrainingScheduler:
                             "scheduler: %d consecutive tick failures — "
                             "escalating to full retrain",
                             self.consecutive_failures)
+                        from predictionio_tpu.obs.flight import FLIGHT
+                        FLIGHT.record(
+                            "retrain_escalation",
+                            failures=self.consecutive_failures,
+                            lastError=self.last_error)
                         if self.on_retrain is not None:
                             try:
                                 self.on_retrain(report)

@@ -547,13 +547,17 @@ def _run_side(device_groups, factors, counter_factors, cfg: ALSConfig,
         lam = np.float32(cfg.lam)
     if alpha is None:
         alpha = np.float32(cfg.alpha)
-    return _solve_sweep(
-        factors, counter_factors, gram, device_groups, lam, alpha,
-        nratings_reg=(cfg.lambda_scaling == "nratings"),
-        implicit=cfg.implicit_prefs, rank=cfg.rank,
-        compute_dtype=cfg.compute_dtype, solver=cfg.solver,
-        dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters,
-        dual_iters_cap=cfg.dual_iters_cap)
+    # compile attribution (obs/costmon): sweeps dispatched from a fold
+    # tick keep the fold's label; bare train sweeps book as als_sweep
+    from predictionio_tpu.obs import costmon
+    with costmon.executable(costmon.ALS_SWEEP, defer_to_outer=True):
+        return _solve_sweep(
+            factors, counter_factors, gram, device_groups, lam, alpha,
+            nratings_reg=(cfg.lambda_scaling == "nratings"),
+            implicit=cfg.implicit_prefs, rank=cfg.rank,
+            compute_dtype=cfg.compute_dtype, solver=cfg.solver,
+            dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters,
+            dual_iters_cap=cfg.dual_iters_cap)
 
 
 def als_train(ratings: RatingsCOO, cfg: ALSConfig,
@@ -662,16 +666,20 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
         U, V = last_good
         return False
 
+    from predictionio_tpu.obs import costmon
     if cfg.fuse_iteration:
         for it in range(cfg.iterations):
-            U, V = _solve_iteration(
-                U, V, user_batches, item_batches, lam_dev, alpha_dev,
-                nratings_reg=(cfg.lambda_scaling == "nratings"),
-                implicit=cfg.implicit_prefs, rank=cfg.rank,
-                compute_dtype=cfg.compute_dtype, solver=cfg.solver,
-                dual_solve=cfg.dual_solve, solver_iters=cfg.solver_iters,
-                dual_iters_cap=cfg.dual_iters_cap,
-                n_users=ratings.n_users, n_items=ratings.n_items)
+            with costmon.executable(costmon.ALS_SWEEP,
+                                    defer_to_outer=True):
+                U, V = _solve_iteration(
+                    U, V, user_batches, item_batches, lam_dev, alpha_dev,
+                    nratings_reg=(cfg.lambda_scaling == "nratings"),
+                    implicit=cfg.implicit_prefs, rank=cfg.rank,
+                    compute_dtype=cfg.compute_dtype, solver=cfg.solver,
+                    dual_solve=cfg.dual_solve,
+                    solver_iters=cfg.solver_iters,
+                    dual_iters_cap=cfg.dual_iters_cap,
+                    n_users=ratings.n_users, n_items=ratings.n_items)
             if not _checked(it):
                 break
     else:

@@ -201,10 +201,31 @@ class CircuitBreaker:
         """Caller holds self._lock."""
         if to == self._state:
             return
+        came_from = self._state
         self._state = to
         self._g_state.set(_STATE_CODE[to])
         self._c_transitions.labels(breaker=self.name, to=to).inc()
         logger.info("breaker %s -> %s", self.name, to)
+        # diagnostics plane (ISSUE 6): every transition is a flight
+        # record; an OPEN transition is an incident (the dependency is
+        # down and callers are now degrading). Both calls are
+        # non-blocking by contract — safe under self._lock.
+        try:
+            from predictionio_tpu.obs.flight import FLIGHT
+            FLIGHT.record("breaker", breaker=self.name, to=to,
+                          from_=came_from,
+                          consecutiveFailures=self._consecutive_failures)
+            if to == OPEN and came_from == CLOSED:
+                from predictionio_tpu.obs.incidents import INCIDENTS
+                INCIDENTS.capture(
+                    "breaker_open",
+                    f"breaker {self.name!r} opened after "
+                    f"{self._consecutive_failures} consecutive failures",
+                    context={"breaker": self.name,
+                             "failures": self._consecutive_failures,
+                             "resetTimeoutS": self._reset_timeout_s})
+        except Exception:   # diagnosis must never worsen the fault
+            logger.debug("flight/incident hook failed", exc_info=True)
 
     @property
     def state(self) -> str:

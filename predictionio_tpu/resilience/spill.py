@@ -271,11 +271,19 @@ def scan_wal(path: str) -> dict:
         out["validBytes"] = valid
         out["tornBytes"] = max(os.path.getsize(path) - valid, 0)
         out["pendingBytes"] = max(valid - min(out["cursor"], valid), 0)
-    qpath = path + ".quarantine"
-    if os.path.exists(qpath):
-        with open(qpath) as f:
-            out["quarantined"] = sum(1 for line in f if line.strip())
+    out["quarantined"] = count_quarantined(path)
     return out
+
+
+def count_quarantined(path: str) -> int:
+    """Record count of WAL ``path``'s quarantine sidecar — a line
+    count (the sidecar is one JSON record per line), NOT a WAL
+    frame-walk. The cheap read incident capture uses mid-outage."""
+    qpath = path + ".quarantine"
+    if not os.path.exists(qpath):
+        return 0
+    with open(qpath) as f:
+        return sum(1 for line in f if line.strip())
 
 
 def iter_pending(path: str, limit: Optional[int] = None):
@@ -476,6 +484,12 @@ class SpillReplayer:
             "spill replay: healthy store rejected event %s %d times "
             "(%s) — quarantined to %s; later records resume draining",
             event.event_id, self.quarantine_after, error, qpath)
+        try:
+            from predictionio_tpu.obs.flight import FLIGHT
+            FLIGHT.record("spill_quarantine", eventId=event.event_id,
+                          error=str(error))
+        except Exception:
+            pass
         return True
 
     def drain(self, max_records: Optional[int] = None) -> int:
@@ -544,6 +558,15 @@ class SpillReplayer:
                             n = self.drain()
                             tr.root.attrs["events"] = n
                             tr.discard = n == 0
+                            # inside the trace: the record's traceId
+                            # is the operator's pivot into the
+                            # spill_replay trace just committed
+                            if n:
+                                from predictionio_tpu.obs.flight \
+                                    import FLIGHT
+                                FLIGHT.record(
+                                    "spill_replay", events=n,
+                                    pending=self.wal.pending_count())
                 except Exception:
                     logger.exception("spill replay tick failed")
 

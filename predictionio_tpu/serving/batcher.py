@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Any, Optional
 
+from predictionio_tpu.obs.slo import lock_probe, timed_acquire
+
 logger = logging.getLogger(__name__)
 
 
@@ -138,6 +140,9 @@ class MicroBatcher:
         self.n_shutdown_failed = 0
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
+        # contention probe (ISSUE 6): request threads' wait on the
+        # admission lock, as pio_lock_wait_seconds{lock=batcher_inflight}
+        self._lock_wait = lock_probe("batcher_inflight")
         self.wait_hist = None
         if metrics is not None:
             self.wait_hist = metrics.histogram(
@@ -239,10 +244,14 @@ class MicroBatcher:
             bound = self.queue_wait_bound_s()
             if bound > deadline_s:
                 self.n_shed += 1
+                from predictionio_tpu.obs.flight import FLIGHT
+                FLIGHT.record("shed", coalesce_s=1.0,
+                              waitBoundS=round(bound, 4),
+                              deadlineS=round(deadline_s, 4))
                 raise ShedError(bound, deadline_s)
         p = _Pending(query)
         p.trace_id = TRACER.current_trace_id()
-        with self._flight_lock:
+        with timed_acquire(self._flight_lock, self._lock_wait):
             # check-and-enqueue is atomic with stop()'s set-and-sweep
             # (both under _flight_lock), so no submitter can slip a
             # pending item in after the shutdown sweep ran

@@ -30,8 +30,11 @@ from predictionio_tpu.core.engine import Engine, EngineParams
 from predictionio_tpu.data.event import format_event_time, utcnow
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.models import get_engine_factory
-from predictionio_tpu.obs import (MetricsRegistry, TRACER, get_registry,
-                                  jaxmon, traces_response)
+from predictionio_tpu.obs import (FLIGHT, MetricsRegistry, SLOEngine,
+                                  TRACER, default_engine_specs,
+                                  flight_response, get_incidents,
+                                  get_registry, health_response, jaxmon,
+                                  traces_response)
 from predictionio_tpu.serving.plugins import EngineServerPluginContext
 from predictionio_tpu.utils.http import (HttpServer, Request, Response,
                                          Router)
@@ -162,6 +165,17 @@ class EngineServer:
             "pio_engine_query_seconds",
             "Per-query serving latency (batched queries observe the "
             "window's wall time each)")
+        # diagnostics plane (ISSUE 6): per-executable compile/HBM
+        # attribution, flight-recorder metric context from this
+        # server's families, burn-rate SLOs at GET /health.json, and
+        # an incident-bundle provider exposing serving + lineage state
+        from predictionio_tpu.obs import costmon
+        costmon.install()
+        FLIGHT.add_source(self.metrics)
+        self.slo = SLOEngine(default_engine_specs(),
+                             registries=[self.metrics])
+        get_incidents().register_provider("engine_server",
+                                          self._incident_state)
         # guarded deploys (ISSUE 5): canary controller + rollback
         # anchors. last_good_version tracks the newest version this
         # server trusts (the loaded instance, then every promotion);
@@ -240,6 +254,25 @@ class EngineServer:
                          lambda: int(
                              self.coordinator.health()["poisoned"]))
 
+    def _incident_state(self) -> dict:
+        """Serving + model-lineage state frozen into incident bundles
+        (obs/incidents.py). Lock-free attribute reads — an incident
+        capture must never contend with the query path."""
+        inst = self.engine_instance
+        return {
+            "modelVersion": self.model_version,
+            "lastGoodVersion": self.last_good_version,
+            "engineInstance": getattr(inst, "id", None),
+            "lineage": getattr(inst, "batch", None),
+            "requestCount": self.request_count,
+            "modelSwaps": self.swap_count,
+            "foldIns": self.fold_in_count,
+            "publishDegraded": self.publish_degraded,
+            "publishFailures": self.publish_failures,
+            "modelStalenessSec": self.model_staleness_s(),
+            "canary": self.canary.stats(),
+        }
+
     def _quantile_samples(self):
         with self._lock:
             pct = self._ring_percentiles()
@@ -312,6 +345,8 @@ class EngineServer:
                 self.swap_count += 1  # /reload hot-swap, not first load
             logger.info("Engine instance %s loaded (%d algorithm(s))",
                         instance.id, len(self.algorithms))
+        FLIGHT.record("hot_swap" if was_loaded else "model_load",
+                      model_version=instance.id, source="load")
         return self
 
     def swap_models(self, models, version: Optional[str] = None,
@@ -338,6 +373,9 @@ class EngineServer:
                           or not self.coordinator.multi_process)
         if single_process and self.canary.stage(models, version,
                                                 int(fold_in_events)):
+            FLIGHT.record("canary_staged", model_version=version,
+                          fraction=self.canary.config.fraction,
+                          foldInEvents=int(fold_in_events))
             return
         with self._lock:
             self.models = models
@@ -349,6 +387,9 @@ class EngineServer:
             # a landed swap ends any stale-model degradation window
             self._last_swap_wall = time.time()
             self.publish_degraded = False
+        FLIGHT.record("hot_swap", model_version=version,
+                      source="fold_publish",
+                      foldInEvents=int(fold_in_events))
         logger.info("Hot-swapped models (swap #%d, version %s)",
                     self.swap_count, version or "<in-process>")
 
@@ -407,6 +448,9 @@ class EngineServer:
                 self.last_good_version = self.model_version
                 self._last_swap_wall = time.time()
                 self.publish_degraded = False
+            FLIGHT.record("hot_swap",
+                          model_version=decision["candidateVersion"],
+                          source="canary_promote")
             logger.info("Hot-swapped models after clean canary "
                         "(swap #%d, version %s)", self.swap_count,
                         decision["candidateVersion"] or "<in-process>")
@@ -855,8 +899,21 @@ class EngineServer:
 
     def _traces(self, req: Request) -> Response:
         """GET /traces.json — recent span trees from the process-wide
-        tracer (?n=, ?kind=, ?sort=slowest)."""
+        tracer (?n=, ?kind=, ?trace_id=, ?sort=slowest)."""
         return Response(200, traces_response(req.params))
+
+    def _flight(self, req: Request) -> Response:
+        """GET /flight.json — recent lifecycle wide events from the
+        process flight recorder (?n=, ?kind=, ?trace_id=)."""
+        return Response(200, flight_response(req.params))
+
+    def _health(self, req: Request) -> Response:
+        """GET /health.json — SLO verdicts with fast/slow burn rates
+        (ISSUE 6): serve p99, fold-tick duration, model staleness and
+        the guarded-deploys event budget."""
+        return Response(200, health_response(self.slo, extra={
+            "modelVersion": self.model_version,
+            "publishDegraded": self.publish_degraded}))
 
     def _build_router(self) -> Router:
         r = Router()
@@ -870,6 +927,8 @@ class EngineServer:
         r.add("GET", "/stats.json", self._stats)
         r.add("GET", "/metrics", self._metrics)
         r.add("GET", "/traces.json", self._traces)
+        r.add("GET", "/flight.json", self._flight)
+        r.add("GET", "/health.json", self._health)
         r.add("POST", "/profile.json", self._profile)
         return r
 

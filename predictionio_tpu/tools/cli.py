@@ -63,10 +63,47 @@ def cmd_status(args) -> int:
         return 1
     if getattr(args, "telemetry", False):
         _print_telemetry(args)
+    if getattr(args, "slo", False):
+        _print_slo(args)
     if all(results.values()):
         _print("Your system is all ready to go.")
         return 0
     return 1
+
+
+def _print_slo(args) -> None:
+    """`pio status --slo` (ISSUE 6): each server's /health.json as a
+    compact burn-rate table."""
+    from predictionio_tpu.utils.http import fetch_json as _fetch_json
+    ip = getattr(args, "ip", None) or "127.0.0.1"
+    targets = [
+        ("engine", f"http://{ip}:{getattr(args, 'engine_port', 8000)}"),
+        ("events", f"http://{ip}:"
+                   f"{getattr(args, 'event_server_port', 7070)}"),
+    ]
+    for name, base in targets:
+        _print(f"{name.capitalize()} server SLOs...")
+        h = _fetch_json(f"{base}/health.json")
+        if "error" in h:
+            _print(f"  unreachable: {h['error']}")
+            continue
+        _print(f"  overall: {h.get('status')}")
+        for s in h.get("slo", ()):
+            bits = [f"  {s.get('name', '?'):20s} {s.get('status'):8s}"]
+            if s.get("burnFast") is not None:
+                bits.append(f"burn fast/slow="
+                            f"{s['burnFast']}/{s.get('burnSlow')}")
+            if s.get("rateFast") is not None:
+                bits.append(f"rate={s['rateFast']}/s "
+                            f"(min {s.get('minRate')})")
+            if s.get("value") is not None:
+                bits.append(f"value={round(s['value'], 3)} "
+                            f"(max {s.get('maxValue')})")
+            if s.get("eventsFast") is not None:
+                bits.append(f"events fast/slow={s['eventsFast']}/"
+                            f"{s.get('eventsSlow')} "
+                            f"(budget {s.get('budget')})")
+            _print(" ".join(bits))
 
 
 def _print_hist(name: str, h) -> None:
@@ -743,6 +780,66 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_incidents(args) -> int:
+    """`pio incidents` (ISSUE 6): browse the postmortem bundles the
+    diagnostics plane captured under <PIO_FS_BASEDIR>/incidents/ —
+    list them, replay one as the lifecycle story it froze (flight
+    records in order, trace links, provider states), or export a
+    tar.gz for hand-off."""
+    import json as _json
+
+    from predictionio_tpu.obs.incidents import IncidentManager
+    mgr = IncidentManager(incidents_dir=getattr(args, "dir", None))
+    sub = args.incidents_command
+    if sub == "list":
+        rows = mgr.list_incidents()
+        if not rows:
+            _print(f"No incidents under {mgr.incidents_dir()}.")
+            return 0
+        for r in rows:
+            _print(f"{r['id']:40s} {r.get('kind', '?'):18s} "
+                   f"{r.get('capturedAt', '')}  {r.get('reason', '')}")
+        return 0
+    if sub == "show":
+        try:
+            bundle = mgr.load(args.id)
+        except (OSError, ValueError) as e:
+            _print(f"Cannot load incident {args.id}: {e}")
+            return 1
+        _print(f"Incident {bundle['id']}: {bundle['kind']} — "
+               f"{bundle['reason']}")
+        _print(f"  captured: {bundle.get('capturedAt')}")
+        for name, state in (bundle.get("providers") or {}).items():
+            _print(f"  [{name}] {_json.dumps(state, default=str)}")
+        flight = bundle.get("flight") or []
+        _print(f"  flight records ({len(flight)}, oldest first):")
+        for rec in flight:
+            extra = {k: v for k, v in rec.items()
+                     if k not in ("seq", "t", "kind", "traceId",
+                                  "modelVersion", "metrics")}
+            _print(f"    #{rec.get('seq'):>6} {rec.get('kind', '?'):20s}"
+                   f" trace={rec.get('traceId', '-'):16s}"
+                   f" version={rec.get('modelVersion', '-')} "
+                   f"{_json.dumps(extra, default=str) if extra else ''}")
+        traces = bundle.get("traceDetail") or []
+        if traces:
+            _print(f"  traces ({len(traces)}):")
+            for t in traces:
+                _print(f"    {t.get('kind', '?'):14s} "
+                       f"{t.get('traceId')} links={t.get('links')}")
+        return 0
+    if sub == "export":
+        try:
+            out = mgr.export(args.id, getattr(args, "out", None))
+        except (OSError, FileNotFoundError) as e:
+            _print(f"Export failed: {e}")
+            return 1
+        _print(f"Exported incident {args.id} to {out}.")
+        return 0
+    _print("incidents subcommand must be list|show|export")
+    return 1
+
+
 def _default_spill_path() -> str:
     import os as _os
     from predictionio_tpu.data.storage.registry import base_dir
@@ -850,6 +947,10 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--event-server-port", type=int, default=7070)
     st.add_argument("--accesskey", default="",
                     help="event-server access key for its /stats.json")
+    st.add_argument("--slo", action="store_true",
+                    help="also poll the running servers' /health.json "
+                         "and print each SLO's status and fast/slow "
+                         "burn rates (ISSUE 6)")
     st.set_defaults(func=cmd_status)
 
     b = sub.add_parser("build")
@@ -1117,6 +1218,23 @@ def build_parser() -> argparse.ArgumentParser:
     spr.add_argument("--wal")
     spr.add_argument("-f", "--force", action="store_true")
     spl.set_defaults(func=cmd_spill)
+
+    inc = sub.add_parser(
+        "incidents", help="browse the diagnostics plane's postmortem "
+        "bundles (ISSUE 6): automatic captures from rollbacks, "
+        "sentinel breaches, gate rejections and breaker opens")
+    incsub = inc.add_subparsers(dest="incidents_command", required=True)
+    inl = incsub.add_parser("list")
+    inl.add_argument("--dir", help="incidents dir (default: "
+                     "<PIO_FS_BASEDIR>/incidents)")
+    ins = incsub.add_parser("show")
+    ins.add_argument("id")
+    ins.add_argument("--dir")
+    ine = incsub.add_parser("export")
+    ine.add_argument("id")
+    ine.add_argument("--out", help="output path (default ./<id>.tar.gz)")
+    ine.add_argument("--dir")
+    inc.set_defaults(func=cmd_incidents)
 
     fl = sub.add_parser(
         "faults", help="chaos-harness control: validate a PIO_FAULTS "

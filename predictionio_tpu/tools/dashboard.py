@@ -166,12 +166,19 @@ class Dashboard:
         from predictionio_tpu.obs import traces_response
         return Response(200, traces_response(req.params))
 
+    def _flight(self, req: Request) -> Response:
+        """GET /flight.json — the dashboard process's flight ring
+        (ISSUE 6); per-server rings live on the servers themselves."""
+        from predictionio_tpu.obs import flight_response
+        return Response(200, flight_response(req.params))
+
     def _build_router(self) -> Router:
         r = Router()
         r.add("GET", "/", self._index)
         r.add("GET", "/telemetry", self._telemetry)
         r.add("GET", "/metrics", self._metrics)
         r.add("GET", "/traces.json", self._traces)
+        r.add("GET", "/flight.json", self._flight)
         r.add("GET", "/engine_instances/<id>/evaluator_results.<fmt>",
               self._result)
         return r

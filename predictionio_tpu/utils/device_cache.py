@@ -142,3 +142,24 @@ def drop_resident(name: str):
 def resident_count() -> int:
     with _lock:
         return len(_resident)
+
+
+def _payload_nbytes(obj) -> int:
+    """Device bytes held by a residency payload: dicts/sequences are
+    walked one level deep (fold payloads are flat dicts of device
+    arrays / (array, gram) pairs); anything without ``nbytes`` counts
+    zero."""
+    if isinstance(obj, dict):
+        return sum(_payload_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_payload_nbytes(v) for v in obj)
+    return int(getattr(obj, "nbytes", 0) or 0)
+
+
+def resident_sizes() -> "Dict[str, int]":
+    """name -> device bytes for every live residency slot — the sample
+    source behind ``pio_hbm_table_bytes{table}`` (obs/costmon.py)."""
+    with _lock:
+        items = list(_resident.items())
+    return {name: _payload_nbytes(payload)
+            for name, (_refs, payload) in items}

@@ -80,6 +80,9 @@ def run_train(engine: Engine, engine_params: EngineParams,
     instance = instances.get(instance_id)
     hist = _stage_hist()
     jaxmon.install()
+    from predictionio_tpu.obs.flight import FLIGHT
+    FLIGHT.record("train_start", model_version=instance_id,
+                  engine=engine_id)
     try:
         with TRACER.trace("train", instance=instance_id,
                           engine=engine_id):
@@ -95,10 +98,14 @@ def run_train(engine: Engine, engine_params: EngineParams,
                         Model(instance_id, blob))
             instances.update(instance.with_(status="COMPLETED",
                                             end_time=_now()))
+        FLIGHT.record("train_end", model_version=instance_id,
+                      status="COMPLETED")
         logger.info("Training completed: engine instance %s", instance_id)
         return instance_id
     except Exception:
         logger.error("Training failed:\n%s", traceback.format_exc())
+        FLIGHT.record("train_end", model_version=instance_id,
+                      status="ABORTED")
         instances.update(instance.with_(status="ABORTED", end_time=_now()))
         raise
 

@@ -1,0 +1,125 @@
+"""Metric-name lint (ISSUE 6 satellite): conventions enforced over a
+FULL scrape of both servers, table-driven — every counter ends in
+``_total``, every histogram exposes ``_bucket``/``_sum``/``_count``,
+and no name is registered as different types across the process
+registry and the per-server child registries (the scrape-breaking
+duplicate-registration bug)."""
+
+import re
+
+import pytest
+
+from predictionio_tpu.data.api.event_server import (EventServer,
+                                                    EventServerConfig)
+from predictionio_tpu.obs.metrics import Histogram, get_registry
+from predictionio_tpu.serving.server import EngineServer, ServerConfig
+
+
+@pytest.fixture(scope="module")
+def registries():
+    """Both servers constructed in-process (never started): every
+    family each one mounts, plus the process-wide registry both chain
+    to. Module-scoped — construction is the expensive part."""
+    engine = EngineServer(ServerConfig(ip="127.0.0.1", port=0))
+    events = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                           stats=True))
+    # exercise lazily-registered process families so the scrape is full
+    from predictionio_tpu.obs import costmon
+    from predictionio_tpu.obs.flight import FLIGHT
+    from predictionio_tpu.obs.slo import lock_probe
+    costmon.install()
+    lock_probe("lint")
+    FLIGHT.record("lint")
+    FLIGHT._register_metrics()
+    yield {"engine_server": engine.metrics,
+           "event_server": events.metrics,
+           "process": get_registry()}
+    if engine.batcher is not None:
+        engine.batcher.stop()
+
+
+def _families(reg):
+    return reg.collect(include_parent=True)
+
+
+class TestNamingConventions:
+    def test_every_counter_ends_in_total(self, registries):
+        offenders = [
+            (where, name)
+            for where, reg in registries.items()
+            for name, mtype, _help, _samples in _families(reg)
+            if mtype == "counter" and not name.endswith("_total")]
+        assert not offenders, f"counters missing _total: {offenders}"
+
+    def test_histograms_expose_bucket_sum_count(self, registries):
+        for where, reg in registries.items():
+            for name, mtype, _help, samples in _families(reg):
+                if mtype != "histogram":
+                    continue
+                suffixes = {s[0] for s in samples}
+                assert {"_bucket", "_sum", "_count"} <= suffixes, (
+                    f"{where}:{name} exposes only {suffixes}")
+                # every bucket series carries le=, +Inf present
+                les = [s[1]["le"] for s in samples
+                       if s[0] == "_bucket"]
+                assert les and "+Inf" in les, f"{where}:{name}"
+
+    def test_metric_names_are_prometheus_legal(self, registries):
+        legal = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        for where, reg in registries.items():
+            for name, _mtype, _help, _samples in _families(reg):
+                assert legal.match(name), f"{where}:{name}"
+
+
+class TestNoDuplicateRegistrations:
+    def test_one_type_per_name_across_registries(self, registries):
+        seen = {}
+        for where, reg in registries.items():
+            for name, mtype, _help, _samples in _families(reg):
+                prev = seen.setdefault(name, (where, mtype))
+                assert prev[1] == mtype, (
+                    f"{name} is a {prev[1]} in {prev[0]} but a "
+                    f"{mtype} in {where}")
+
+    def test_no_repeated_type_line_in_one_scrape(self, registries):
+        for where, reg in registries.items():
+            typed = re.findall(r"^# TYPE (\S+) ", reg.render(),
+                               flags=re.M)
+            dupes = {n for n in typed if typed.count(n) > 1}
+            assert not dupes, f"{where} scrape TYPEs twice: {dupes}"
+
+    def test_child_shadowing_preserves_type(self, registries):
+        """A name present in both a child and the process registry
+        must be the same family type (shadowing is allowed, type
+        clashes are not)."""
+        proc = {name: mtype for name, mtype, _h, _s
+                in registries["process"].collect()}
+        for where in ("engine_server", "event_server"):
+            for name, mtype, _h, _s in registries[where].collect(
+                    include_parent=False):
+                if name in proc:
+                    assert proc[name] == mtype, (f"{where}:{name} "
+                                                 "shadows with a "
+                                                 "different type")
+
+
+class TestIssue6FamiliesPresent:
+    """The diagnostics plane's own families ride both scrapes."""
+
+    @pytest.mark.parametrize("name,where", [
+        ("pio_lock_wait_seconds", "process"),
+        ("pio_flight_records_total", "process"),
+        ("pio_flight_dropped_total", "process"),
+        ("pio_compile_executable_seconds_total", "process"),
+        ("pio_compile_cache_hits_total", "process"),
+        ("pio_compile_cache_misses_total", "process"),
+        ("pio_hbm_table_bytes", "process"),
+        ("pio_engine_query_seconds", "engine_server"),
+        ("pio_event_write_seconds", "event_server"),
+    ])
+    def test_family_registered(self, registries, name, where):
+        assert registries[where].get(name) is not None
+
+    def test_lock_wait_is_histogram(self, registries):
+        fam = registries["process"].get("pio_lock_wait_seconds")
+        assert isinstance(fam, Histogram)

@@ -1,0 +1,255 @@
+"""Diagnostics-plane chaos acceptance (ISSUE 6, `-m chaos`; entry
+point scripts/obs_smoke.sh): against the REAL event server -> train ->
+serve -> fold stack, an injected NaN corruption must leave a complete
+forensic story behind —
+
+- the guard rejection automatically captures an incident bundle whose
+  flight records, trace links and registry lineage reconstruct the
+  event -> fold -> gate -> reject chain (`pio incidents show`),
+- GET /health.json flips the guarded-deploys SLO within one fast burn
+  window,
+- serving keeps answering 200 throughout (the recorder is
+  non-blocking by contract),
+
+and with gates disabled + canary on, the watchdog's ROLLBACK likewise
+produces a bundle and burns the SLO."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.core import EngineParams
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.api.event_server import (EventServer,
+                                                    EventServerConfig)
+from predictionio_tpu.data.storage import AccessKey, App, Storage
+from predictionio_tpu.guard.gates import GateRejected
+from predictionio_tpu.models import recommendation as R
+from predictionio_tpu.obs.flight import FLIGHT
+from predictionio_tpu.obs.incidents import get_incidents
+from predictionio_tpu.online.scheduler import (SchedulerConfig,
+                                               attach_scheduler)
+from predictionio_tpu.resilience.faults import reset_env_injector
+from predictionio_tpu.serving import EngineServer, ServerConfig
+from predictionio_tpu.workflow import run_train
+
+pytestmark = pytest.mark.chaos
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=15) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return (resp.status, json.loads(resp.read()),
+                    resp.headers.get("X-PIO-Canary"))
+    except urllib.error.HTTPError as e:
+        return e.code, {}, None
+
+
+def _wait_incident(mgr, kind, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        found = [r for r in mgr.list_incidents() if r["kind"] == kind]
+        if found:
+            return found[0]
+        time.sleep(0.05)
+    return None
+
+
+@pytest.fixture
+def stack(tmp_path, tmp_env, mesh8, request):
+    """Event server (HTTP ingest) + trained engine + engine server +
+    fold scheduler, with the incident manager pointed at a fresh dir."""
+    gates = getattr(request, "param", {}).get("gates", True)
+    canary = getattr(request, "param", {}).get("canary", 0.0)
+    inc = get_incidents()
+    saved = (inc._dir_override, inc.cooldown_s)
+    inc.configure(incidents_dir=str(tmp_path / "incidents"),
+                  cooldown_s=0.0)
+    inc._last_by_kind.clear()
+
+    app_id = Storage.get_meta_data_apps().insert(App(0, "obsapp"))
+    ev = Storage.get_events()
+    ev.init(app_id)
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("obskey", app_id, []))
+    for u in range(6):
+        for i in range(6):
+            ev.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(1 + (u + i) % 5)})),
+                app_id)
+    ep = EngineParams(
+        data_source_params=("", R.DataSourceParams(app_name="obsapp")),
+        preparator_params=("", R.PreparatorParams()),
+        algorithm_params_list=[("als", R.ALSAlgorithmParams(
+            rank=4, num_iterations=2, lam=0.1, seed=1))],
+        serving_params=("", None))
+    engine = R.RecommendationEngineFactory.apply()
+    run_train(engine, ep, engine_id="obs", engine_version="1",
+              engine_variant="v1", engine_factory="recommendation")
+    eserver = EventServer(EventServerConfig(
+        ip="127.0.0.1", port=0, stats=True)).start()
+    server = EngineServer(ServerConfig(
+        ip="127.0.0.1", port=0, engine_id="obs", engine_version="1",
+        engine_variant="v1", micro_batch=0,
+        canary_fraction=canary, canary_window_s=3.0,
+        canary_min_requests=4, canary_nan_tolerance=0))
+    server.load()
+    server.start()
+    sched = attach_scheduler(server, SchedulerConfig(
+        app_name="obsapp", max_deltas=1, gates=gates))
+    try:
+        yield {"server": server, "eserver": eserver, "sched": sched,
+               "events": ev, "app_id": app_id, "incidents": inc}
+    finally:
+        server.stop()
+        eserver.stop()
+        inc._dir_override, inc.cooldown_s = saved
+        reset_env_injector()
+
+
+def _http_burst(eserver, n=4):
+    """Ingest fresh events through the REAL event server so each one
+    gets an ingress trace the fold tick will link. Returns the trace
+    ids the server minted."""
+    tids = []
+    for j in range(n):
+        status, body, _ = _post(
+            eserver.config.port, "/events.json?accessKey=obskey",
+            {"event": "rate", "entityType": "user",
+             "entityId": f"u{j % 6}", "targetEntityType": "item",
+             "targetEntityId": f"i{j % 6}",
+             "properties": {"rating": 5.0}})
+        assert status == 201, body
+        tids.append(body["traceId"])
+    return tids
+
+
+class TestGateRejectionForensics:
+    def test_corrupt_fold_reconstructs_chain_and_burns_slo(
+            self, stack, monkeypatch):
+        server, eserver = stack["server"], stack["eserver"]
+        sched, inc = stack["sched"], stack["incidents"]
+
+        # baseline: SLO engine samples healthy state first
+        status, health = _get(server.config.port, "/health.json")
+        assert status == 200
+        guarded = [s for s in health["slo"]
+                   if s["name"] == "guarded_deploys"][0]
+        assert guarded["status"] in ("ok", "no_data")
+
+        ingest_tids = _http_burst(eserver)
+        monkeypatch.setenv("PIO_FAULTS", "fold.factors:corrupt=1,seed=1")
+        with pytest.raises(GateRejected):
+            sched.tick(force=True)
+        monkeypatch.delenv("PIO_FAULTS")
+        reset_env_injector()
+
+        # -- flight chain: gate_verdict record carries the tick trace
+        verdicts = FLIGHT.snapshot(kind="gate_verdict", limit=5)
+        assert verdicts and verdicts[0]["passed"] is False
+        tick_tid = verdicts[0]["traceId"]
+        assert tick_tid
+
+        # -- incident bundle captured automatically
+        row = _wait_incident(inc, "gate_rejected")
+        assert row is not None, "gate rejection produced no bundle"
+        bundle = inc.load(row["id"])
+        # registry lineage + provider states
+        assert bundle["providers"]["engine_server"]["modelVersion"] \
+            == server.model_version
+        assert "scheduler" in bundle["providers"]
+        assert bundle["context"]["gateReport"]["passed"] is False
+        # the frozen flight tail holds the chain
+        kinds = [r["kind"] for r in bundle["flight"]]
+        assert "gate_verdict" in kinds
+        # trace links reconstruct event -> fold: the bundled fold_tick
+        # trace links the HTTP-ingested events' traces
+        tick_traces = [t for t in bundle["traceDetail"]
+                       if t["traceId"] == tick_tid]
+        assert tick_traces, "fold tick trace missing from bundle"
+        assert set(ingest_tids) & set(tick_traces[0]["links"])
+        # the live server walks the same chain via ?trace_id=
+        status, related = _get(
+            server.config.port, f"/traces.json?trace_id={tick_tid}")
+        related_ids = {t["traceId"] for t in related["traces"]}
+        assert tick_tid in related_ids
+        assert set(ingest_tids) & related_ids
+
+        # -- pio incidents show replays the story
+        from predictionio_tpu.tools.cli import main
+        assert main(["incidents", "show", row["id"],
+                     "--dir", inc.incidents_dir()]) == 0
+
+        # -- /health.json flips the SLO within one fast burn window
+        status, health = _get(server.config.port, "/health.json")
+        guarded = [s for s in health["slo"]
+                   if s["name"] == "guarded_deploys"][0]
+        assert guarded["status"] == "breached"
+        assert health["status"] == "breached"
+
+        # -- serving never blocked on the diagnostics plane
+        status, body, _ = _post(server.config.port, "/queries.json",
+                                {"user": "u1", "num": 3})
+        assert status == 200 and body.get("itemScores") is not None
+
+
+@pytest.mark.parametrize("stack", [{"gates": False, "canary": 0.25}],
+                         indirect=True)
+class TestCanaryRollbackForensics:
+    def test_rollback_captures_incident_and_burns_slo(
+            self, stack, monkeypatch):
+        server, sched = stack["server"], stack["sched"]
+        ev, app_id, inc = (stack["events"], stack["app_id"],
+                           stack["incidents"])
+        _get(server.config.port, "/health.json")   # SLO baseline
+
+        for j in range(4):
+            ev.insert(Event(
+                event="rate", entity_type="user",
+                entity_id=f"u{j % 6}", target_entity_type="item",
+                target_entity_id=f"i{j % 6}",
+                properties=DataMap({"rating": 5.0})), app_id)
+        monkeypatch.setenv("PIO_FAULTS", "fold.factors:corrupt=1,seed=1")
+        report = sched.tick(force=True)
+        monkeypatch.delenv("PIO_FAULTS")
+        reset_env_injector()
+        assert report is not None          # published -> staged canary
+        assert server.canary.active
+
+        # query until the watchdog sees poisoned canary answers and
+        # rolls back
+        deadline = time.monotonic() + 20.0
+        while server.canary.active and time.monotonic() < deadline:
+            _post(server.config.port, "/queries.json",
+                  {"user": "u1", "num": 3})
+        decision = server.canary.last_decision
+        assert decision and decision["decision"] == "rollback"
+
+        kinds = [r["kind"] for r in FLIGHT.tail(100)]
+        assert "canary_staged" in kinds
+        assert "canary_rollback" in kinds
+
+        row = _wait_incident(inc, "canary_rollback")
+        assert row is not None, "rollback produced no bundle"
+        bundle = inc.load(row["id"])
+        assert bundle["context"]["decision"] == "rollback"
+
+        status, health = _get(server.config.port, "/health.json")
+        guarded = [s for s in health["slo"]
+                   if s["name"] == "guarded_deploys"][0]
+        assert guarded["status"] == "breached"

@@ -1,0 +1,322 @@
+"""SLO engine + contention probes (ISSUE 6 tentpole piece 4):
+burn-rate evaluation from registry histograms under an injected
+clock, every spec kind, /health.json rendering, and the
+pio_lock_wait_seconds probe."""
+
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.obs.metrics import MetricsRegistry, get_registry
+from predictionio_tpu.obs.slo import (SLOEngine, SLOSpec,
+                                      default_engine_specs,
+                                      default_event_specs,
+                                      health_response, lock_probe,
+                                      timed_acquire)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+@pytest.fixture
+def reg():
+    return MetricsRegistry()
+
+
+def latency_spec(**kw):
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    return SLOSpec("serve_p99", "latency", ("h_seconds",),
+                   objective=0.99, threshold_s=0.25, **kw)
+
+
+class TestLatencyBurn:
+    def test_healthy_traffic_is_ok(self, reg):
+        h = reg.histogram("h_seconds", "x")
+        clock = FakeClock()
+        eng = SLOEngine([latency_spec()], registries=[reg],
+                        clock=clock)
+        eng.evaluate()                       # baseline sample
+        for _ in range(200):
+            h.observe(0.01)
+        clock.advance(30)
+        out = eng.evaluate()
+        s = out["slo"][0]
+        assert s["status"] == "ok"
+        assert s["burnFast"] == 0.0
+        assert out["status"] == "ok"
+
+    def test_bad_tail_breaches_within_one_fast_window(self, reg):
+        h = reg.histogram("h_seconds", "x")
+        clock = FakeClock()
+        eng = SLOEngine([latency_spec()], registries=[reg],
+                        clock=clock)
+        eng.evaluate()
+        for _ in range(100):
+            h.observe(0.01)
+        for _ in range(50):                  # 33% over threshold
+            h.observe(1.0)
+        clock.advance(45)                    # inside one fast window
+        out = eng.evaluate()
+        s = out["slo"][0]
+        # bad fraction 1/3 against a 1% budget: burn ~33x >> 14x
+        assert s["burnFast"] > 14
+        assert s["status"] == "breached"
+        assert out["status"] == "breached"
+
+    def test_no_traffic_is_no_data_not_breach(self, reg):
+        reg.histogram("h_seconds", "x")
+        clock = FakeClock()
+        eng = SLOEngine([latency_spec()], registries=[reg],
+                        clock=clock)
+        eng.evaluate()
+        clock.advance(30)
+        s = eng.evaluate()["slo"][0]
+        assert s["status"] == "no_data"
+
+    def test_old_burn_drains_past_the_window(self, reg):
+        h = reg.histogram("h_seconds", "x")
+        clock = FakeClock()
+        eng = SLOEngine([latency_spec()], registries=[reg],
+                        clock=clock)
+        eng.evaluate()
+        for _ in range(50):
+            h.observe(1.0)                   # the fire
+        clock.advance(30)
+        assert eng.evaluate()["slo"][0]["status"] == "breached"
+        # fire ends; healthy traffic resumes past the fast window
+        for _ in range(20):
+            clock.advance(40)
+            for _ in range(100):
+                h.observe(0.01)
+            eng.evaluate()
+        s = eng.evaluate()["slo"][0]
+        assert s["burnFast"] == 0.0
+        assert s["status"] == "ok"
+
+    def test_missing_family_is_no_data(self, reg):
+        eng = SLOEngine([latency_spec()], registries=[reg],
+                        clock=FakeClock())
+        assert eng.evaluate()["slo"][0]["status"] == "no_data"
+
+
+class TestOtherKinds:
+    def test_counter_budget_flips_on_first_event(self, reg):
+        c = reg.counter("g_rollbacks_total", "x")
+        spec = SLOSpec("guarded", "counter_budget",
+                       ("g_rollbacks_total",), budget=0,
+                       fast_window_s=60, slow_window_s=600)
+        clock = FakeClock()
+        eng = SLOEngine([spec], registries=[reg], clock=clock)
+        eng.evaluate()
+        clock.advance(10)
+        assert eng.evaluate()["slo"][0]["status"] == "ok"
+        c.inc()
+        clock.advance(10)
+        s = eng.evaluate()["slo"][0]
+        assert s["status"] == "breached"
+        assert s["eventsFast"] == 1.0
+
+    def test_counter_budget_sums_multiple_metrics(self, reg):
+        reg.counter("a_total", "x")
+        b = reg.counter("b_total", "x")
+        spec = SLOSpec("guarded", "counter_budget",
+                       ("a_total", "b_total"), budget=0)
+        clock = FakeClock()
+        eng = SLOEngine([spec], registries=[reg], clock=clock)
+        eng.evaluate()
+        b.inc()
+        clock.advance(5)
+        assert eng.evaluate()["slo"][0]["status"] == "breached"
+
+    def test_rate_min_breaches_when_traffic_stalls(self, reg):
+        h = reg.histogram("w_seconds", "x")
+        spec = SLOSpec("ingest_rate", "rate_min", ("w_seconds",),
+                       min_rate=10.0, fast_window_s=60,
+                       slow_window_s=600)
+        clock = FakeClock()
+        eng = SLOEngine([spec], registries=[reg], clock=clock)
+        eng.evaluate()
+        for _ in range(1200):
+            h.observe(0.001)
+        clock.advance(60)
+        assert eng.evaluate()["slo"][0]["status"] == "ok"   # 20 ev/s
+        clock.advance(60)                  # stall: nothing new
+        s = eng.evaluate()["slo"][0]
+        assert s["status"] == "breached"
+        assert s["rateFast"] == 0.0
+
+    def test_rate_min_full_stall_breaches_not_no_data(self, reg):
+        """A stream that HAD traffic and stalled to zero across BOTH
+        windows is the worst outage — it must breach, not hide behind
+        no_data (only a never-any-traffic stream is no_data)."""
+        h = reg.histogram("w_seconds", "x")
+        spec = SLOSpec("ingest_rate", "rate_min", ("w_seconds",),
+                       min_rate=10.0, fast_window_s=60,
+                       slow_window_s=120)
+        clock = FakeClock()
+        eng = SLOEngine([spec], registries=[reg], clock=clock)
+        eng.evaluate()
+        for _ in range(100):
+            h.observe(0.001)
+        clock.advance(60)
+        eng.evaluate()
+        for _ in range(10):            # long dead: stall > slow window
+            clock.advance(60)
+            eng.evaluate()
+        s = eng.evaluate()["slo"][0]
+        assert s["rateFast"] == 0.0 and s["rateSlow"] == 0.0
+        assert s["status"] == "breached"
+
+    def test_rate_min_zero_is_advisory(self, reg):
+        reg.histogram("w_seconds", "x")
+        spec = SLOSpec("ingest_rate", "rate_min", ("w_seconds",),
+                       min_rate=0.0)
+        clock = FakeClock()
+        eng = SLOEngine([spec], registries=[reg], clock=clock)
+        eng.evaluate()
+        clock.advance(30)
+        assert eng.evaluate()["slo"][0]["status"] == "no_data"
+
+    def test_gauge_max(self, reg):
+        g = reg.gauge("staleness_seconds", "x")
+        spec = SLOSpec("staleness", "gauge_max",
+                       ("staleness_seconds",), max_value=600.0)
+        eng = SLOEngine([spec], registries=[reg], clock=FakeClock())
+        g.set(30.0)
+        assert eng.evaluate()["slo"][0]["status"] == "ok"
+        g.set(1200.0)
+        s = eng.evaluate()["slo"][0]
+        assert s["status"] == "breached" and s["value"] == 1200.0
+
+
+class TestDefaultsAndSurface:
+    def test_default_specs_resolve_known_families(self):
+        names = {s.name for s in default_engine_specs()}
+        assert {"serve_p99", "fold_tick_duration", "model_staleness",
+                "guarded_deploys"} <= names
+        names = {s.name for s in default_event_specs()}
+        assert {"ingest_write_p99", "ingest_rate",
+                "ingest_durability"} <= names
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("PIO_SLO_SERVE_P99_MS", "100")
+        monkeypatch.setenv("PIO_SLO_FAST_WINDOW_S", "5")
+        spec = [s for s in default_engine_specs()
+                if s.name == "serve_p99"][0]
+        assert spec.threshold_s == 0.1
+        assert spec.fast_window_s == 5.0
+
+    def test_health_response_shape(self, reg):
+        h = reg.histogram("h_seconds", "x")
+        h.observe(0.01)
+        eng = SLOEngine([latency_spec()], registries=[reg])
+        out = health_response(eng, extra={"modelVersion": "v1"})
+        assert out["status"] in ("ok", "burning", "breached")
+        assert out["modelVersion"] == "v1"
+        assert out["slo"][0]["name"] == "serve_p99"
+
+    def test_health_response_without_engine(self):
+        out = health_response(None)
+        assert out == {"status": "ok", "slo": []}
+
+
+class TestLockProbe:
+    def test_uncontended_wait_observed(self):
+        probe = lock_probe("test_lock")
+        before = probe.count
+        lk = threading.Lock()
+        with timed_acquire(lk, probe):
+            pass
+        assert probe.count == before + 1
+        assert not lk.locked()
+
+    def test_contended_wait_measured(self):
+        probe = lock_probe("test_lock_contended")
+        lk = threading.Lock()
+        lk.acquire()
+        t = threading.Timer(0.05, lk.release)
+        t.start()
+        t0 = time.perf_counter()
+        with timed_acquire(lk, probe):
+            waited = time.perf_counter() - t0
+        assert waited >= 0.04
+        assert (probe.percentile(99) or 0) >= 0.01
+
+    def test_release_on_exception(self):
+        probe = lock_probe("test_lock_exc")
+        lk = threading.Lock()
+        with pytest.raises(ValueError):
+            with timed_acquire(lk, probe):
+                raise ValueError("boom")
+        assert not lk.locked()
+
+    def test_family_is_labeled_histogram_on_process_registry(self):
+        lock_probe("test_family")
+        fam = get_registry().get("pio_lock_wait_seconds")
+        assert fam is not None and fam.mtype == "histogram"
+        assert fam.labelnames == ("lock",)
+
+
+class TestHistorySpansWindows:
+    def test_fast_polling_cannot_shrink_the_slow_window(self, reg):
+        """/health.json is polled by load balancers at arbitrary rates;
+        per-poll history appends would cap the deque's time span at
+        max_samples/poll_rate seconds, silently clearing a breached
+        SLO once the triggering event rotated out. Appends are spaced
+        so max_samples always covers the slow window."""
+        c = reg.counter("pio_guard_gate_rejects_total", "x")
+        spec = SLOSpec("guarded_deploys", "counter_budget",
+                       ("pio_guard_gate_rejects_total",),
+                       budget=0.0, fast_window_s=10.0,
+                       slow_window_s=100.0)
+        clock = FakeClock()
+        eng = SLOEngine([spec], registries=[reg], clock=clock,
+                        max_samples=8)
+        eng.evaluate()                       # baseline at t0
+        clock.advance(5)
+        c.inc()                              # the incident, t0+5
+        # poll every second for 50 s: with naive per-poll appends the
+        # 8-slot history would span 8 s and the slow baseline would
+        # postdate the incident
+        for _ in range(50):
+            clock.advance(1)
+            out = eng.evaluate()
+        s = out["slo"][0]
+        assert s["eventsSlow"] == 1.0, \
+            "incident rotated out of the slow window history"
+        assert s["status"] == "breached"
+        assert len(eng._history) <= 8
+
+
+class TestSlowBurnAlone:
+    def test_sustained_sub_fast_burn_surfaces_as_burning(self, reg):
+        """A steady 8x budget burn (8% bad at objective 0.99) sits
+        below fast_burn=14 but above slow_burn=6; it must surface as
+        'burning', not read 'ok' forever while the budget drains."""
+        h = reg.histogram("h_seconds", "x")
+        clock = FakeClock()
+        eng = SLOEngine([latency_spec()], registries=[reg],
+                        clock=clock)
+        eng.evaluate()                       # baseline sample
+        out = None
+        for _ in range(12):                  # 12 min > slow window
+            clock.advance(60)
+            for _ in range(92):
+                h.observe(0.01)
+            for _ in range(8):
+                h.observe(0.5)               # 8% over threshold
+            out = eng.evaluate()
+        s = out["slo"][0]
+        assert s["burnSlow"] is not None and s["burnSlow"] >= 6
+        assert s["burnFast"] is not None and s["burnFast"] < 14
+        assert s["status"] == "burning"
