@@ -41,10 +41,12 @@ class Stats:
             self._window_start = now
 
     def update(self, app_id: int, event_name: str, entity_type: str,
-               status: int):
+               status: int, n: int = 1):
+        """``n`` lets the columnar bulk-write route count a whole batch
+        of identical (event, entityType) rows in one lock acquisition."""
         with self._lock:
             self._maybe_rotate()
-            self._current[(app_id, event_name, entity_type, status)] += 1
+            self._current[(app_id, event_name, entity_type, status)] += n
 
     def _render(self, counters: Dict[Tuple, int], app_id: Optional[int]):
         by_event: Dict[str, int] = defaultdict(int)

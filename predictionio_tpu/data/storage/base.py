@@ -301,6 +301,15 @@ class Events(abc.ABC):
                      channel_id: Optional[int] = None) -> List[str]:
         return [self.insert(e, app_id, channel_id) for e in events]
 
+    def insert_columnar(self, batch, app_id: int,
+                        channel_id: Optional[int] = None) -> List[str]:
+        """Bulk write from a ``ColumnarBatch`` of parallel arrays (the
+        /events/columnar.json write mode, ISSUE 7). The default
+        materializes ``Event`` objects and rides ``insert_batch``;
+        backends with a vectorized path (nativelog, sqlite) override to
+        skip the per-event object round trip entirely."""
+        return self.insert_batch(batch.to_events(), app_id, channel_id)
+
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]: ...

@@ -115,6 +115,9 @@ class RemoteEvents(base.Events):
                                   base_delay_s=0.1, max_delay_s=5.0)
         self._app_id: Optional[int] = None   # learned lazily, then pinned
         self._local = threading.local()
+        # flipped (once) by a 404 from the columnar write route: a
+        # pre-ISSUE-7 server — bulk writes fall back to chunked /batch
+        self._no_columnar_write = False
 
     # -- transport ----------------------------------------------------------
     def _conn(self) -> http.client.HTTPConnection:
@@ -247,11 +250,93 @@ class RemoteEvents(base.Events):
             raise RemoteError(status, (body or {}).get("message", ""))
         return body["eventId"]
 
+    #: rows per columnar bulk-write POST (~4 MB of typical JSON; the
+    #: server's default bound is 1M rows)
+    COLUMNAR_WRITE_PAGE = 100_000
+
+    def bulk_create(self, events: Sequence[Event], app_id,
+                    channel_id=None) -> List[str]:
+        """Bulk ingest as ONE ``POST /events/columnar.json`` write per
+        page — one parse and one bulk insert server-side instead of
+        ceil(n/50) object-array batches (ISSUE 7). Ids are assigned
+        client-side first (re-send idempotency, as insert). A 404 from
+        a pre-columnar server falls back to chunked /batch posts, once
+        per client. Any per-record failure raises RemoteError with the
+        first failure's status, matching insert_batch."""
+        params = self._params(app_id, channel_id)
+        evs = [self._with_id(e) for e in events]
+        if not evs:
+            return []
+        # the columnar wire has no tags/prId columns — events carrying
+        # either must take the object /batch path or the server would
+        # 201 them with the fields silently dropped. (creationTime is
+        # server-assigned metadata on the columnar route, matching the
+        # reference's server-side stamping.)
+        if (self._no_columnar_write
+                or any(e.tags or e.pr_id for e in evs)):
+            return self._insert_batch_objects(evs, params)
+        from predictionio_tpu.data.columnar import events_to_wire
+        ids: List[str] = []
+        for lo in range(0, len(evs), self.COLUMNAR_WRITE_PAGE):
+            page = evs[lo:lo + self.COLUMNAR_WRITE_PAGE]
+            status, body = self._request(
+                "POST", "/events/columnar.json", params,
+                events_to_wire(page))
+            if status == 404:
+                # pre-ISSUE-7 server: no columnar write route
+                self._no_columnar_write = True
+                return ids + self._insert_batch_objects(evs[lo:], params)
+            if status not in (200, 201):
+                raise RemoteError(status, (body or {}).get("message", ""))
+            fails = (body or {}).get("failures")
+            if fails:
+                f = fails[0]
+                raise RemoteError(f.get("status", 400),
+                                  f.get("message", ""))
+            ids.extend(e.event_id for e in page)
+        return ids
+
     def insert_batch(self, events: Sequence[Event], app_id,
                      channel_id=None) -> List[str]:
+        return self.bulk_create(events, app_id, channel_id)
+
+    def insert_columnar(self, batch, app_id, channel_id=None):
+        """Forward the parallel arrays as ONE wire body per page — no
+        Event materialization on either side when the server has the
+        columnar write route."""
         params = self._params(app_id, channel_id)
+        if batch.n == 0:
+            return []
+        if self._no_columnar_write:
+            return super().insert_columnar(batch, app_id, channel_id)
         ids: List[str] = []
-        evs = [self._with_id(e) for e in events]
+        for lo in range(0, batch.n, self.COLUMNAR_WRITE_PAGE):
+            page = batch.slice_rows(lo, min(lo + self.COLUMNAR_WRITE_PAGE,
+                                            batch.n))
+            body = page.to_wire()
+            if page.event_id is None:
+                body["returnIds"] = True
+            status, resp = self._request("POST", "/events/columnar.json",
+                                         params, body)
+            if status == 404:
+                self._no_columnar_write = True
+                return ids + super().insert_columnar(
+                    batch.slice_rows(lo, batch.n), app_id, channel_id)
+            if status not in (200, 201):
+                raise RemoteError(status, (resp or {}).get("message", ""))
+            fails = (resp or {}).get("failures")
+            if fails:
+                f = fails[0]
+                raise RemoteError(f.get("status", 400),
+                                  f.get("message", ""))
+            ids.extend(page.event_id if page.event_id is not None
+                       else resp.get("eventIds", []))
+        return ids
+
+    def _insert_batch_objects(self, evs: Sequence[Event],
+                              params: dict) -> List[str]:
+        """The pre-columnar wire shape: chunked /batch/events.json."""
+        ids: List[str] = []
         for lo in range(0, len(evs), MAX_BATCH):
             status, body = self._request(
                 "POST", "/batch/events.json", params,

@@ -318,6 +318,28 @@ class MemEvents(base.Events):
                     event.target_entity_id, set()).add(eid)
         return eid
 
+    def insert_batch(self, events, app_id, channel_id=None):
+        """One lock acquisition for the whole batch (the base default
+        re-enters insert — and thus the RLock — per event; ISSUE 7)."""
+        table = self._table(app_id, channel_id, create=True)
+        key = (app_id, channel_id)
+        eids = []
+        with self._lock:
+            by_ent, by_tgt = self._by_entity[key], self._by_target[key]
+            for event in events:
+                eid = event.event_id or new_event_id()
+                eids.append(eid)
+                old = table.get(eid)
+                if old is not None:
+                    self._unindex(key, eid, old)
+                table[eid] = event.with_id(eid)
+                if event.entity_id:
+                    by_ent.setdefault(event.entity_id, set()).add(eid)
+                if event.target_entity_id:
+                    by_tgt.setdefault(event.target_entity_id,
+                                      set()).add(eid)
+        return eids
+
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
         table = self._table(app_id, channel_id)
         return table.get(event_id) if table else None

@@ -28,22 +28,38 @@ silently miss records).
 
 from __future__ import annotations
 
-import contextlib
+import collections
 import ctypes
 import json
 import os
 import subprocess
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
-from predictionio_tpu.data.event import Event, new_event_id, to_millis
+import numpy as np
+
+from predictionio_tpu.data.event import (Event, format_event_time,
+                                         new_event_id, new_event_ids,
+                                         parse_event_time, to_millis,
+                                         utcnow)
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import ABSENT
 from predictionio_tpu.obs.slo import lock_probe, timed_acquire
 
 _LIB_LOCK = threading.Lock()
 _LIB = None
+#: GIL-HOLDING twin of _LIB (ctypes.PyDLL), used for the SHORT commit-
+#: path calls (small group appends, flush). A CDLL call releases the
+#: GIL and must re-acquire it on return — under 8 concurrent writers
+#: that handoff costs ~1 ms per call (measured), dwarfing the ~90 us
+#: of C work and inverting the concurrent-vs-serial ordering
+#: (BENCH_r05). Holding the GIL for a sub-100 us append is cheaper for
+#: everyone. Long calls (bulk blocks, scans) stay on _LIB. Safe
+#: because the Python wrapper serializes per-handle access with its
+#: own locks, so a GIL-holding call never waits on the C mutex.
+_PYLIB = None
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
@@ -51,14 +67,55 @@ _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", "libpio_eventlog.so")
 
 
+def _so_is_stale() -> bool:
+    if not os.path.exists(_SO_PATH):
+        return True
+    try:
+        src = os.path.join(_NATIVE_DIR, "eventlog.cpp")
+        return os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+    except OSError:
+        return False
+
+
 def _load_lib():
-    global _LIB
+    global _LIB, _PYLIB
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB
-        if not os.path.exists(_SO_PATH):
+        if _so_is_stale():
             subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                            capture_output=True)
+        pylib = ctypes.PyDLL(_SO_PATH)
+        pylib.el_hash.restype = ctypes.c_uint64
+        pylib.el_hash.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+        pylib.el_append_batch.restype = ctypes.c_int64
+        pylib.el_append_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        pylib.el_flush.argtypes = [ctypes.c_void_p]
+        pylib.el_sync.restype = ctypes.c_int
+        pylib.el_sync.argtypes = [ctypes.c_void_p]
+        # el_exists is a ~1 us in-memory index probe, but the insert
+        # path calls it once per OTHER file (the partitions>1
+        # caller-supplied-id overwrite check) — through the
+        # GIL-releasing binding each probe pays a GIL reacquisition
+        # that costs ~1 ms under concurrent request threads
+        pylib.el_exists.restype = ctypes.c_int
+        pylib.el_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int32]
+        # the fsync loop calls el_flush_dup UNDER the per-handle append
+        # lock; through the GIL-releasing binding its reacquisition
+        # wait (~ms when request threads are busy) extends that lock
+        # hold and convoys the group committers behind a us-scale
+        # fflush+dup
+        pylib.el_flush_dup.restype = ctypes.c_int
+        pylib.el_flush_dup.argtypes = [ctypes.c_void_p]
+        _PYLIB = pylib
         lib = ctypes.CDLL(_SO_PATH)
         lib.el_open.restype = ctypes.c_void_p
         lib.el_open.argtypes = [ctypes.c_char_p]
@@ -79,6 +136,26 @@ def _load_lib():
         lib.el_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.c_int32]
         lib.el_flush.argtypes = [ctypes.c_void_p]
+        lib.el_sync.restype = ctypes.c_int
+        lib.el_sync.argtypes = [ctypes.c_void_p]
+        lib.el_flush_dup.restype = ctypes.c_int
+        lib.el_flush_dup.argtypes = [ctypes.c_void_p]
+        lib.el_append_batch.restype = ctypes.c_int64
+        lib.el_append_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.el_exists.restype = ctypes.c_int
+        lib.el_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int32]
+        lib.el_hash_batch.restype = None
+        lib.el_hash_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_uint64)]
         lib.el_scan.restype = ctypes.c_int64
         lib.el_scan.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
@@ -125,7 +202,9 @@ _INT64_MIN = -(2 ** 63)
 
 def _hash(lib, s: str) -> int:
     b = s.encode("utf-8")
-    return lib.el_hash(b, len(b))
+    # PyDLL when loaded: a ~1 us hash must not release the GIL — the
+    # reacquisition under concurrent writers costs ~1000x the hash
+    return (_PYLIB or lib).el_hash(b, len(b))
 
 
 class StorageClient:
@@ -156,7 +235,6 @@ class StorageClient:
 
 
 _LEGACY = -1  # partition index of a pre-partitioning single log file
-_NULL_CTX = contextlib.nullcontext()  # reentrant and reusable
 
 
 class _EntityIndex:
@@ -260,16 +338,25 @@ class _EntityIndex:
 
     # -- incremental append -------------------------------------------------
     def add(self, ent: str, tgt: str, eid: str):
+        self.add_many([(ent, tgt, eid)])
+
+    def add_many(self, entries):
+        """Group append: ONE write + ONE flush for the whole group —
+        the per-partition committer's sidecar path (a per-event flush
+        here was part of the foreground-writer contention ISSUE 7
+        retires)."""
         with self.lock:
             if not self.loaded:
-                self._pending.append((ent, tgt, eid))
+                self._pending.extend(entries)
                 return
             if self._fh is None:
                 self._fh = open(self.path, "a")
-            self._fh.write(json.dumps([ent, tgt, eid],
-                                      separators=(",", ":")) + "\n")
+            self._fh.write("".join(
+                json.dumps(list(e), separators=(",", ":")) + "\n"
+                for e in entries))
             self._fh.flush()
-            self._remember(ent, tgt, eid)
+            for ent, tgt, eid in entries:
+                self._remember(ent, tgt, eid)
 
     def candidate_ids(self, entity_ids, target_entity_ids) -> List[str]:
         with self.lock:
@@ -315,6 +402,477 @@ class _EntityIndex:
                     os.remove(p)
 
 
+#: one framed record on its way into a sub-log: everything the C append
+#: needs plus the entity-index sidecar line (ent, tgt, eid)
+_Record = collections.namedtuple(
+    "_Record", "key payload ts ehash nhash thash ent tgt eid")
+
+#: reused compact-JSON encoder for properties cells: per-call
+#: json.dumps(separators=...) constructs a fresh JSONEncoder every
+#: time — measured ~40% of the columnar bulk loop
+_PROPS_ENCODE = json.JSONEncoder(separators=(",", ":")).encode
+
+
+def _props_frag(p, _enc=json.encoder.encode_basestring_ascii,
+                _dumps=_PROPS_ENCODE) -> str:
+    """One properties cell as a compact JSON fragment. The telemetry-
+    shaped single-scalar dict ({"rating": 4.0}) formats inline;
+    everything else takes the reused encoder. Non-finite floats fall
+    through to the encoder so their spelling matches json.dumps."""
+    if not p:
+        return "{}"
+    if len(p) == 1:
+        k, v = next(iter(p.items()))
+        tv = type(v)
+        if tv is int or (tv is float and -1e308 < v < 1e308):
+            return f"{{{_enc(k)}:{v!r}}}"
+        if tv is str:
+            return f"{{{_enc(k)}:{_enc(v)}}}"
+    return _dumps(p)
+
+
+def _props_col(props) -> List[str]:
+    """The properties column as JSON fragments, memoized per batch:
+    telemetry-shaped loads draw single-scalar dicts from a tiny
+    vocabulary ({"rating": 1.0..5.0}), so the (key, value) pair is a
+    hashable cache key and repeated cells skip the format entirely.
+    Multi-key / non-scalar cells fall through to _props_frag."""
+    cache: dict = {}
+    get = cache.get
+    out = []
+    ap = out.append
+    for p in props:
+        if not p:
+            ap("{}")
+            continue
+        if len(p) == 1:
+            kv = next(iter(p.items()))
+            vt = type(kv[1])
+            if vt in (int, float, str):
+                # the type joins the key: 1 == 1.0 (same hash), and a
+                # plain (key, value) memo would hand the float row the
+                # int row's fragment, silently retyping the stored
+                # value
+                ck = (kv[0], kv[1], vt)
+                f = get(ck)
+                if f is None:
+                    cache[ck] = f = _props_frag(p)
+                ap(f)
+                continue
+        ap(_props_frag(p))
+    return out
+
+
+#: a PRE-FRAMED group from the columnar bulk path: the ctypes-ready
+#: arrays el_append_batch consumes, built vectorized OUTSIDE any lock
+#: (numpy int arrays, one hash-batch FFI call, joined byte runs), so
+#: the committer only passes pointers. ents/tgts/eids are the raw id
+#: columns — sidecar lines materialize only when the shard actually
+#: carries a loaded entity index.
+_Block = collections.namedtuple(
+    "_Block", "n keys keylens datas datalens ts eh nh th ents tgts eids")
+
+_INGEST_GROUP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                         2048, 4096)
+_INGEST_COMMIT_BUCKETS = (1e-5, 5e-5, 2.5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
+                          2.5e-2, 0.1, 0.5, 2.0)
+_ingest_metrics_cache = None
+
+
+def _ingest_metrics():
+    """(group_size, commit_seconds) histograms on the process registry
+    (ISSUE 7 obs): how many records each group commit absorbs, and what
+    one commit costs wall-clock."""
+    global _ingest_metrics_cache
+    if _ingest_metrics_cache is None:
+        from predictionio_tpu.obs.metrics import get_registry
+        reg = get_registry()
+        _ingest_metrics_cache = (
+            reg.histogram(
+                "pio_ingest_group_size",
+                "Records per nativelog group commit",
+                buckets=_INGEST_GROUP_BUCKETS),
+            reg.histogram(
+                "pio_ingest_commit_seconds",
+                "Wall time of one nativelog group commit (sidecar + "
+                "batch append + flush)",
+                buckets=_INGEST_COMMIT_BUCKETS))
+    return _ingest_metrics_cache
+
+
+def _group_commit_ms() -> float:
+    """PIO_INGEST_GROUP_COMMIT_MS: the async-fsync cadence — how far
+    durability-to-disk may lag an ack. Acks always wait for the group's
+    flush-to-OS (a SIGKILL cannot lose an acked event); fsync covers
+    power loss/host crash. ``0`` = fsync synchronously inside every
+    group commit (strict); ``<0`` = never fsync (the pre-ISSUE-7
+    behavior); default 2 ms."""
+    try:
+        return float(os.environ.get("PIO_INGEST_GROUP_COMMIT_MS", "2"))
+    except (TypeError, ValueError):
+        return 2.0
+
+
+def _gc_nap_budget_s(fsync_ms: float) -> float:
+    """Upper bound on the leader's group-formation wait: half the
+    PIO_INGEST_GROUP_COMMIT_MS ack-latency knob, clamped to [0.2, 2]
+    ms. Strict-sync (0) and never-fsync (<0) stores still benefit from
+    grouping, so they get the default 1 ms."""
+    if fsync_ms <= 0:
+        return 0.001
+    return min(max(fsync_ms / 2000.0, 0.0002), 0.002)
+
+
+class _Submission:
+    """One writer's stake in a group commit: the records (or one
+    pre-framed columnar block) it enqueued, an event its committer
+    completes, and the error slot."""
+
+    __slots__ = ("records", "block", "done", "error")
+
+    def __init__(self, records, block: Optional[_Block] = None):
+        self.records = records
+        self.block = block
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def wait(self):
+        self.done.wait()
+        if self.error is not None:
+            raise self.error
+
+
+class _GroupCommitter:
+    """One write queue + at-most-one committer per sub-log (ISSUE 7
+    tentpole), leader/follower style: writers enqueue framed records,
+    then whichever writer wins the commit lock becomes the group's
+    committer — it drains EVERYTHING queued into one
+    ``el_append_batch`` call (one handle-lock acquisition, one FFI
+    crossing, one contiguous write), appends the group's entity-index
+    sidecar lines in one shot (sidecar BEFORE log, preserving the crash
+    ordering), flushes to the OS once, and completes all waiters.
+    Followers sleep on their submission until a leader lands it.
+
+    Group formation is natural: records accumulate while the current
+    leader commits, so a lone writer commits inline at single-insert
+    latency (no thread handoff) while concurrent writers batch
+    automatically instead of convoying on the append lock (BENCH_r05's
+    concurrent-8 < serial regression). fsync rides the
+    PIO_INGEST_GROUP_COMMIT_MS cadence (see _group_commit_ms); the ack
+    itself only ever waits for the group's flush."""
+
+    def __init__(self, store: "NativeLogEvents", app_id: int,
+                 channel_id: Optional[int], part: int):
+        self.store = store
+        self.app_id = app_id
+        self.channel_id = channel_id
+        self.part = part
+        self._qlock = threading.Lock()
+        self._queue: List[_Submission] = []
+        # signaled by submit(): wakes a leader blocked in its group-
+        # formation wait the moment a record joins the queue
+        self._qcv = threading.Condition(self._qlock)
+        self._commit_lock = threading.Lock()
+        # wakes followers the moment a group lands or leadership frees
+        # up — polling here (the first cut did 10 ms sleeps) re-created
+        # half the convoy the committer exists to remove
+        self._cv = threading.Condition(threading.Lock())
+        self.stopped = False
+        # single-event writers routed to THIS sub-log and currently
+        # between routing and ack: the leader's group-formation wait
+        # compares the queue against this, not the store-wide writer
+        # count — on a partitioned store a store-wide count is never
+        # covered by one partition's queue and every group would stall
+        # the full nap budget
+        self.writers = 0
+
+    def writer_enter(self):
+        with self._qlock:
+            self.writers += 1
+
+    def writer_exit(self):
+        with self._qlock:
+            self.writers -= 1
+
+    def submit(self, records: List[_Record],
+               block: Optional[_Block] = None) -> _Submission:
+        sub = _Submission(records, block)
+        with self._qlock:
+            if self.stopped:
+                raise IOError("event store is closed")
+            self._queue.append(sub)
+            self._qcv.notify_all()
+        return sub
+
+    #: groups a leader may commit for OTHERS after its own submission
+    #: landed. Handing leadership to a sleeping follower costs that
+    #: follower a GIL wakeup (~ms when the server's request threads
+    #: are busy) before it can commit — a per-group tax that serializes
+    #: ingest into a convoy of wakeups. A warm leader instead keeps
+    #: draining: records that arrived during each commit become the
+    #: next natural group. The cap bounds how long one unlucky
+    #: caller's ack is delayed by strangers' work.
+    MAX_EXTRA_DRAINS = 8
+
+    def help_until(self, sub: _Submission):
+        """Drive group commits until ``sub`` completes. Every submitter
+        calls this after submit(): it either becomes the leader (drains
+        the queue, commits the group — which includes its own records)
+        or finds a leader already at work and sleeps on the condition
+        until a group lands. After its own submission lands, a leader
+        keeps draining up to MAX_EXTRA_DRAINS queued groups — staying
+        warm beats waking a follower — then retires; the followers it
+        wakes take over any still-queued work. The bounded wait is only
+        a backstop for the narrow race where a leader exits exactly as
+        we enqueue."""
+        while not sub.done.is_set():
+            if self._commit_lock.acquire(blocking=False):
+                extra = 0
+                try:
+                    if not sub.done.is_set() and self.writers > 1:
+                        # group-commit delay (PostgreSQL commit_delay
+                        # idea): other writers are mid-frame in
+                        # insert() — wait for them to enqueue so their
+                        # records join THIS group instead of each
+                        # paying a commit. The wait MUST truly block
+                        # (cv signaled per submit): timed sleeps have
+                        # a ~1.2 ms floor on HZ=250 kernels, and
+                        # sleep(0) yields lose the GIL race back to
+                        # this thread until the 5 ms switch-interval
+                        # forces a handoff — both measured as ~1.6 ms
+                        # of dead air per group. Blocking hands the
+                        # GIL to a framing follower and the enqueue
+                        # notify wakes us in microseconds. The wait
+                        # exits the moment every in-flight writer has
+                        # enqueued; the budget keeps added ack latency
+                        # inside the PIO_INGEST_GROUP_COMMIT_MS
+                        # envelope. A lone writer never waits.
+                        deadline = (time.perf_counter()
+                                    + self.store._nap_budget_s)
+                        with self._qcv:
+                            while (len(self._queue)
+                                   < self.writers):
+                                left = deadline - time.perf_counter()
+                                if left <= 0:
+                                    break
+                                self._qcv.wait(left)
+                    while self._drain_once():
+                        if sub.done.is_set():
+                            extra += 1
+                            if extra > self.MAX_EXTRA_DRAINS:
+                                break
+                finally:
+                    self._commit_lock.release()
+                    with self._cv:
+                        self._cv.notify_all()
+                if sub.done.is_set():
+                    break
+            else:
+                with self._cv:
+                    # re-check INSIDE the cv: if the leader finished
+                    # (lock free) or our group landed between our
+                    # failed acquire and here, looping beats sleeping —
+                    # the notify we'd wait for may already have fired
+                    if not sub.done.is_set() \
+                            and self._commit_lock.locked():
+                        self._cv.wait(timeout=0.005)
+        if sub.error is not None:
+            raise sub.error
+
+    def _drain_once(self) -> bool:
+        """Commit one group: everything queued right now (caller holds
+        the commit lock). Returns False when the queue was empty."""
+        with self._qlock:
+            subs, self._queue = self._queue, []
+        if not subs:
+            return False
+        err = None
+        try:
+            self._commit(subs)
+        except BaseException as e:          # waiters must never hang
+            err = e
+        for s in subs:
+            s.error = err
+            s.done.set()
+        with self._cv:
+            self._cv.notify_all()           # wake this group's waiters
+        return True
+
+    @staticmethod
+    def _records_arrays(records: List[_Record]):
+        """One el_append_batch argument set from a list of framed
+        records (the single/small-writer group shape)."""
+        n = len(records)
+        keys = b"".join(r.key for r in records)
+        datas = b"".join(r.payload for r in records)
+        keylens = (ctypes.c_int32 * n)(*[len(r.key) for r in records])
+        datalens = (ctypes.c_int64 * n)(*[len(r.payload)
+                                         for r in records])
+        ts = (ctypes.c_int64 * n)(*[r.ts for r in records])
+        eh = (ctypes.c_uint64 * n)(*[r.ehash for r in records])
+        nh = (ctypes.c_uint64 * n)(*[r.nhash for r in records])
+        th = (ctypes.c_uint64 * n)(*[r.thash for r in records])
+        return (n, keys, keylens, datas, datalens, ts, eh, nh, th)
+
+    @staticmethod
+    def _block_arrays(b: _Block):
+        """el_append_batch arguments from a pre-framed columnar block:
+        the numpy arrays were built vectorized by insert_columnar, so
+        this only reinterprets pointers."""
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        p64 = ctypes.POINTER(ctypes.c_int64)
+        pu64 = ctypes.POINTER(ctypes.c_uint64)
+        return (b.n, b.keys, b.keylens.ctypes.data_as(p32),
+                b.datas, b.datalens.ctypes.data_as(p64),
+                b.ts.ctypes.data_as(p64), b.eh.ctypes.data_as(pu64),
+                b.nh.ctypes.data_as(pu64), b.th.ctypes.data_as(pu64))
+
+    def _commit(self, subs: List[_Submission]):
+        store, lib = self.store, self.store.lib
+        t0 = time.perf_counter()
+        records = [r for s in subs if s.block is None
+                   for r in s.records]
+        blocks = [s.block for s in subs if s.block is not None]
+        total = len(records) + sum(b.n for b in blocks)
+        if not total:
+            return
+        # sidecar lines for the whole group BEFORE the log append (a
+        # dangling indexed id is skipped at read; a missing one would be
+        # a wrong filtered result) — one write+flush instead of n.
+        # Block sidecar tuples materialize HERE, only when the shard
+        # actually carries an index (the common unindexed ingest skips
+        # the per-row tuple build entirely).
+        idx = store._entidx.get((self.app_id, self.channel_id, self.part))
+        if idx is not None:
+            entries = [(r.ent, r.tgt, r.eid) for r in records]
+            for b in blocks:
+                tgts = b.tgts or ("",) * b.n
+                entries.extend((e, t or "", i) for e, t, i
+                               in zip(b.ents, tgts, b.eids))
+            idx.add_many(entries)
+        groups = [self._block_arrays(b) for b in blocks]
+        if records:
+            groups.append(self._records_arrays(records))
+        hkey = (self.app_id, self.channel_id, self.part)
+        fsync_ms = store._fsync_ms
+        # short calls go through the GIL-holding binding: a CDLL call's
+        # GIL reacquisition costs ~1 ms under concurrent writers, 10x
+        # the C work itself (see _PYLIB). Bulk blocks stay GIL-releasing
+        # so the pipelined builder overlaps with them.
+        fast = _PYLIB or lib
+        while True:
+            h, lk = store._handle_of(self.app_id, self.channel_id,
+                                     self.part)
+            with timed_acquire(lk, store._append_lock_wait):
+                if store._stale(hkey, h):
+                    continue           # lost a race with remove(): reopen
+                for (n, keys, keylens, datas, datalens, ts, eh, nh,
+                     th) in groups:
+                    clib = fast if n <= 4096 else lib
+                    rc = clib.el_append_batch(h, n, keys, keylens, datas,
+                                              datalens, ts, eh, nh, th)
+                    if rc != n:
+                        raise IOError("batch append failed")
+                # the ack barrier: flushed to the OS — a process kill
+                # cannot lose an acked event; disk durability rides the
+                # fsync cadence below. A flush FAILURE (ENOSPC/EIO
+                # after fwrite buffered the group) must raise, not
+                # ack: the IOError reaches every waiter and the event
+                # server's transient-error classification spills the
+                # group to the WAL instead of acking it into the void.
+                if fast.el_flush(h) != 0:
+                    raise IOError("event log flush failed")
+                if fsync_ms == 0:
+                    # strict mode pays a real disk sync per group: go
+                    # through the GIL-RELEASING binding — the PyDLL
+                    # fast path would freeze every Python thread
+                    # (request handlers, the serving plane) for the
+                    # sync's duration
+                    if lib.el_sync(h) != 0:
+                        raise IOError("fsync failed")
+            break
+        if fsync_ms > 0:
+            store._mark_dirty(hkey)
+        gs, cs = _ingest_metrics()
+        gs.observe(total)
+        cs.observe(time.perf_counter() - t0)
+
+    def stop(self):
+        """Refuse new submissions and land whatever is queued on the
+        calling thread (blocking on an in-flight leader first).
+        Submissions that raced the flag re-resolve a fresh committer."""
+        with self._qlock:
+            self.stopped = True
+        with self._commit_lock:
+            self._drain_once()
+
+
+class _FsyncLoop:
+    """The async half of the durability knob: committers mark handles
+    dirty, this thread el_syncs them every ``interval_ms``. One per
+    store; started on the first dirty mark, stopped (with a final sync
+    pass) at close."""
+
+    def __init__(self, store: "NativeLogEvents", interval_ms: float):
+        self.store = store
+        self.interval_s = max(interval_ms, 0.5) / 1000.0
+        self._dirty: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="nativelog-fsync")
+        self._thread.start()
+
+    def mark(self, hkey):
+        with self._lock:
+            self._dirty.add(hkey)
+
+    def _sync_pass(self):
+        with self._lock:
+            dirty, self._dirty = self._dirty, set()
+        for app_id, channel_id, part in dirty:
+            h, lk = self.store._handle_of(app_id, channel_id, part,
+                                          create=False)
+            if h is None:
+                continue
+            # flush under the append lock (microseconds), fsync OUTSIDE
+            # it on a dup'd fd: an fsync held under this lock convoys
+            # every group committer behind the disk (measured ~2x bulk
+            # ingest). The dup keeps the file description alive even if
+            # remove() closes the handle mid-sync.
+            fd = -1
+            fast = _PYLIB or self.store.lib   # us-scale: hold the GIL
+            with lk:
+                if not self.store._stale((app_id, channel_id, part), h):
+                    fd = fast.el_flush_dup(h)
+            if fd >= 0:
+                try:
+                    os.fsync(fd)
+                except OSError:
+                    # re-mark: the dirty flag was popped up front, so a
+                    # failed sync must re-queue itself for the next pass
+                    self.mark((app_id, channel_id, part))
+                finally:
+                    os.close(fd)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._sync_pass()
+            except Exception:
+                pass                       # a sync failure must not kill
+            #                                the cadence; the next pass
+            #                                (or close) retries
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        try:
+            self._sync_pass()              # land what the loop missed
+        except Exception:
+            pass
+
+
 class NativeLogEvents(base.Events):
     def __init__(self, lib, root: str, partitions: int = 1):
         self.lib = lib
@@ -352,6 +910,11 @@ class NativeLogEvents(base.Events):
         self._handles: Dict[Tuple[int, Optional[int], int], int] = {}
         self._hlocks: Dict[Tuple[int, Optional[int], int],
                            threading.RLock] = {}
+        # negative handle cache (see _handle_of): keys whose log file
+        # does not exist on disk — probed O(partitions) times per
+        # pre-assigned-id insert, so a stat() each would be a hot-path
+        # syscall storm. Entries clear when a handle is created.
+        self._absent: set = set()
         self._lock = threading.RLock()
         # serializes cross-shard overwrite-by-id inserts of the SAME id
         # (two racers otherwise each delete the other's freshly-appended
@@ -361,15 +924,33 @@ class NativeLogEvents(base.Events):
         self._overwrite_locks = [threading.Lock() for _ in range(64)]
         self._pool: Optional[ThreadPoolExecutor] = None
         self._closed = False
-        # per-namespace persisted entity->ids sidecars (created lazily on
-        # the first entity-filtered read; kept incremental by insert())
-        self._entidx: Dict[Tuple[int, Optional[int]], _EntityIndex] = {}
+        # per-SUB-LOG persisted entity->ids sidecars, keyed (app, chan,
+        # part) — sharding the sidecar alongside the log lets each
+        # partition's committer append its own index lines without
+        # contending on a namespace-wide sidecar lock (ISSUE 7 tentpole
+        # c). Created lazily on the first entity-filtered read; kept
+        # incremental by the committers.
+        self._entidx: Dict[Tuple[int, Optional[int], int],
+                           _EntityIndex] = {}
         self._entidx_lock = threading.RLock()
+        # group-commit plane (ISSUE 7 tentpole a): one write queue +
+        # committer per sub-log; writers enqueue and wait instead of
+        # convoying on the per-handle append lock
+        self._committers: Dict[Tuple[int, Optional[int], int],
+                               _GroupCommitter] = {}
+        self._fsync_ms = _group_commit_ms()
+        self._nap_budget_s = _gc_nap_budget_s(self._fsync_ms)
+        self._fsync_loop: Optional[_FsyncLoop] = None
         # contention probe (ISSUE 6): writer wait on the per-handle
         # lock, as pio_lock_wait_seconds{lock=nativelog_append} — the
         # instrument that localizes BENCH_r05's concurrent-8 ingest
         # regression (slower than serial) to this lock or below it
         self._append_lock_wait = lock_probe("nativelog_append")
+        # (in-flight single-event writers are counted PER COMMITTER —
+        # _GroupCommitter.writers — so a partitioned store's formation
+        # waits compare each sub-log's queue against that sub-log's
+        # own writers, not a store-wide count one partition's queue
+        # could never cover)
 
     def _path_of(self, app_id: int, channel_id: Optional[int],
                  part: int) -> str:
@@ -381,16 +962,34 @@ class NativeLogEvents(base.Events):
     def _handle_of(self, app_id: int, channel_id: Optional[int], part: int,
                    create: bool = True):
         key = (app_id, channel_id, part)
+        # Lock-free fast path: CPython dict reads are atomic, and every
+        # operation re-checks ``_stale`` under the per-handle lock, so a
+        # lookup that races close/remove resolves there. Taking the
+        # store lock here put a GLOBAL convoy on every read AND every
+        # cross-file id probe (O(partitions) lookups per pre-assigned-id
+        # insert) — measured as the top server-side stack under
+        # concurrent ingest. ``_absent`` is the negative cache for files
+        # that don't exist (the legacy part on never-upgraded stores):
+        # without it each probe pays O(partitions) stat() calls.
+        h = self._handles.get(key)
+        if h is not None:
+            lk = self._hlocks.get(key)
+            if lk is not None:
+                return h, lk
+        elif not create and key in self._absent:
+            return None, None
         with self._lock:
             if key not in self._handles:
                 path = self._path_of(app_id, channel_id, part)
                 if not create and not os.path.exists(path):
+                    self._absent.add(key)
                     return None, None
                 h = self.lib.el_open(path.encode())
                 if not h:
                     raise IOError(f"cannot open event log {path}")
                 self._handles[key] = h
                 self._hlocks[key] = threading.RLock()
+                self._absent.discard(key)
             return self._handles[key], self._hlocks[key]
 
     def _write_part(self, event: Event) -> int:
@@ -418,45 +1017,73 @@ class NativeLogEvents(base.Events):
                 out.append(((app_id, channel_id, p), h, lk))
         return out
 
-    def _log_bytes(self, app_id, channel_id) -> int:
-        """Total on-disk bytes of the namespace's log files — the entity
-        index's staleness fingerprint."""
-        total = 0
-        parts = ([0] if self.partitions == 1
-                 else list(range(self.partitions)) + [_LEGACY])
-        for p in parts:
-            path = self._path_of(app_id, channel_id, p)
-            if os.path.exists(path):
-                total += os.path.getsize(path)
-        return total
+    def _index_parts(self, app_id, channel_id) -> List[int]:
+        """Partition indexes that carry an entity-index sidecar: every
+        shard, plus the legacy unpartitioned file when one exists."""
+        if self.partitions == 1:
+            return [0]
+        parts = list(range(self.partitions))
+        if os.path.exists(self._path_of(app_id, channel_id, _LEGACY)):
+            parts.append(_LEGACY)
+        return parts
 
-    def _flush_all(self, app_id, channel_id):
-        for p in range(self.partitions):
-            h, lk = self._handle_of(app_id, channel_id, p, create=False)
-            if h is not None:
-                with lk:
-                    if not self._stale((app_id, channel_id, p), h):
-                        self.lib.el_flush(h)
+    def _entidx_path(self, app_id, channel_id, part) -> str:
+        stem = f"events_{app_id}_{channel_id or 0}"
+        if part == _LEGACY or self.partitions == 1:
+            # the pre-sharding sidecar name: a store upgraded from
+            # PARTITIONS=1 adopts its old sidecar as the legacy part's
+            # (its meta covered exactly the legacy file's bytes)
+            return os.path.join(self.root, stem + ".entidx")
+        return os.path.join(self.root, f"{stem}_p{part}.entidx")
 
-    def _index_of(self, app_id, channel_id) -> _EntityIndex:
-        """The namespace's entity index, loading the persisted sidecar
-        when its meta matches the logs and rebuilding (one full scan —
+    def _shard_bytes(self, app_id, channel_id, part) -> int:
+        path = self._path_of(app_id, channel_id, part)
+        return os.path.getsize(path) if os.path.exists(path) else 0
+
+    def _flush_part(self, app_id, channel_id, part):
+        h, lk = self._handle_of(app_id, channel_id, part, create=False)
+        if h is not None:
+            with lk:
+                if not self._stale((app_id, channel_id, part), h):
+                    self.lib.el_flush(h)
+
+    def _shard_events(self, app_id, channel_id, part) -> List[Event]:
+        """Every live event in ONE sub-log — the per-shard sidecar
+        rebuild scan (sharded sidecars rebuild shard-by-shard instead of
+        one namespace-wide scan)."""
+        h, lk = self._handle_of(app_id, channel_id, part, create=False)
+        if h is None:
+            return []
+        return [Event.from_dict(json.loads(raw.decode("utf-8")))
+                for raw in self._scan_one((app_id, channel_id, part),
+                                          h, lk)]
+
+    def _index_of_part(self, app_id, channel_id, part) -> _EntityIndex:
+        """One sub-log's entity index, loading the persisted sidecar
+        when its meta matches the shard and rebuilding (one shard scan —
         the adoption cost) otherwise."""
-        key = (app_id, channel_id)
+        key = (app_id, channel_id, part)
         with self._entidx_lock:
             idx = self._entidx.get(key)
             if idx is None:
-                stem = f"events_{app_id}_{channel_id or 0}"
-                idx = _EntityIndex(os.path.join(self.root,
-                                                stem + ".entidx"))
+                idx = _EntityIndex(
+                    self._entidx_path(app_id, channel_id, part))
                 self._entidx[key] = idx
         with idx.lock:
             if not idx.loaded:
-                self._flush_all(app_id, channel_id)  # sizes settle first
-                nbytes = self._log_bytes(app_id, channel_id)
+                self._flush_part(app_id, channel_id, part)  # size settles
+                nbytes = self._shard_bytes(app_id, channel_id, part)
                 if not idx.try_load(nbytes):
-                    idx.rebuild(self.find(app_id, channel_id), nbytes)
+                    idx.rebuild(
+                        self._shard_events(app_id, channel_id, part),
+                        nbytes)
         return idx
+
+    def _index_of(self, app_id, channel_id) -> List[_EntityIndex]:
+        """The namespace's entity indexes, one per sub-log, each loaded
+        or rebuilt on first use."""
+        return [self._index_of_part(app_id, channel_id, p)
+                for p in self._index_parts(app_id, channel_id)]
 
     def _stale(self, key, h) -> bool:
         """True when a concurrent close()/remove() freed this handle
@@ -485,8 +1112,19 @@ class NativeLogEvents(base.Events):
             return [f() for f in fns]
 
     def close(self):
+        # committers drain first (queued groups still commit, waiters
+        # complete), then the fsync loop lands its final pass, THEN the
+        # handles close — so el_close never races an in-flight commit
         with self._lock:
             self._closed = True
+            committers = list(self._committers.values())
+            self._committers.clear()
+            fsync_loop, self._fsync_loop = self._fsync_loop, None
+        for c in committers:
+            c.stop()
+        if fsync_loop is not None:
+            fsync_loop.stop()
+        with self._lock:
             pool, self._pool = self._pool, None
             items = [(k, h, self._hlocks[k])
                      for k, h in self._handles.items()]
@@ -500,10 +1138,10 @@ class NativeLogEvents(base.Events):
         with self._entidx_lock:
             indexes = list(self._entidx.items())
             self._entidx.clear()
-        for (app_id, channel_id), idx in indexes:
+        for (app_id, channel_id, part), idx in indexes:
             # clean close stamps the meta fingerprint: the next open
             # adopts the sidecar instead of rebuilding
-            idx.close(self._log_bytes(app_id, channel_id))
+            idx.close(self._shard_bytes(app_id, channel_id, part))
 
     # -- Events interface ---------------------------------------------------
     def init(self, app_id, channel_id=None) -> bool:
@@ -513,13 +1151,23 @@ class NativeLogEvents(base.Events):
 
     def remove(self, app_id, channel_id=None) -> bool:
         removed = False
-        with self._entidx_lock:
-            idx = self._entidx.pop((app_id, channel_id), None)
-        if idx is None:   # sidecar may exist from a prior process
-            idx = _EntityIndex(os.path.join(
-                self.root, f"events_{app_id}_{channel_id or 0}.entidx"))
-        idx.drop()
+        # this namespace's committers drain and stop before the files
+        # go away (a queued group must not resurrect a removed log)
+        with self._lock:
+            committers = [(k, c) for k, c in self._committers.items()
+                          if k[0] == app_id and k[1] == channel_id]
+            for k, _ in committers:
+                self._committers.pop(k)
+        for _, c in committers:
+            c.stop()
         parts = list(range(self.partitions)) + [_LEGACY]
+        for p in parts:
+            with self._entidx_lock:
+                idx = self._entidx.pop((app_id, channel_id, p), None)
+            if idx is None:   # sidecar may exist from a prior process
+                idx = _EntityIndex(
+                    self._entidx_path(app_id, channel_id, p))
+            idx.drop()
         with self._lock:
             for p in parts:
                 key = (app_id, channel_id, p)
@@ -533,6 +1181,32 @@ class NativeLogEvents(base.Events):
                     os.remove(path)
                     removed = True
         return removed
+
+    def invalidate_namespace(self, app_id, channel_id=None):
+        """Forget every cached view of a namespace whose on-disk files
+        were replaced OUTSIDE this DAO (snapshot restore): cached
+        handles close, the negative-existence cache (``_absent`` — a
+        restored shard would otherwise stay invisible forever) and
+        in-memory entity indexes drop. The next operation re-opens
+        from disk."""
+        parts = list(range(self.partitions)) + [_LEGACY]
+        with self._lock:
+            for p in parts:
+                key = (app_id, channel_id, p)
+                self._absent.discard(key)
+                h = self._handles.pop(key, None)
+                if h is not None:
+                    lk = self._hlocks.pop(key, None)
+                    if lk is not None:
+                        with lk:
+                            self.lib.el_close(h)
+        with self._entidx_lock:
+            idxs = [self._entidx.pop((app_id, channel_id, p), None)
+                    for p in parts]
+        for idx in idxs:
+            if idx is not None:
+                idx._close_fh()   # drop, never stamp: the sidecar no
+                #                   longer describes the on-disk log
 
     def snapshot_files(self, app_id, channel_id=None):
         """Flush every shard and return ``[(file_name, abs_path)]`` for
@@ -567,73 +1241,553 @@ class NativeLogEvents(base.Events):
             return ""
         return f"{e.target_entity_type}\x00{e.target_entity_id}"
 
-    def insert(self, event: Event, app_id, channel_id=None) -> str:
-        part = self._write_part(event)
-        hkey = (app_id, channel_id, part)
-        preexisting_id = bool(event.event_id)
-        eid = event.event_id or new_event_id()
+    # -- group-commit write plane (ISSUE 7) ---------------------------------
+    def _record_of(self, event: Event, eid: str) -> _Record:
         payload = json.dumps(
             event.with_id(eid).to_dict(), separators=(",", ":")
         ).encode("utf-8")
-        key = eid.encode("utf-8")
         target = self._target_key(event)
-        # A caller-supplied id may live in a DIFFERENT file: another shard
-        # (a re-insert that changed the entity re-routes, since shard
-        # routing is by entity hash) or a pre-partitioning legacy file —
-        # so every preexisting-id insert sweeps all other files, keeping
-        # overwrite-by-id a whole-store invariant and self-healing any
-        # duplicates an earlier crash left behind. Fresh generated ids
-        # are new by construction and skip all of this. The overwrite
-        # lock spans append+sweep so racing same-id inserts serialize to
-        # last-writer-wins (each otherwise deletes the other's fresh
-        # copy); appending BEFORE sweeping means an append failure or a
-        # crash leaves the old copy intact (worst crash outcome is a
-        # duplicate repaired on the next overwrite, never loss).
-        sweep = self.partitions > 1 and preexisting_id
-        ctx = (self._overwrite_locks[_hash(self.lib, eid) & 63]
-               if sweep else _NULL_CTX)
-        # incremental entity-index maintenance, sidecar line BEFORE the
-        # log append (crash ordering: a dangling indexed id is skipped at
-        # read; a missing one would be a wrong filtered result). Only a
-        # LOADED index is appended to — an unloaded sidecar goes stale
-        # and the next _index_of detects that via the meta fingerprint.
-        idx = self._entidx.get((app_id, channel_id))
-        if idx is not None:
-            idx.add(event.entity_id, event.target_entity_id or "", eid)
-        with ctx:
-            while True:
-                h, lk = self._handle_of(app_id, channel_id, part)
-                with timed_acquire(lk, self._append_lock_wait):
-                    if self._stale(hkey, h):
-                        continue       # lost a race with remove(): reopen
-                    rc = self.lib.el_append(
-                        h, key, len(key), payload, len(payload),
-                        to_millis(event.event_time),
-                        _hash(self.lib, self._entity_key(event)),
-                        _hash(self.lib, event.event),
-                        _hash(self.lib, target) if target else 0)
-                if rc != 0:
-                    raise IOError("append failed")
-                break
-            if sweep:
-                for okey, oh, olk in self._read_handles(app_id,
-                                                        channel_id):
-                    if okey[2] == part:
+        return _Record(
+            eid.encode("utf-8"), payload, to_millis(event.event_time),
+            _hash(self.lib, self._entity_key(event)),
+            _hash(self.lib, event.event),
+            _hash(self.lib, target) if target else 0,
+            event.entity_id, event.target_entity_id or "", eid)
+
+    def _committer_of(self, app_id, channel_id, part) -> _GroupCommitter:
+        key = (app_id, channel_id, part)
+        # lock-free fast path (same contract as _handle_of): committers
+        # are only replaced when stopped, and submit() re-raises on a
+        # stop that races this lookup, which _submit retries
+        c = self._committers.get(key)
+        if c is not None and not c.stopped:
+            return c
+        with self._lock:
+            c = self._committers.get(key)
+            if c is None or c.stopped:
+                c = _GroupCommitter(self, app_id, channel_id, part)
+                self._committers[key] = c
+            return c
+
+    def _submit(self, app_id, channel_id, part, records: List[_Record],
+                block: Optional[_Block] = None
+                ) -> Tuple[_GroupCommitter, _Submission]:
+        while True:
+            c = self._committer_of(app_id, channel_id, part)
+            try:
+                return c, c.submit(records, block)
+            except IOError:
+                continue   # committer stopped between resolve and submit
+
+    def _mark_dirty(self, hkey):
+        """Queue a handle for the async fsync cadence (the durability
+        half of PIO_INGEST_GROUP_COMMIT_MS)."""
+        loop = self._fsync_loop
+        if loop is None:
+            with self._lock:
+                if self._fsync_loop is None:
+                    self._fsync_loop = _FsyncLoop(self, self._fsync_ms)
+                loop = self._fsync_loop
+        loop.mark(hkey)
+
+    def _id_in_other_file(self, app_id, channel_id, key: bytes,
+                          part: int) -> bool:
+        """O(1) index probes: does this event id live in any file OTHER
+        than its routed shard (another shard after an entity re-route,
+        or the pre-partitioning legacy file)? Decides whether a caller-
+        supplied id needs the serialized overwrite+sweep path or can
+        ride the group committer."""
+        fast = _PYLIB or self.lib   # us-scale probe: hold the GIL
+        for okey, oh, olk in self._read_handles(app_id, channel_id):
+            if okey[2] == part:
+                continue
+            with olk:
+                if self._stale(okey, oh):
+                    continue
+                if fast.el_exists(oh, key, len(key)):
+                    return True
+        return False
+
+    def _ids_in_other_files(self, app_id, channel_id,
+                            key_id_parts) -> set:
+        """Batched ``_id_in_other_file`` over ``(key_bytes, eid, part)``
+        triples: which of the batch's caller-supplied ids live in a
+        file other than their routed shard — one lock acquisition per
+        file for the whole batch."""
+        found: set = set()
+        fast = _PYLIB or self.lib   # us-scale probes: hold the GIL
+        for okey, oh, olk in self._read_handles(app_id, channel_id):
+            with olk:
+                if self._stale(okey, oh):
+                    continue
+                for key, eid, part in key_id_parts:
+                    if okey[2] == part or eid in found:
                         continue
-                    with olk:
-                        if not self._stale(okey, oh):
-                            self.lib.el_delete(oh, key, len(key))
+                    if fast.el_exists(oh, key, len(key)):
+                        found.add(eid)
+        return found
+
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        part = self._write_part(event)
+        # count on the ROUTED sub-log's committer: its leader's
+        # formation wait exits when this partition's queue covers this
+        # partition's writers. (A committer swapped out by a racing
+        # remove() just sees an advisory count decorate the retiring
+        # instance — formation timing, never correctness.)
+        c = self._committer_of(app_id, channel_id, part)
+        c.writer_enter()
+        try:
+            return self._insert_one(event, app_id, channel_id, part)
+        finally:
+            c.writer_exit()
+
+    def _insert_one(self, event: Event, app_id, channel_id,
+                    part: int) -> str:
+        # a minted id (server pre-assign, Event.id_minted) is fresh
+        # random hex that cannot live in another file: skip the
+        # O(files) probe and the overwrite stripe lock entirely
+        preexisting_id = bool(event.event_id) and not event.id_minted
+        eid = event.event_id or new_event_id()
+        rec = self._record_of(event, eid)
+        # A caller-supplied id may live in a DIFFERENT file: another
+        # shard (a re-insert that changed the entity re-routes, since
+        # shard routing is by entity hash) or a pre-partitioning legacy
+        # file. Probing the other files' in-memory indexes is O(files);
+        # only a HIT takes the serialized overwrite+sweep path — the
+        # common pre-assigned-id ingest (event server, spill replay,
+        # pio import) probes, misses, and rides the group committer.
+        # The stripe lock spans probe→ack so racing same-id inserts
+        # serialize to last-writer-wins.
+        if self.partitions > 1 and preexisting_id:
+            with self._overwrite_locks[_hash(self.lib, eid) & 63]:
+                if self._id_in_other_file(app_id, channel_id, rec.key,
+                                          part):
+                    self._insert_overwrite(rec, app_id, channel_id, part)
+                else:
+                    c, sub = self._submit(app_id, channel_id, part, [rec])
+                    c.help_until(sub)
+            return eid
+        c, sub = self._submit(app_id, channel_id, part, [rec])
+        c.help_until(sub)
         return eid
 
+    def _insert_overwrite(self, rec: _Record, app_id, channel_id, part):
+        """The cross-file overwrite-by-id path (caller holds the id's
+        stripe lock): direct append to the routed shard, then sweep the
+        id out of every other file. Appending BEFORE sweeping means an
+        append failure or a crash leaves the old copy intact (worst
+        outcome is a duplicate repaired on the next overwrite, never
+        loss)."""
+        idx = self._entidx.get((app_id, channel_id, part))
+        if idx is not None:
+            # sidecar line BEFORE the log append (crash ordering: a
+            # dangling indexed id is skipped at read; a missing one
+            # would be a wrong filtered result)
+            idx.add(rec.ent, rec.tgt, rec.eid)
+        hkey = (app_id, channel_id, part)
+        while True:
+            h, lk = self._handle_of(app_id, channel_id, part)
+            with timed_acquire(lk, self._append_lock_wait):
+                if self._stale(hkey, h):
+                    continue           # lost a race with remove(): reopen
+                rc = self.lib.el_append(
+                    h, rec.key, len(rec.key), rec.payload,
+                    len(rec.payload), rec.ts, rec.ehash, rec.nhash,
+                    rec.thash)
+                if rc != 0:
+                    raise IOError("append failed")
+                if self.lib.el_flush(h) != 0:
+                    raise IOError("event log flush failed")
+                if self._fsync_ms == 0 and self.lib.el_sync(h) != 0:
+                    raise IOError("fsync failed")
+            break
+        if self._fsync_ms > 0:
+            self._mark_dirty(hkey)
+        for okey, oh, olk in self._read_handles(app_id, channel_id):
+            if okey[2] == part:
+                continue
+            with olk:
+                if not self._stale(okey, oh):
+                    self.lib.el_delete(oh, rec.key, len(rec.key))
+
     def insert_batch(self, events, app_id, channel_id=None):
-        eids = [self.insert(e, app_id, channel_id) for e in events]
-        self._flush_all(app_id, channel_id)
-        idx = self._entidx.get((app_id, channel_id))
-        if idx is not None and idx.loaded:
-            # batch boundaries are cheap sync points: re-anchor the meta
-            # fingerprint so a clean restart adopts without a rebuild
-            idx.mark_clean(self._log_bytes(app_id, channel_id))
-        return eids
+        """Bulk write as at most one group submission per touched
+        sub-log: ids are minted in one pass, in-batch id duplicates
+        resolve to the LAST occurrence (what the serial overwrite path
+        converged to), and each partition's records commit as one
+        ``el_append_batch`` group. The columnar ingest route and the
+        spill replayer land here."""
+        if not events:
+            return []           # nothing to commit — and no meta
+        #                         re-anchor (the empty-batch re-anchor
+        #                         was the ISSUE 7 satellite bug)
+        pairs = [(e, e.event_id or new_event_id()) for e in events]
+        last = {eid: i for i, (_, eid) in enumerate(pairs)}
+        routed: List[Tuple[_Record, int, bool]] = []
+        for i, (event, eid) in enumerate(pairs):
+            if last[eid] != i:
+                continue        # superseded within the batch: last wins
+            routed.append((self._record_of(event, eid),
+                           self._write_part(event),
+                           bool(event.event_id)
+                           and not event.id_minted))
+        pre = []
+        if self.partitions > 1:
+            pre = [(r.key, r.eid, p) for r, p, owns in routed if owns]
+        # caller-supplied ids hold their overwrite stripes across
+        # probe -> commit, exactly like the single-insert path: a
+        # same-id write racing the gap between an unlocked probe and
+        # the group commit would leave two live copies of the id in
+        # different shards. Stripes acquire in sorted index order (no
+        # deadlock against other sorted batches or the single path's
+        # one stripe), and progress is self-made — we lead our own
+        # group commits — so holding them across help_until cannot
+        # wedge. The common minted-id batch (event server, spill
+        # replay) takes zero stripes.
+        stripes = sorted({_hash(self.lib, eid) & 63
+                          for _, eid, _ in pre})
+        for s in stripes:
+            self._overwrite_locks[s].acquire()
+        try:
+            overwrite_ids: set = set()
+            if pre:
+                # one lock acquisition per FILE for the whole batch's
+                # caller-supplied ids, instead of per-event probing
+                overwrite_ids = self._ids_in_other_files(
+                    app_id, channel_id, pre)
+            by_part: Dict[int, List[_Record]] = {}
+            touched = set()
+            for rec, part, _owns in routed:
+                if rec.eid in overwrite_ids:
+                    # stripe already held (acquired above)
+                    self._insert_overwrite(rec, app_id, channel_id,
+                                           part)
+                    touched.add(part)
+                else:
+                    by_part.setdefault(part, []).append(rec)
+            waits = [self._submit(app_id, channel_id, p, recs)
+                     for p, recs in by_part.items()]
+            for c, sub in waits:
+                c.help_until(sub)
+        finally:
+            for s in reversed(stripes):
+                self._overwrite_locks[s].release()
+        self._reanchor(app_id, channel_id, touched | set(by_part))
+        return [eid for _, eid in pairs]
+
+    def _reanchor(self, app_id, channel_id, parts):
+        """Batch boundaries are cheap sync points: re-anchor each
+        touched shard's meta fingerprint so a clean restart adopts the
+        sidecar without a rebuild."""
+        for p in parts:
+            idx = self._entidx.get((app_id, channel_id, p))
+            if idx is not None and idx.loaded:
+                idx.mark_clean(self._shard_bytes(app_id, channel_id, p))
+
+    def _hash_column(self, strs, prefix: str = "") -> np.ndarray:
+        """FNV-1a of n strings (each optionally prefixed) in ONE FFI
+        crossing (el_hash_batch vs 3 per-record el_hash round trips — a
+        measured ~30% of the Python bulk loop). Zero-length strings
+        hash to 0, the record header's 'target absent' convention. The
+        all-ASCII column (every id the wire normally carries) encodes
+        with ONE str.encode — byte extents equal string lengths —
+        instead of n; a scalar entity type rides as ``prefix`` so the
+        per-row "type\\x00id" keys are never materialized (prefix +
+        prefix.join is one C-level concat)."""
+        n = len(strs)
+        out = np.empty(n, dtype=np.uint64)
+        if n == 0:
+            return out
+        joined = (prefix + prefix.join(strs)) if prefix else "".join(strs)
+        if joined.isascii():
+            buf = joined.encode("ascii")
+            lens = np.fromiter(map(len, strs), dtype=np.int64, count=n)
+            if prefix:
+                lens += len(prefix)
+        else:
+            if prefix:
+                strs = [prefix + s for s in strs]
+            bufs = [s.encode("utf-8") for s in strs]
+            buf = b"".join(bufs)
+            lens = np.fromiter(map(len, bufs), dtype=np.int64, count=n)
+        offs = np.empty(n + 1, dtype=np.int64)
+        offs[0] = 0
+        np.cumsum(lens, out=offs[1:])
+        self.lib.el_hash_batch(
+            buf, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        return out
+
+    #: rows per pipelined sub-batch (see insert_columnar)
+    _COLUMNAR_CHUNK = 16384
+
+    def insert_columnar(self, batch, app_id, channel_id=None):
+        """Vectorized columnar bulk write — the ≥10x ingest fast path
+        (ISSUE 7 tentpole b). One id-mint pass (a single os.urandom
+        call), record JSON built by string templating with the
+        broadcast columns' fragments computed once (no Event objects,
+        no per-event json.dumps), each hash column in one
+        el_hash_batch FFI crossing, and ONE pre-framed _Block
+        submission per touched sub-log riding the same group
+        committers as every other writer (bulk and single writers
+        interleave without convoying). Payloads are ASCII by
+        construction (ensure_ascii dumps + escaped ids), so byte
+        extents equal string lengths and the column joins to one
+        contiguous buffer without a per-row encode.
+
+        Large batches pipeline in _COLUMNAR_CHUNK-row sub-batches: a
+        worker thread drives chunk k's group commit (the C append
+        releases the GIL) while this thread builds chunk k+1's arrays,
+        overlapping string work and fwrite/index work on two cores.
+        Requires ids to be distinct batch-wide (minted ids always are;
+        the event server pre-mints for spill replay): with an in-batch
+        duplicate, last-wins dedup and the cross-file overwrite probe
+        need the whole batch at once, so those stay single-shot."""
+        n = batch.n
+        if n == 0:
+            return []
+        ck = self._COLUMNAR_CHUNK
+        if n > ck + (ck >> 1) and (
+                batch.event_id is None or batch.minted
+                or (all(batch.event_id)
+                    and len(set(batch.event_id)) == n)):
+            # whole-column time pre-pass: a malformed cell must raise
+            # BEFORE chunk 0 commits — failing mid-pipeline would leave
+            # earlier chunks durable under a request that 400s (the
+            # server route pre-validates, but direct DAO callers get
+            # the same no-partial-commit contract)
+            et = batch.event_time
+            if isinstance(et, list):
+                for x in et:
+                    if x:
+                        parse_event_time(x)
+            ids: List[str] = []
+            touched: set = set()
+            futures = []
+            with ThreadPoolExecutor(1) as pool:
+                for lo in range(0, n, ck):
+                    cids, waits, t0 = self._columnar_submit(
+                        batch.slice_rows(lo, min(lo + ck, n)),
+                        app_id, channel_id)
+                    ids.extend(cids)
+                    touched |= t0
+                    futures.append(pool.submit(self._help_all, waits))
+                for f in futures:
+                    touched |= f.result()
+            self._reanchor(app_id, channel_id, touched)
+            return ids
+        ids, waits, touched = self._columnar_submit(batch, app_id,
+                                                    channel_id)
+        touched |= self._help_all(waits)
+        self._reanchor(app_id, channel_id, touched)
+        return ids
+
+    @staticmethod
+    def _help_all(waits) -> set:
+        touched = set()
+        for p, (c, sub) in waits:
+            c.help_until(sub)
+            touched.add(p)
+        return touched
+
+    def _columnar_submit(self, batch, app_id, channel_id):
+        """Build one batch's pre-framed blocks and enqueue them on the
+        per-partition committers WITHOUT driving the commits; returns
+        (ids, waits, touched-parts-so-far) for the caller to help."""
+        n = batch.n
+        enc = json.encoder.encode_basestring_ascii
+        # -- ids: one mint pass. batch.minted ids (server pre-mint for
+        # spill replay) are OUR fresh hex — they keep the whole minted
+        # fast path: inline-quotable, distinct by construction, cannot
+        # pre-exist in another file -----------------------------------------
+        ids = batch.event_id
+        keep: Optional[List[int]] = None
+        supplied = ids is not None and not batch.minted
+        if ids is None:
+            ids = new_event_ids(n)
+            hexes = "".join(ids)
+            id_frags = None           # minted hex: inline-quotable
+        elif not supplied:
+            hexes = "".join(ids)
+            id_frags = None
+        else:
+            ids = [x if x else new_event_id() for x in ids]
+            id_frags = [enc(x) for x in ids]
+            last = {eid: i for i, eid in enumerate(ids)}
+            if len(last) != n:
+                # in-batch duplicate ids resolve to the LAST occurrence
+                # (what the serial overwrite path converged to)
+                keep = [i for i, eid in enumerate(ids) if last[eid] == i]
+        # -- hash columns + shard routing -----------------------------------
+        ents = batch.entity_id
+        etype = batch.entity_type
+        if isinstance(etype, str):
+            et_frag, et_frags = enc(etype), None
+            eh = self._hash_column(ents, prefix=f"{etype}\x00")
+        else:
+            et_frag, et_frags = None, [enc(t) for t in etype]
+            eh = self._hash_column(
+                [f"{t}\x00{e}" for t, e in zip(etype, ents)])
+        name = batch.event
+        if isinstance(name, str):
+            ev_frag, ev_frags = enc(name), None
+            nh = np.full(n, _hash(self.lib, name), dtype=np.uint64)
+        else:
+            ev_frag, ev_frags = None, [enc(x) for x in name]
+            nh = self._hash_column(name)
+        tids = batch.target_entity_id
+        tt = batch.target_entity_type
+        if tids is None:
+            th = np.zeros(n, dtype=np.uint64)
+            tgt_frags = None
+        else:
+            if isinstance(tt, str):
+                ttf = enc(tt)
+                tkeys = [f"{tt}\x00{t}" if t else "" for t in tids]
+                tgt_frags = [
+                    f',"targetEntityType":{ttf},"targetEntityId":{enc(t)}'
+                    if t else "" for t in tids]
+            else:
+                tts = tt or (None,) * n
+                tkeys = [f"{a}\x00{b}" if b and a else ""
+                         for a, b in zip(tts, tids)]
+                tgt_frags = [
+                    f',"targetEntityType":{enc(a)}'
+                    f',"targetEntityId":{enc(b)}' if b and a else ""
+                    for a, b in zip(tts, tids)]
+            th = self._hash_column(tkeys)
+        # -- times ----------------------------------------------------------
+        now = utcnow()
+        now_s = format_event_time(now)
+        et = batch.event_time
+        if et is None:
+            t_const, t_frags = now_s, None
+            ts = np.full(n, to_millis(now), dtype=np.int64)
+        elif isinstance(et, str):
+            t = parse_event_time(et)
+            t_const, t_frags = format_event_time(t), None
+            ts = np.full(n, to_millis(t), dtype=np.int64)
+        else:
+            parsed = [parse_event_time(x) if x else now for x in et]
+            t_const, t_frags = None, [format_event_time(x)
+                                      for x in parsed]
+            ts = np.array([to_millis(x) for x in parsed],
+                          dtype=np.int64)
+        # -- properties ------------------------------------------------------
+        props = batch.properties
+        p_frags = None if props is None else _props_col(props)
+        # -- payload templating: broadcast columns are inlined into the
+        # template as escaped literals, so each row pays ONE %-format
+        # over only the per-row columns (the common "all rate events
+        # now" shape formats 4 args, not 8) ---------------------------------
+        tmpl: List[str] = ['{"eventId":']
+        cols: List[list] = []
+
+        def seg(frags, const=""):
+            if frags is None:
+                tmpl.append(const.replace("%", "%%"))
+            else:
+                tmpl.append("%s")
+                cols.append(frags)
+
+        if id_frags is not None:
+            seg(id_frags)
+        else:
+            tmpl.append('"%s"')       # minted hex: inline-quotable
+            cols.append(ids)
+        tmpl.append(',"event":')
+        seg(ev_frags, ev_frag)
+        tmpl.append(',"entityType":')
+        seg(et_frags, et_frag)
+        tmpl.append(',"entityId":')
+        seg([enc(e) for e in ents])
+        seg(tgt_frags)
+        tmpl.append(',"properties":')
+        seg(p_frags, "{}")
+        tmpl.append(',"eventTime":"')
+        seg(t_frags, t_const)
+        tmpl.append(f'","tags":[],"creationTime":"{now_s}"}}')
+        fmt = "".join(tmpl)
+        payloads = [fmt % tup for tup in zip(*cols)]
+        # minted ids skip per-row key encodes entirely: the hex pool IS
+        # the concatenated key buffer (32 bytes each, constant extents)
+        keys_b = ([s.encode("utf-8") for s in ids] if supplied else None)
+        # -- routing: shards, cross-file overwrites -------------------------
+        parts = ((eh % np.uint64(self.partitions)).astype(np.int64)
+                 if self.partitions > 1 else None)
+        rows = keep if keep is not None else range(n)
+        overwrite: set = set()
+        if supplied and parts is not None:
+            # KNOWN WINDOW: this probe runs outside the overwrite
+            # stripe locks (holding every supplied id's stripe across
+            # a pipelined multi-chunk commit would stall all
+            # concurrent supplied-id writers for the import's
+            # duration). A same-id write racing the gap can leave a
+            # cross-shard duplicate — the same artifact a crash can
+            # leave, and repaired the same way: the next overwrite of
+            # that id sweeps every other file. insert_batch (the
+            # bounded server/replay path) holds its stripes instead.
+            found = self._ids_in_other_files(
+                app_id, channel_id,
+                [(keys_b[i], ids[i], int(parts[i])) for i in rows])
+            if found:
+                overwrite = {i for i in rows if ids[i] in found}
+                for i in sorted(overwrite):
+                    rec = self._record_of(batch.row_event(i), ids[i])
+                    with self._overwrite_locks[_hash(self.lib,
+                                                     ids[i]) & 63]:
+                        self._insert_overwrite(rec, app_id, channel_id,
+                                               int(parts[i]))
+
+        def block_of(sel: Optional[List[int]]) -> _Block:
+            if sel is None:               # the hot path: all rows, no
+                #                           gather — arrays used as built
+                if keys_b is None:
+                    kcat = hexes.encode("ascii")
+                    keylens = (np.full(n, 32, dtype=np.int32)
+                               if len(hexes) == (n << 5) else
+                               np.fromiter(map(len, ids),
+                                           dtype=np.int32, count=n))
+                else:
+                    kcat = b"".join(keys_b)
+                    keylens = np.fromiter(map(len, keys_b),
+                                          dtype=np.int32, count=n)
+                datalens = np.fromiter(map(len, payloads),
+                                       dtype=np.int64, count=n)
+                return _Block(n, kcat, keylens,
+                              "".join(payloads).encode("ascii"),
+                              datalens, ts, eh, nh, th, ents, tids, ids)
+            kb = ([keys_b[i] for i in sel] if keys_b is not None
+                  else [ids[i].encode("ascii") for i in sel])
+            pl = [payloads[i] for i in sel]
+            m = len(sel)
+            return _Block(
+                m, b"".join(kb),
+                np.fromiter(map(len, kb), dtype=np.int32, count=m),
+                "".join(pl).encode("ascii"),
+                np.fromiter(map(len, pl), dtype=np.int64, count=m),
+                ts[sel], eh[sel], nh[sel], th[sel],
+                [ents[i] for i in sel],
+                None if tids is None else [tids[i] for i in sel],
+                [ids[i] for i in sel])
+
+        waits = []
+        touched = set(int(parts[i]) for i in overwrite) if overwrite \
+            else set()
+        if parts is None and keep is None:
+            waits.append((0, self._submit(app_id, channel_id, 0, [],
+                                          block_of(None))))
+        else:
+            by_part: Dict[int, List[int]] = {}
+            for i in rows:
+                if i in overwrite:
+                    continue
+                by_part.setdefault(
+                    0 if parts is None else int(parts[i]), []).append(i)
+            for p, sel in by_part.items():
+                waits.append((p, self._submit(app_id, channel_id, p, [],
+                                              block_of(sel))))
+        return ids, waits, touched
 
     def _decode(self, h, eid_bytes: bytes) -> Optional[Event]:
         n = self.lib.el_get(h, eid_bytes, len(eid_bytes))
@@ -694,33 +1848,40 @@ class NativeLogEvents(base.Events):
             to_millis(until_time) if until_time else _INT64_MIN,
             entity_hash, arr, n_names, target_hash)
 
+    def _scan_one(self, hkey, h, lk, start_time=None, until_time=None,
+                  entity_type=None, entity_id=None, event_names=None,
+                  target_entity_type=None, target_entity_id=None):
+        """Coarse-filtered scan + ONE bulk payload fetch of a single
+        sub-log through the FFI (el_scan_fetch); returns raw JSON
+        payload bytes per record."""
+        with lk:
+            if self._stale(hkey, h):
+                return []          # store removed mid-read
+            self._coarse_scan(h, start_time, until_time, entity_type,
+                              entity_id, event_names,
+                              target_entity_type, target_entity_id)
+            total = self.lib.el_scan_fetch(h)
+            if total < 0:
+                raise IOError("bulk scan fetch failed")
+            n = self.lib.el_scan_nfetched(h)
+            data = ctypes.string_at(self.lib.el_scan_data(h), total)
+            offs = self.lib.el_scan_offsets(h)
+            return [data[offs[i]:offs[i + 1]] for i in range(n)]
+
     def _bulk_scan_payloads(self, app_id, channel_id, start_time,
                             until_time, entity_type, entity_id,
                             event_names, target_entity_type,
                             target_entity_id):
-        """Coarse-filtered scan + ONE bulk payload fetch through the FFI
-        per partition (el_scan_fetch), shards scanned in parallel; returns
-        raw JSON payload bytes per record."""
-        def one(hkey, h, lk):
-            with lk:
-                if self._stale(hkey, h):
-                    return []          # store removed mid-read
-                self._coarse_scan(h, start_time, until_time, entity_type,
-                                  entity_id, event_names,
-                                  target_entity_type, target_entity_id)
-                total = self.lib.el_scan_fetch(h)
-                if total < 0:
-                    raise IOError("bulk scan fetch failed")
-                n = self.lib.el_scan_nfetched(h)
-                data = ctypes.string_at(self.lib.el_scan_data(h), total)
-                offs = self.lib.el_scan_offsets(h)
-                return [data[offs[i]:offs[i + 1]] for i in range(n)]
-
+        """_scan_one over every file a read must consult, shards scanned
+        in parallel."""
         handles = self._read_handles(app_id, channel_id, entity_type,
                                      entity_id)
         payloads = []
         for chunk in self._parallel(
-                [lambda k=k, h=h, lk=lk: one(k, h, lk)
+                [lambda k=k, h=h, lk=lk: self._scan_one(
+                    k, h, lk, start_time, until_time, entity_type,
+                    entity_id, event_names, target_entity_type,
+                    target_entity_id)
                  for k, h, lk in handles]):
             payloads.extend(chunk)
         return payloads
@@ -757,12 +1918,16 @@ class NativeLogEvents(base.Events):
         touched ids' event ids come from the index, each record is an
         O(1) ``el_get`` probe — per-read cost proportional to the
         touched histories, never the log size. The first call on an
-        adopted store pays one full-scan rebuild (see _EntityIndex)."""
-        idx = self._index_of(app_id, channel_id)
+        adopted store pays one per-shard rebuild (see _EntityIndex)."""
+        indexes = self._index_of(app_id, channel_id)
         eset = {str(x) for x in (entity_ids or ())}
         tset = {str(x) for x in (target_entity_ids or ())}
+        candidates: Dict[str, None] = {}   # ordered cross-shard de-dup
+        for idx in indexes:
+            for eid in idx.candidate_ids(eset, tset):
+                candidates[eid] = None
         events = []
-        for eid in idx.candidate_ids(eset, tset):
+        for eid in candidates:
             e = self.get(eid, app_id, channel_id)
             if e is None:
                 continue     # deleted (or dangling sidecar line)

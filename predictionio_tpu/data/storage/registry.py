@@ -159,6 +159,15 @@ def _check_events_conformance(obj) -> None:
             "does not implement find_columnar_by_entities: entity-"
             "filtered reads would silently full-scan. Override it with "
             "real pushdown (see data/storage/base.py).")
+    # the bulk-ingest contract (ISSUE 7): the base insert_batch is a
+    # per-event loop — a backend shipping it would quietly serialize
+    # the columnar write route, the spill replayer, and pio import
+    if getattr(type(obj), "insert_batch", None) is base.Events.insert_batch:
+        raise StorageError(
+            f"events backend {type(obj).__module__}.{type(obj).__name__} "
+            "does not implement insert_batch: bulk ingest would fall "
+            "back to a per-event insert loop. Override it with a real "
+            "bulk write (multi-row INSERT / group commit).")
 
 
 def clear_cache() -> None:

@@ -186,6 +186,11 @@ def restore_snapshot(uri: str, name: str,
         ev.remove(dst_app, dst_ch)   # close handles + delete files
     for tmp, final in staged:
         os.replace(tmp, final)
+    # the files changed under the DAO: drop its cached handles, its
+    # negative-existence cache (a shard the store probed as missing
+    # before the restore would otherwise stay invisible) and any
+    # in-memory entity index for the namespace
+    ev.invalidate_namespace(dst_app, dst_ch)
     logger.info("snapshot %s restored into app %s channel %s (%d files)",
                 name, dst_app, dst_ch, len(manifest["files"]))
     return manifest

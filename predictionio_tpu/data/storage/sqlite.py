@@ -23,7 +23,8 @@ from typing import List, Optional
 
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import (Event, from_millis, new_event_id,
-                                         to_millis)
+                                         new_event_ids, parse_event_time,
+                                         to_millis, utcnow)
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (ABSENT, AccessKey, App,
                                                 Channel, EngineInstance,
@@ -483,12 +484,63 @@ class SQLEvents(base.Events):
                  event.target_entity_id, event.properties.to_json(),
                  to_millis(event.event_time), json.dumps(list(event.tags)),
                  event.pr_id, to_millis(event.creation_time)))
+        self._write_rows(rows)
+        return eids
+
+    def _write_rows(self, rows):
         with self.c.lock:
             self.c._conn.executemany(
                 f"INSERT OR REPLACE INTO {self.t} VALUES "
                 "(?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
             self.c._conn.commit()
-        return eids
+
+    def insert_columnar(self, batch, app_id, channel_id=None):
+        """Columnar bulk write straight from the parallel arrays: one
+        id-mint pass, rows zipped from the columns (broadcast scalars
+        ride itertools.repeat), ONE executemany + ONE commit — no
+        Event objects on the way in (ISSUE 7)."""
+        from itertools import repeat
+
+        n = batch.n
+        if n == 0:
+            return []
+        ids = batch.event_id
+        if ids is None:
+            ids = new_event_ids(n)
+        else:
+            ids = [x if x else new_event_id() for x in ids]
+        now = utcnow()
+        now_ms = to_millis(now)
+        et = batch.event_time
+        if et is None:
+            t_col = repeat(now_ms)
+        elif isinstance(et, str):
+            t_col = repeat(to_millis(parse_event_time(et)))
+        else:
+            t_col = [to_millis(parse_event_time(x)) if x else now_ms
+                     for x in et]
+        props = batch.properties
+        dumps = json.JSONEncoder(separators=(",", ":")).encode
+        p_col = (repeat("{}") if props is None
+                 else [dumps(p) if p else "{}" for p in props])
+
+        def bcast(c):
+            return repeat(c) if isinstance(c, str) else c
+
+        def tgt(c):
+            # absent targets store as NULL, matching the object path
+            if c is None or isinstance(c, str):
+                return repeat(c or None)
+            return [x or None for x in c]
+
+        chan = self._chan(channel_id)
+        rows = list(zip(ids, repeat(app_id), repeat(chan),
+                        bcast(batch.event), bcast(batch.entity_type),
+                        batch.entity_id, tgt(batch.target_entity_type),
+                        tgt(batch.target_entity_id), p_col, t_col,
+                        repeat("[]"), repeat(None), repeat(now_ms)))
+        self._write_rows(rows)
+        return ids
 
     def _from_row(self, r) -> Event:
         return Event(

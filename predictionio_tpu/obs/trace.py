@@ -28,9 +28,9 @@ from __future__ import annotations
 import collections
 import contextvars
 import itertools
+import os
 import threading
 import time
-import uuid
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
@@ -67,6 +67,23 @@ class Span:
         return d
 
 
+_tid_pool = threading.local()
+
+
+def _new_trace_id() -> str:
+    """16-hex trace id, entropy drawn 128 ids at a time into a
+    thread-local pool — one request-path os.urandom syscall (with its
+    GIL release/reacquire round trip) per 128 traces instead of per
+    trace, mirroring event.new_event_id."""
+    off = getattr(_tid_pool, "off", None)
+    if not off:   # None or exhausted (0)
+        _tid_pool.hexes = os.urandom(8 * 128).hex()
+        off = 128
+    _tid_pool.off = off - 1
+    i = (off - 1) << 4
+    return _tid_pool.hexes[i:i + 16]
+
+
 class Trace:
     """One span tree. The root span shares the trace's kind as its
     name; ``links`` are trace_ids of causally-related traces (event
@@ -77,7 +94,7 @@ class Trace:
     MAX_LINKS = 64
 
     def __init__(self, kind: str, trace_id: Optional[str] = None):
-        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.trace_id = trace_id or _new_trace_id()
         self.kind = kind
         self.root = Span(kind, None)
         self.spans: List[Span] = [self.root]

@@ -251,6 +251,12 @@ class FaultyEvents(base.Events):
         self.injector.before("storage.write")
         return self.inner.insert_batch(events, app_id, channel_id)
 
+    def insert_columnar(self, batch, app_id, channel_id=None):
+        # explicit forward: base.Events has a materialize-and-batch
+        # default, so __getattr__ would bypass the backend's fast path
+        self.injector.before("storage.write")
+        return self.inner.insert_columnar(batch, app_id, channel_id)
+
     def delete(self, event_id, app_id, channel_id=None):
         self.injector.before("storage.write")
         return self.inner.delete(event_id, app_id, channel_id)

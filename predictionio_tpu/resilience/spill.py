@@ -39,7 +39,7 @@ import struct
 import threading
 import time
 import zlib
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event, new_event_id
 from predictionio_tpu.resilience.policy import TRANSIENT_ERRORS
@@ -153,6 +153,34 @@ class SpillWAL:
             self._size += len(record)
             self._pending_records += 1
         return eid
+
+    def append_many(self, events, app_id: int,
+                    channel_id: Optional[int] = None) -> List[str]:
+        """Durably spill a whole batch under ONE lock / write / fsync —
+        the columnar bulk-write route's outage path (per-event fsyncs
+        during an outage would throttle exactly the burst the WAL
+        exists to absorb). Ids are assigned where missing; insertion
+        order is the list order, as the replayer expects."""
+        eids = []
+        frames = []
+        for event in events:
+            eid = event.event_id or new_event_id()
+            eids.append(eid)
+            payload = json.dumps(
+                {"appId": app_id, "channelId": channel_id,
+                 "event": event.with_id(eid).to_dict()},
+                separators=(",", ":")).encode("utf-8")
+            frames.append(
+                _HEADER.pack(len(payload), zlib.crc32(payload)) + payload)
+        blob = b"".join(frames)
+        with self._lock:
+            self._f.write(blob)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+            self._size += len(blob)
+            self._pending_records += len(frames)
+        return eids
 
     # -- read side ----------------------------------------------------------
     def pending(self) -> Iterator[Tuple[int, int, Optional[int], Event]]:
@@ -492,55 +520,143 @@ class SpillReplayer:
             pass
         return True
 
+    #: consecutive same-namespace records per bulk replay flush
+    REPLAY_BATCH = 256
+
+    def _insert_batch(self, app_id, channel_id, events) -> int:
+        """A same-namespace run into the primary via ONE
+        ``insert_batch`` (ISSUE 7 satellite: recovery drains at bulk
+        speed — exactly when throughput matters), id-deduped by
+        get-probes first. Returns the inserted count. Transient
+        failures raise after breaker gating + retry; a partial commit
+        re-replays as dedups (ids were pre-assigned at spill time)."""
+        def attempt():
+            if self.breaker is not None:
+                self.breaker.allow()
+            try:
+                fresh = [e for e in events
+                         if self.events.get(e.event_id, app_id,
+                                            channel_id) is None]
+                if fresh:
+                    self.events.insert_batch(fresh, app_id, channel_id)
+            except self.TRANSIENT_ERRORS:
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                raise
+            except Exception:
+                # reachable store, deterministic rejection: breaker
+                # success; the caller's per-record fallback pinpoints it
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                raise
+            if self.breaker is not None:
+                self.breaker.record_success()
+            return len(fresh)
+
+        return self.policy.call(attempt)
+
     def drain(self, max_records: Optional[int] = None) -> int:
         """Replay pending records in order until the WAL is empty, the
-        cap is hit, or an insert fails. A transient failure stops the
-        drain AT the failing record (nothing is skipped); a record the
-        HEALTHY store keeps rejecting is quarantined after
-        ``quarantine_after`` drains so it cannot wedge the records
-        behind it. Returns records replayed+deduped."""
+        cap is hit, or an insert fails. Consecutive records for the
+        same (app, channel) land as ONE ``insert_batch`` per
+        REPLAY_BATCH run (one group commit / multi-row INSERT instead
+        of a per-frame insert — the slowest possible path during
+        recovery, which ISSUE 7 retires); a run the store rejects
+        deterministically re-replays per record so the poisoned frame
+        is pinpointed (and eventually quarantined) exactly as before.
+        A transient failure stops the drain AT the failing run
+        (nothing is skipped). Returns records replayed+deduped."""
         done = 0
-        last_offset = None
-        since_ckpt = 0
-        try:
-            for offset, app_id, channel_id, event in self.wal.pending():
-                try:
-                    inserted = self._insert_one(app_id, channel_id, event)
-                except Exception as e:
-                    self.last_error = str(e)
-                    if self._note_head_failure(offset, app_id,
-                                               channel_id, event, e):
-                        # quarantined: step past it and keep draining
-                        self.wal.checkpoint(offset,
-                                            records=since_ckpt + 1)
-                        since_ckpt = 0
-                        last_offset = None
-                        continue
-                    logger.warning("spill replay stopped at event %s: %s",
-                                   event.event_id, e)
+        buf: list = []           # [(offset, event)] — one namespace run
+        key: Optional[tuple] = None
+
+        def flush_per_record() -> bool:
+            """PR 3 semantics for one buffered run: pinpoint / maybe
+            quarantine the poisoned record. True = keep draining."""
+            nonlocal done
+            ok_since = 0
+            last = None
+            keep = True
+            app_id, channel_id = key
+            try:
+                for offset, event in buf:
+                    try:
+                        inserted = self._insert_one(app_id, channel_id,
+                                                    event)
+                    except Exception as e:
+                        self.last_error = str(e)
+                        if self._note_head_failure(offset, app_id,
+                                                   channel_id, event, e):
+                            # quarantined: step past, keep draining
+                            self.wal.checkpoint(offset,
+                                                records=ok_since + 1)
+                            ok_since = 0
+                            last = None
+                            continue
+                        logger.warning(
+                            "spill replay stopped at event %s: %s",
+                            event.event_id, e)
+                        keep = False
+                        break
+                    if inserted:
+                        self.replayed += 1
+                        self._c_replayed.inc()
+                    else:
+                        self.deduped += 1
+                        self._c_deduped.inc()
+                    done += 1
+                    ok_since += 1
+                    last = offset
+            finally:
+                if last is not None:
+                    self.wal.checkpoint(last, records=ok_since)
+                buf.clear()
+            return keep
+
+        def flush() -> bool:
+            """Land one buffered run; True = keep draining."""
+            nonlocal done
+            if not buf:
+                return True
+            try:
+                inserted = self._insert_batch(key[0], key[1],
+                                              [e for _, e in buf])
+            except self.TRANSIENT_ERRORS as e:
+                # outage-class: stop AT the run head; nothing skipped
+                self.last_error = str(e)
+                logger.warning("spill replay stopped at event %s: %s",
+                               buf[0][1].event_id, e)
+                buf.clear()
+                return False
+            except Exception:
+                return flush_per_record()
+            self.replayed += inserted
+            self._c_replayed.inc(inserted)
+            self.deduped += len(buf) - inserted
+            self._c_deduped.inc(len(buf) - inserted)
+            done += len(buf)
+            self.wal.checkpoint(buf[-1][0], records=len(buf))
+            buf.clear()
+            return True
+
+        exhausted = True
+        for offset, app_id, channel_id, event in self.wal.pending():
+            k = (app_id, channel_id)
+            if key != k or len(buf) >= self.REPLAY_BATCH:
+                if buf and not flush():
+                    exhausted = False
                     break
-                if inserted:
-                    self.replayed += 1
-                    self._c_replayed.inc()
-                else:
-                    self.deduped += 1
-                    self._c_deduped.inc()
-                done += 1
-                since_ckpt += 1
-                last_offset = offset
-                if done % self.batch_checkpoint == 0:
-                    self.wal.checkpoint(offset, records=since_ckpt)
-                    since_ckpt = 0
-                    last_offset = None
-                if max_records is not None and done >= max_records:
-                    break
-            else:
-                self.last_error = None
-                self._head_fail_offset = None
-                self._head_fail_count = 0
-        finally:
-            if last_offset is not None:
-                self.wal.checkpoint(last_offset, records=since_ckpt)
+                key = k
+            buf.append((offset, event))
+            if max_records is not None \
+                    and done + len(buf) >= max_records:
+                exhausted = False
+                break
+        clean = flush() if buf else True
+        if exhausted and clean:
+            self.last_error = None
+            self._head_fail_offset = None
+            self._head_fail_count = 0
         return done
 
     # -- background loop ----------------------------------------------------

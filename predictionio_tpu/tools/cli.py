@@ -425,7 +425,8 @@ def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api.event_server import (EventServer,
                                                         EventServerConfig)
     server = EventServer(EventServerConfig(ip=args.ip, port=args.port,
-                                           stats=args.stats))
+                                           stats=args.stats,
+                                           max_batch=args.max_batch))
     _print(f"Event Server is listening on http://{args.ip}:{args.port}")
     return _serve_foreground(server, "event server")
 
@@ -1043,6 +1044,10 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--ip", default="0.0.0.0")
     ev.add_argument("--port", type=int, default=7070)
     ev.add_argument("--stats", action="store_true")
+    ev.add_argument("--max-batch", type=int, default=50,
+                    help="/batch/events.json size cap (default 50, the "
+                         "reference wire limit); the columnar write "
+                         "route has its own much larger bound")
     ev.set_defaults(func=cmd_eventserver)
 
     db = sub.add_parser("dashboard")

@@ -202,10 +202,50 @@ class TestFindEvents:
                             batch)
         assert status == 200
         assert [r["status"] for r in body] == [201, 400, 201]
+        # oversize: 413 with the honest limit in the body (ISSUE 7),
+        # not a silent 400
         status, body = call(p, "POST",
                             "/batch/events.json?accessKey=testkey",
                             [EVENT] * 51)
-        assert status == 400
+        assert status == 413
+        assert body["maxBatch"] == 50 and body["received"] == 51
+
+    def test_columnar_write_per_row_failures(self, server):
+        """Columnar bulk write keeps /batch semantics for per-ROW
+        problems (ISSUE 7 acceptance): deterministic rejections come
+        back as per-record 4xx entries in ``failures`` while the good
+        rows land; a clean batch acks 201 with ids on request."""
+        p = server.config.port
+        col = {"event": ["rate", "$invalid", "rate"],
+               "entityType": "user",
+               "entityId": ["u1", "u2", "u3"],
+               "targetEntityType": "item",
+               "targetEntityId": ["i1", "i2", "i3"],
+               "properties": [{"rating": 1.0}, {"rating": 2.0},
+                              {"rating": 3.0}],
+               "returnIds": True}
+        status, body = call(
+            p, "POST", "/events/columnar.json?accessKey=testkey", col)
+        assert status == 200            # partial: mirrors /batch
+        assert body["eventsCreated"] == 2
+        assert len(body["eventIds"]) == 2
+        [f] = body["failures"]
+        assert f["index"] == 1 and f["status"] == 400
+        assert "$invalid" in f["message"]
+        status, got = call(
+            p, "GET", "/events.json?accessKey=testkey&event=rate")
+        assert status == 200 and {e["entityId"] for e in got} == \
+            {"u1", "u3"}
+        # clean batch: 201, count only unless ids are asked for
+        clean = {"event": "rate", "entityType": "user",
+                 "entityId": ["c1", "c2"],
+                 "targetEntityType": "item",
+                 "targetEntityId": ["i9", "i9"],
+                 "properties": [{"rating": 4.0}, {"rating": 5.0}]}
+        status, body = call(
+            p, "POST", "/events/columnar.json?accessKey=testkey", clean)
+        assert status == 201
+        assert body["eventsCreated"] == 2 and "eventIds" not in body
 
     def test_stats(self, server):
         p = server.config.port

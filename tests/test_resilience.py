@@ -306,6 +306,14 @@ class _FlakyEvents(MemEvents):
             raise IOError("primary store down")
         return super().insert(event, app_id, channel_id)
 
+    def insert_batch(self, events, app_id, channel_id=None):
+        # a down store fails bulk writes too (the replayer drains in
+        # bulk since ISSUE 7); one batch = one attempt
+        self.attempts += 1
+        if self.attempts <= self.fail_first:
+            raise IOError("primary store down")
+        return super().insert_batch(events, app_id, channel_id)
+
 
 class TestSpillReplayer:
     def _replayer(self, wal, store, **kw):
@@ -361,6 +369,14 @@ class TestSpillReplayer:
                 if event.event_id == ids[1]:
                     raise ValueError("constraint violation")  # always
                 return super().insert(event, app_id, channel_id)
+
+            def insert_batch(self, events, app_id, channel_id=None):
+                # like a real multi-row INSERT: one poisoned record
+                # rejects the statement (the replayer then re-replays
+                # the run per record to pinpoint it)
+                if any(e.event_id == ids[1] for e in events):
+                    raise ValueError("constraint violation")
+                return super().insert_batch(events, app_id, channel_id)
 
         store = _Rejecting()
         r = self._replayer(wal, store)

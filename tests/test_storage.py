@@ -895,8 +895,8 @@ class TestNativeLogEntityIndex:
 
         c2 = self._client(tmp_path)
         ev2 = c2.get_data_object("events", "ns")
-        idx = ev2._index_of(1, None)
-        assert idx.loaded                     # adopted, not rebuilt
+        indexes = ev2._index_of(1, None)      # one sidecar per sub-log
+        assert indexes and all(ix.loaded for ix in indexes)
         assert len(ev2.find_columnar_by_entities(
             1, entity_ids=["u1"])["t"]) == 2
         # incremental maintenance after adoption
@@ -1027,3 +1027,263 @@ class TestEventsBackendConformance:
         ev = Storage.get_events()
         assert type(ev).find_columnar_by_entities \
             is not base.Events.find_columnar_by_entities
+
+    def test_insert_batch_base_default_is_refused(self):
+        """ISSUE 7: a backend shipping the base per-event insert_batch
+        loop would quietly serialize the columnar write route and the
+        spill replayer — the registry refuses it."""
+        from predictionio_tpu.data.storage import base
+        from predictionio_tpu.data.storage.registry import (
+            StorageError, _check_events_conformance)
+        from predictionio_tpu.data.storage.memory import MemEvents
+
+        class BulklessBackend(base.Events):
+            # real filtered-read pushdown, but the base insert_batch
+            find_columnar_by_entities = MemEvents.find_columnar_by_entities
+
+            def init(self, app_id, channel_id=None):
+                return True
+
+            def remove(self, app_id, channel_id=None):
+                return True
+
+            def insert(self, event, app_id, channel_id=None):
+                return "x"
+
+            def get(self, event_id, app_id, channel_id=None):
+                return None
+
+            def delete(self, event_id, app_id, channel_id=None):
+                return False
+
+            def find(self, app_id, channel_id=None, **kw):
+                return iter(())
+
+        with pytest.raises(StorageError, match="insert_batch"):
+            _check_events_conformance(BulklessBackend())
+
+    def test_all_registered_backends_override_insert_batch(self):
+        from predictionio_tpu.data.storage import base
+        from predictionio_tpu.data.storage.eventserver_client import \
+            RemoteEvents
+        from predictionio_tpu.data.storage.memory import MemEvents
+        from predictionio_tpu.data.storage.mysql import MyEvents
+        from predictionio_tpu.data.storage.nativelog import NativeLogEvents
+        from predictionio_tpu.data.storage.pgsql import PGEvents
+        from predictionio_tpu.data.storage.sqlite import SQLEvents
+        for cls in (MemEvents, SQLEvents, PGEvents, MyEvents,
+                    NativeLogEvents, RemoteEvents):
+            assert cls.insert_batch is not base.Events.insert_batch, cls
+
+
+def columnar_body(n, event="rate", etype="user", with_targets=True,
+                  with_props=True, ids=None):
+    from predictionio_tpu.data.columnar import normalize_columnar
+    d = {"event": event, "entityType": etype,
+         "entityId": [f"u{i % 7}" for i in range(n)]}
+    if with_targets:
+        d["targetEntityType"] = "item"
+        d["targetEntityId"] = [f"i{i % 5}" for i in range(n)]
+    if with_props:
+        d["properties"] = [{"rating": float(i % 5)} for i in range(n)]
+    if ids is not None:
+        d["eventId"] = ids
+    return normalize_columnar(d)
+
+
+class TestInsertBatch:
+    """ISSUE 7 backend contract: bulk writes must match the serial
+    path's semantics — per-input ids in order, last-wins in-batch id
+    dedup, overwrite-by-id across prior inserts, and entity-index
+    visibility the moment the batch acks."""
+
+    def test_ids_in_input_order(self, events):
+        evs = [mk(eid=f"u{i}", sec=i) for i in range(6)]
+        eids = events.insert_batch(evs, 1)
+        assert len(eids) == 6
+        for i, eid in enumerate(eids):
+            assert events.get(eid, 1).entity_id == f"u{i}"
+
+    def test_empty_batch_is_noop(self, events):
+        assert events.insert_batch([], 1) == []
+        assert list(events.find(1)) == []
+
+    def test_in_batch_duplicate_id_last_wins(self, events):
+        evs = [mk(eid="uA", sec=1, event_id="dup"),
+               mk(eid="uB", sec=2),
+               mk(eid="uC", sec=3, event_id="dup")]
+        eids = events.insert_batch(evs, 1)
+        assert eids[0] == eids[2] == "dup"
+        got = events.get("dup", 1)
+        assert got.entity_id == "uC"
+        assert len(list(events.find(1))) == 2
+
+    def test_overwrite_by_supplied_id(self, events):
+        # the serial path wrote it first; the batch re-routes it (on
+        # nativelog-p4 the entity change moves it across shard files)
+        events.insert(mk(eid="uOld", sec=1, event_id="X"), 1)
+        events.insert_batch([mk(eid="uNew", sec=2, event_id="X"),
+                             mk(eid="uFresh", sec=3)], 1)
+        got = events.get("X", 1)
+        assert got.entity_id == "uNew"
+        all_ents = sorted(e.entity_id for e in events.find(1))
+        assert all_ents == ["uFresh", "uNew"]
+
+    def test_entidx_visible_immediately_after_ack(self, events):
+        # warm the filtered-read index first (on nativelog this
+        # materializes the .entidx sidecar), then batch-insert: the new
+        # rows must be visible to the NEXT filtered read, no
+        # rebuild/restart allowed
+        events.insert(mk(eid="uIdx", sec=1), 1)
+        assert len(events.find_columnar_by_entities(
+            1, entity_ids=["uIdx"])["t"]) == 1
+        events.insert_batch(
+            [mk(eid="uIdx", sec=s) for s in range(2, 6)], 1)
+        assert len(events.find_columnar_by_entities(
+            1, entity_ids=["uIdx"])["t"]) == 5
+        # and on the target side
+        events.insert_batch(
+            [mk(eid="uX", sec=7, target_entity_type="item",
+                target_entity_id="iIdx")], 1)
+        assert len(events.find_columnar_by_entities(
+            1, target_entity_ids=["iIdx"])["t"]) == 1
+
+
+class TestInsertColumnar:
+    """The columnar bulk-write DAO contract over every backend: the
+    vectorized fast paths (nativelog blocks, sqlite executemany) must
+    be indistinguishable from materialize-and-batch."""
+
+    def test_roundtrip_broadcast_columns(self, events):
+        b = columnar_body(10)
+        ids = events.insert_columnar(b, 1)
+        assert len(ids) == len(set(ids)) == 10
+        got = events.get(ids[3], 1)
+        assert got.event == "rate"
+        assert got.entity_type == "user"
+        assert got.entity_id == "u3"
+        assert got.target_entity_type == "item"
+        assert got.target_entity_id == "i3"
+        assert got.properties.fields["rating"] == 3.0
+        assert got.event_time is not None
+
+    def test_no_targets_no_props(self, events):
+        b = columnar_body(4, event="$set", with_targets=False,
+                          with_props=False)
+        ids = events.insert_columnar(b, 1)
+        got = events.get(ids[0], 1)
+        assert not got.target_entity_id
+        assert got.properties.fields == {}
+
+    def test_property_numeric_type_preserved(self, events):
+        """An int cell and an equal float cell are distinct values:
+        the framing memo must not hand 1.0 the cached fragment for 1
+        (they compare and hash equal)."""
+        from predictionio_tpu.data.columnar import normalize_columnar
+        b = normalize_columnar({
+            "event": "rate", "entityType": "user",
+            "entityId": ["a", "b"],
+            "properties": [{"rating": 1}, {"rating": 1.0}]})
+        ids = events.insert_columnar(b, 1)
+        assert type(events.get(ids[0], 1).properties.fields["rating"]) \
+            is int
+        assert type(events.get(ids[1], 1).properties.fields["rating"]) \
+            is float
+
+    def test_bad_event_time_rejected_per_row(self, events):
+        """A malformed eventTime cell is a per-ROW 400 at validation —
+        never a whole-request failure after earlier rows committed
+        (the pipelined nativelog path commits chunk by chunk)."""
+        from predictionio_tpu.data.columnar import (normalize_columnar,
+                                                    validate_rows)
+        b = normalize_columnar({
+            "event": "rate", "entityType": "user",
+            "entityId": ["u1", "u2", "u3"],
+            "eventTime": ["2026-01-02T03:04:05.000Z", "not-a-date",
+                          "2026-01-02T03:04:06.000Z"]})
+        keep, fails = validate_rows(b)
+        assert keep == [0, 2]
+        assert [(i, s) for i, s, _ in fails] == [(1, 400)]
+        ids = events.insert_columnar(b.select(keep), 1)
+        assert len(ids) == 2
+
+    def test_supplied_ids_and_event_times(self, events):
+        from predictionio_tpu.data.columnar import normalize_columnar
+        b = normalize_columnar({
+            "event": "buy", "entityType": "user",
+            "entityId": ["a", "b"],
+            "eventId": ["id-a", "id-b"],
+            "eventTime": ["2026-01-02T03:04:05.000Z",
+                          "2026-01-02T03:04:06.000Z"]})
+        ids = events.insert_columnar(b, 1)
+        assert ids == ["id-a", "id-b"]
+        got = events.get("id-b", 1)
+        assert got.entity_id == "b"
+        assert got.event_time.second == 6
+
+    def test_per_row_event_names(self, events):
+        from predictionio_tpu.data.columnar import normalize_columnar
+        b = normalize_columnar({
+            "event": ["rate", "buy", "rate"], "entityType": "user",
+            "entityId": ["a", "b", "c"]})
+        ids = events.insert_columnar(b, 1)
+        assert events.get(ids[1], 1).event == "buy"
+        assert len(list(events.find(1, event_names=["rate"]))) == 2
+
+    def test_matches_object_path(self, events):
+        """Byte-level agreement with the serial object path on the
+        fields the spec cares about."""
+        b = columnar_body(5)
+        ids = events.insert_columnar(b, 1)
+        ref = [mk("rate", f"u{i % 7}", sec=i + 10,
+                  target_entity_type="item", target_entity_id=f"i{i % 5}",
+                  properties=DataMap({"rating": float(i % 5)}))
+               for i in range(5)]
+        rids = events.insert_batch(ref, 1)
+        for cid, rid, i in zip(ids, rids, range(5)):
+            c, r = events.get(cid, 1), events.get(rid, 1)
+            assert (c.event, c.entity_type, c.entity_id,
+                    c.target_entity_type, c.target_entity_id,
+                    c.properties.fields) == \
+                   (r.event, r.entity_type, r.entity_id,
+                    r.target_entity_type, r.target_entity_id,
+                    r.properties.fields), i
+
+
+class TestNativeLogColumnarPipeline:
+    """The chunked pipelined path (> _COLUMNAR_CHUNK rows) must be
+    invisible: same results as single-shot, across partition counts."""
+
+    @pytest.fixture(params=[1, 4])
+    def nl_events(self, request, tmp_path):
+        from predictionio_tpu.data.storage.nativelog import \
+            StorageClient as NativeClient
+        c = NativeClient(StorageClientConfig(
+            "TEST", "nativelog", {"PATH": str(tmp_path / "plog"),
+                                  "PARTITIONS": str(request.param)}))
+        ev = c.get_data_object("events", "test")
+        ev.init(1)
+        # shrink the chunk so the pipelined path runs at test sizes
+        ev._COLUMNAR_CHUNK = 64
+        yield ev
+        c.close()
+
+    def test_pipelined_equals_single_shot(self, nl_events):
+        n = 500   # > 64 * 1.5 -> pipelined
+        b = columnar_body(n)
+        ids = nl_events.insert_columnar(b, 1)
+        assert len(ids) == len(set(ids)) == n
+        for i in (0, 63, 64, 200, n - 1):
+            got = nl_events.get(ids[i], 1)
+            assert got is not None, i
+            assert got.entity_id == f"u{i % 7}", i
+            assert got.properties.fields["rating"] == float(i % 5), i
+        assert len(list(nl_events.find(1, limit=-1))) == n
+
+    def test_pipelined_with_supplied_distinct_ids(self, nl_events):
+        n = 200
+        ids_in = [f"sid{i:05d}" for i in range(n)]
+        b = columnar_body(n, ids=ids_in)
+        assert nl_events.insert_columnar(b, 1) == ids_in
+        assert nl_events.get("sid00199", 1).entity_id == f"u{199 % 7}"
+        assert len(list(nl_events.find(1, limit=-1))) == n
