@@ -1,0 +1,35 @@
+"""`pio lint` — whole-repo static analysis for the defect classes the
+rebuild keeps paying for by hand.
+
+The Spark runtime this repo replaced (MLlib's managed executors) made
+whole families of bugs impossible by construction; hand-rolled Python
+threading + JAX dispatch re-opened them, and the PR 4 device-cache
+gc-callback deadlock, the PR 7 nativelog lock convoy, and a string of
+review-round catches (locks held across fsync, jit-capture recompile
+hazards) are all instances of classes a mechanical AST pass can find.
+
+Three rule families over ``predictionio_tpu/``:
+
+- ``LOCK*`` — lock discipline: the repo-wide lock graph (order cycles =
+  deadlock potential), locks held across blocking calls (FFI ``el_*``,
+  fsync/file IO, HTTP, queue waits, jit dispatch — the PR 7 convoy
+  class), attributes mutated from background threads without a lock.
+- ``JAX*``  — hot-path hygiene: implicit host syncs in serving/fold
+  code, jit-of-closure recompile hazards, jit built per request,
+  donated-buffer reuse.
+- ``COST*`` — hot-path cost: fsync, eager log-string formatting, or
+  metric *registration* (vs. increment) on the ingest-ack/query paths.
+
+Accepted findings live in ``conf/lint_baseline.json`` with one-line
+justifications; the CI gate (tier-1 ``tests/test_static_analysis.py``
+and ``pio lint --json``) is **zero NEW findings**.
+"""
+
+from predictionio_tpu.analysis.core import (Finding, RepoModel, Rule,
+                                            RULES)
+from predictionio_tpu.analysis.runner import (LintReport,
+                                              default_baseline_path,
+                                              default_root, run_lint)
+
+__all__ = ["Finding", "RepoModel", "Rule", "RULES", "LintReport",
+           "run_lint", "default_root", "default_baseline_path"]

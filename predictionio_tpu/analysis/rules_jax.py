@@ -1,0 +1,299 @@
+"""JAX* rules: hot-path hygiene for serving/fold code.
+
+"Hot zone" = modules whose path has a ``serving``/``ops``/``guard``
+segment or is ``fold_in.py`` — the code that runs per query or per fold
+tick, where one stray ``.item()`` stalls the dispatch pipeline and one
+uncached ``jax.jit`` recompiles for minutes (BENCH_r01: warmup 231 s vs
+3.9 ms steady-state).
+
+Device-value taint is per-function and syntactic: a local assigned from
+a ``jnp.*``/``jax.*`` call or a known-jitted callable is device-
+resident; host conversions of tainted names (or any ``.item()`` in the
+zone) are findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from predictionio_tpu.analysis.core import (Finding, FunctionInfo,
+                                            RepoModel, attr_chain,
+                                            jit_donated_positions,
+                                            register_rule)
+
+JAX001 = register_rule(
+    "JAX001", "implicit host sync on hot path",
+    ".item(), float()/int()/bool(), or np.asarray()/np.array() applied "
+    "to a device value inside serving/fold code — each one blocks on "
+    "the async dispatch queue and forces a device-to-host transfer per "
+    "call. Batch the readback or keep the value on device.")
+
+JAX002 = register_rule(
+    "JAX002", "jit of closure (recompile hazard)",
+    "jax.jit applied to a locally-defined function that captures "
+    "enclosing variables. Every call of the enclosing function builds "
+    "a NEW closure; jit's cache keys on function identity, so each "
+    "build recompiles unless the wrapper is cached by the enclosing "
+    "scope. Cache the jitted callable (module dict / lru_cache) keyed "
+    "by the captured statics.")
+
+JAX003 = register_rule(
+    "JAX003", "jit constructed per call (uncached)",
+    "jax.jit(...) executed inside a function body without a visible "
+    "cache (no lru_cache decorator, result not stored in a cache "
+    "container). On a per-request or per-tick path this recompiles "
+    "every invocation — minutes of XLA time per BENCH_r01.")
+
+JAX004 = register_rule(
+    "JAX004", "donated buffer reused after dispatch",
+    "An argument at a donate_argnums position is used again after the "
+    "jitted call. Donation invalidates the buffer; reuse returns "
+    "garbage or raises depending on backend (and silently breaks when "
+    "donation is re-enabled on TPU).")
+
+_HOT_SEGMENTS = {"serving", "ops", "guard"}
+
+
+def in_hot_zone(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return bool(_HOT_SEGMENTS.intersection(parts[:-1])) \
+        or parts[-1] == "fold_in.py"
+
+
+_DEVICE_ROOTS = {"jnp", "jax", "lax"}
+_HOST_CASTS = {"float", "int", "bool"}
+_NP_CONVERTERS = {("np", "asarray"), ("np", "array"),
+                  ("numpy", "asarray"), ("numpy", "array"),
+                  ("onp", "asarray"), ("onp", "array")}
+
+
+def _tainted_names(fn: FunctionInfo) -> Set[str]:
+    """Locals assigned from a jax/jnp call or a known-jitted callable
+    anywhere in the function (flow-insensitive: assignment order inside
+    branches isn't tracked, the zone restriction carries the signal)."""
+    jitted = set(fn.module.jitted)
+    for ev in fn.events:
+        if ev.kind == "store" and ev.chain and ev.chain[-1] == "jit":
+            jitted.add(ev.name)
+    out: Set[str] = set()
+    for ev in fn.events:
+        if ev.kind != "store" or not ev.chain:
+            continue
+        root = ev.chain[0]
+        if root in _DEVICE_ROOTS or root in jitted:
+            out.add(ev.name)
+    return out
+
+
+def check_jax001(repo: RepoModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for key, fn in repo.functions.items():
+        if not in_hot_zone(fn.module.relpath):
+            continue
+        tainted = _tainted_names(fn)
+        for ev in fn.events:
+            if ev.kind != "call":
+                continue
+            chain, node = ev.chain, ev.node
+            if chain[-1] == "item" and len(chain) >= 2:
+                findings.append(Finding(
+                    JAX001.id, fn.module.relpath, ev.line, fn.qualname,
+                    f"item:{chain[-2]}",
+                    f"{'.'.join(chain)}() forces a device sync per "
+                    f"call"))
+                continue
+            arg0 = _first_arg_name(node)
+            if arg0 is None or arg0 not in tainted:
+                continue
+            if len(chain) == 1 and chain[0] in _HOST_CASTS:
+                findings.append(Finding(
+                    JAX001.id, fn.module.relpath, ev.line, fn.qualname,
+                    f"{chain[0]}:{arg0}",
+                    f"{chain[0]}({arg0}) converts a device value on "
+                    f"the host (implicit transfer + sync)"))
+            elif tuple(chain[-2:]) in _NP_CONVERTERS:
+                findings.append(Finding(
+                    JAX001.id, fn.module.relpath, ev.line, fn.qualname,
+                    f"asarray:{arg0}",
+                    f"{'.'.join(chain)}({arg0}) pulls a device value "
+                    f"to host memory (implicit transfer + sync)"))
+    return findings
+
+
+def _first_arg_name(node: Optional[ast.AST]) -> Optional[str]:
+    if not isinstance(node, ast.Call) or not node.args:
+        return None
+    a = node.args[0]
+    return a.id if isinstance(a, ast.Name) else None
+
+
+def _free_vars(fn_node: ast.AST, params: Set[str]) -> Set[str]:
+    """Names loaded but never bound in the function — closure captures
+    (module globals are filtered by the caller)."""
+    bound = set(params)
+    loaded: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Store):
+                bound.add(node.id)
+            elif isinstance(node.ctx, ast.Load):
+                loaded.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn_node:
+                bound.add(node.name)
+    import builtins
+    return {n for n in loaded - bound if not hasattr(builtins, n)}
+
+
+def _jit_calls(fn: FunctionInfo):
+    for ev in fn.events:
+        if ev.kind in ("call", "store") and ev.chain \
+                and ev.chain[-1] == "jit" and ev.node is not None:
+            # the actual jit Call node: stores carry the Assign node
+            node = ev.node
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                node = node.value
+            if isinstance(node, ast.Call):
+                yield ev, node
+
+
+def check_jax002(repo: RepoModel) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for key, fn in repo.functions.items():
+        nested_by_name = {repo.functions[k].name: repo.functions[k]
+                          for k in fn.nested}
+        module_globals = _module_globals(fn)
+        for ev, call in _jit_calls(fn):
+            if (fn.key, ev.line) in seen:
+                continue
+            seen.add((fn.key, ev.line))
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            target = nested_by_name.get(call.args[0].id)
+            if target is None:
+                continue
+            free = _free_vars(target.node, target.params)
+            free -= module_globals
+            free -= fn.module.imports.keys()
+            if free:
+                findings.append(Finding(
+                    JAX002.id, fn.module.relpath, ev.line, fn.qualname,
+                    f"closure:{target.name}",
+                    f"jax.jit({target.name}) where {target.name} "
+                    f"captures {sorted(free)} from the enclosing scope "
+                    f"— a fresh closure per call recompiles unless the "
+                    f"jitted wrapper is cached"))
+    return findings
+
+
+def _module_globals(fn: FunctionInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in fn.module.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            out.add(node.name)
+    return out
+
+
+def _has_cache_exemption(fn: FunctionInfo, jit_store_name: str) -> bool:
+    """The enclosing function visibly caches the jitted callable:
+    lru_cache-decorated, or the jit result is stored into a subscript
+    (``_CACHE[key] = fn``) somewhere in the function."""
+    for dec in getattr(fn.node, "decorator_list", []):
+        chain = attr_chain(dec if not isinstance(dec, ast.Call)
+                           else dec.func)
+        if chain and chain[-1] in ("lru_cache", "cache"):
+            return True
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    v = node.value
+                    if isinstance(v, ast.Name) and v.id == jit_store_name:
+                        return True
+                    if isinstance(v, ast.Call) and \
+                            (attr_chain(v.func) or ())[-1:] == ("jit",):
+                        return True
+    return False
+
+
+def check_jax003(repo: RepoModel) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for key, fn in repo.functions.items():
+        for ev, call in _jit_calls(fn):
+            if (fn.key, ev.line) in seen:
+                continue
+            seen.add((fn.key, ev.line))
+            store_name = ev.name if ev.kind == "store" else ""
+            if _has_cache_exemption(fn, store_name):
+                continue
+            findings.append(Finding(
+                JAX003.id, fn.module.relpath, ev.line, fn.qualname,
+                f"jit:{store_name or 'inline'}",
+                f"jax.jit constructed inside {fn.qualname} with no "
+                f"visible cache — recompiles on every invocation"))
+    return findings
+
+
+def check_jax004(repo: RepoModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for key, fn in repo.functions.items():
+        donating = dict(fn.module.jitted)   # name -> positions
+        donating = {n: p for n, p in donating.items() if p}
+        for ev in fn.events:                # local jit wrappers
+            if ev.kind == "store" and ev.chain \
+                    and ev.chain[-1] == "jit" and ev.node is not None:
+                node = ev.node
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    pos = jit_donated_positions(node.value)
+                    if pos:
+                        donating[ev.name] = pos
+        if not donating:
+            continue
+        # calls to donating wrappers: donated positional Name args must
+        # not be loaded after the call line
+        for ev in fn.events:
+            if ev.kind != "call" or len(ev.chain) != 1 \
+                    or ev.chain[0] not in donating:
+                continue
+            call = ev.node
+            if not isinstance(call, ast.Call):
+                continue
+            for pos in donating[ev.chain[0]]:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                if not isinstance(arg, ast.Name):
+                    continue
+                # rebinding kills the hazard: `G = f(G)` in a loop
+                # re-points the name at the RESULT buffer, so loads
+                # after the re-store (including next iteration's arg)
+                # are safe. Only loads between donation and the next
+                # store of the name are findings.
+                restore = min((s.line for s in fn.events
+                               if s.kind == "store" and s.name == arg.id
+                               and s.line >= ev.line),
+                              default=None)
+                for later in fn.events:
+                    if later.kind != "load" or later.name != arg.id \
+                            or later.line <= ev.line:
+                        continue
+                    if restore is not None and later.line > restore:
+                        continue
+                    findings.append(Finding(
+                        JAX004.id, fn.module.relpath, later.line,
+                        fn.qualname, f"donated:{arg.id}",
+                        f"{arg.id} donated to {ev.chain[0]} at "
+                        f"line {ev.line} is used again — the "
+                        f"buffer is invalid after donation"))
+                    break
+    return findings

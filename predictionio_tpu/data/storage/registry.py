@@ -18,11 +18,19 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from predictionio_tpu.obs.slo import lock_probe, timed_acquire
+
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
 
 _lock = threading.RLock()
 _clients: Dict[str, Any] = {}       # source name -> backend client
 _dataobjects: Dict[str, Any] = {}   # (repo, kind) -> DAO
+
+#: contention probe (ISSUE 8 satellite): every DAO access — including
+#: each fold-tick publish's instances/models resolution — crosses
+#: ``_lock``; the wait rides pio_lock_wait_seconds{lock=registry_publish}.
+#: Resolved at import time so the hot path only observes.
+_dao_lock_wait = lock_probe("registry_publish")
 
 
 class StorageClientConfig:
@@ -125,7 +133,7 @@ def get_data_object(repo: str, kind: str):
     """kind in {apps, access_keys, channels, engine_instances,
     engine_manifests, evaluation_instances, models, events}."""
     key = f"{repo}/{kind}"
-    with _lock:
+    with timed_acquire(_lock, _dao_lock_wait):
         if key not in _dataobjects:
             cfg = repository_config(repo)
             client = _client_for(cfg)

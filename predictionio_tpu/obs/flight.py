@@ -350,7 +350,11 @@ class FlightRecorder:
                 fh.write("\n".join(batch) + "\n")
                 fh.flush()   # page cache only — fsync-light by contract
             except Exception:
-                self.write_errors += 1
+                # same lock as dropped/spent_s: the self-accounting
+                # counters are read-modify-written from several threads
+                # (ISSUE 6 hardening; this one had escaped it)
+                with self._lock:
+                    self.write_errors += 1
                 try:
                     if fh is not None:
                         fh.close()

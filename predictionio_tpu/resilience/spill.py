@@ -42,6 +42,7 @@ import zlib
 from typing import Iterator, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.obs.slo import lock_probe, timed_acquire
 from predictionio_tpu.resilience.policy import TRANSIENT_ERRORS
 
 logger = logging.getLogger(__name__)
@@ -62,6 +63,15 @@ class SpillWAL:
         self.cursor_path = path + ".cursor"
         self.fsync = fsync
         self._lock = threading.RLock()
+        # contention probe (ISSUE 8 satellite): spill appends are the
+        # ingest ACK path during an outage — writer wait on this lock
+        # is ack latency, surfaced as
+        # pio_lock_wait_seconds{lock=spill_wal_append}
+        self._append_lock_wait = lock_probe("spill_wal_append")
+        # serializes cursor-file persistence OUTSIDE the append lock
+        # (ISSUE 8 triage: checkpoint held _lock across the cursor
+        # fsync, convoying concurrent spill acks behind replayer IO)
+        self._cursor_io_lock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._cursor = self._read_cursor()
         self._size = self._recover()
@@ -145,7 +155,7 @@ class SpillWAL:
              "event": event.with_id(eid).to_dict()},
             separators=(",", ":")).encode("utf-8")
         record = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
-        with self._lock:
+        with timed_acquire(self._lock, self._append_lock_wait):
             self._f.write(record)
             self._f.flush()
             if self.fsync:
@@ -173,7 +183,7 @@ class SpillWAL:
             frames.append(
                 _HEADER.pack(len(payload), zlib.crc32(payload)) + payload)
         blob = b"".join(frames)
-        with self._lock:
+        with timed_acquire(self._lock, self._append_lock_wait):
             self._f.write(blob)
             self._f.flush()
             if self.fsync:
@@ -219,7 +229,15 @@ class SpillWAL:
         many records the caller consumed up to ``offset`` (the replayer
         always knows); without it the count is recomputed by a
         header-only scan. A fully-drained WAL is compacted back to zero
-        bytes so it never grows unboundedly across spill episodes."""
+        bytes so it never grows unboundedly across spill episodes.
+
+        Cursor-file persistence (open + fsync + replace) runs OUTSIDE
+        the append lock: a replayer checkpointing mid-recovery must not
+        convoy concurrent spill ACKs behind its cursor IO (`pio lint`
+        LOCK002). Safe because the cursor is advisory-monotonic: the
+        write under ``_cursor_io_lock`` re-reads the latest in-memory
+        cursor, and a crash that persists a stale (lower) offset only
+        re-replays records the drain already id-dedups."""
         with self._lock:
             if offset <= self._cursor:
                 return
@@ -238,7 +256,17 @@ class SpillWAL:
             else:
                 self._pending_records = self._count_records_from(
                     self._cursor)
-            self._write_cursor(self._cursor)
+        self._persist_cursor()
+
+    def _persist_cursor(self):
+        """Write the freshest in-memory cursor to the sidecar. The IO
+        lock serializes writers; each one re-snapshots ``_cursor`` so
+        out-of-order checkpoint threads still persist the newest
+        value."""
+        with self._cursor_io_lock:
+            with self._lock:
+                cur = self._cursor
+            self._write_cursor(cur)
 
     def close(self):
         with self._lock:

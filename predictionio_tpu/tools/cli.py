@@ -910,6 +910,27 @@ def cmd_spill(args) -> int:
     return 1
 
 
+def cmd_lint(args) -> int:
+    """Static concurrency + JAX hot-path analyzer (ISSUE 8): the
+    whole-repo AST pass behind the tier-1 zero-new-findings gate.
+    Heavy lifting lives in analysis/runner.py; this shim forwards the
+    already-parsed flags so `pio lint --json` and the standalone runner
+    agree exactly."""
+    from predictionio_tpu.analysis.runner import main as lint_main
+    argv = []
+    if args.json:
+        argv.append("--json")
+    if args.root:
+        argv.extend(["--root", args.root])
+    if args.baseline:
+        argv.extend(["--baseline", args.baseline])
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    return lint_main(argv)
+
+
 def cmd_upgrade(args) -> int:
     """(Console upgrade / WorkflowUtils.checkUpgrade — the reference phones
     home for new versions; this build is offline, so upgrade is a no-op
@@ -1187,6 +1208,28 @@ def build_parser() -> argparse.ArgumentParser:
 
     up = sub.add_parser("upgrade")
     up.set_defaults(func=cmd_upgrade)
+
+    ln = sub.add_parser(
+        "lint", help="static concurrency + JAX hot-path analyzer "
+        "(ISSUE 8): lock-order cycles, locks held across blocking "
+        "calls, unguarded background-thread mutation, implicit host "
+        "syncs, jit recompile hazards, hot-path cost. Exit 0 = zero "
+        "findings outside conf/lint_baseline.json")
+    ln.add_argument("--json", action="store_true",
+                    help="machine-readable report (CI mode)")
+    ln.add_argument("--root", default=None,
+                    help="directory to analyze (default: the "
+                         "predictionio_tpu package)")
+    ln.add_argument("--baseline", default=None,
+                    help="baseline file (default: conf/lint_baseline"
+                         ".json)")
+    ln.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, suppressing nothing")
+    ln.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current finding "
+                         "set (new entries get TODO justifications "
+                         "you must edit)")
+    ln.set_defaults(func=cmd_lint)
 
     rb = sub.add_parser(
         "rollback", help="guarded deploys (ISSUE 5): demote model "

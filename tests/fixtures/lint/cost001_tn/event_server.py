@@ -1,0 +1,14 @@
+"""COST001 true negative: the handler only buffers; fsync lives on the
+background cadence function no request path calls."""
+
+import os
+
+
+def _fsync_cadence(f):
+    os.fsync(f.fileno())
+
+
+def _create_event(req, log_file):
+    log_file.write(req)
+    log_file.flush()
+    return 201

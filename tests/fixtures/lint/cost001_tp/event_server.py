@@ -1,0 +1,15 @@
+"""COST001 true positive: the ingest-ack handler reaches an fsync
+through a helper — every single-event ack waits on physical IO."""
+
+import os
+
+
+def _durable_write(f, payload):
+    f.write(payload)
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _create_event(req, log_file):
+    _durable_write(log_file, req)
+    return 201

@@ -1,0 +1,11 @@
+"""COST002 true negative: lazy %-style logging args — nothing is
+formatted unless the level is enabled."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def handle_query(query):
+    logger.info("query received: %s", query)
+    return {"ok": True}

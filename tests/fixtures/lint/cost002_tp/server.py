@@ -1,0 +1,11 @@
+"""COST002 true positive: the query handler renders its log message
+eagerly (f-string) — paid even when INFO is disabled."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def handle_query(query):
+    logger.info(f"query received: {query}")
+    return {"ok": True}

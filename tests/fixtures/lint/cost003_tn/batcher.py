@@ -1,0 +1,13 @@
+"""COST003 true negative: the instrument is resolved once in __init__;
+the hot path only increments."""
+
+
+class QuietBatcher:
+    def __init__(self, registry):
+        self.registry = registry
+        self._queries = registry.counter("pio_queries_total",
+                                         "queries seen")
+
+    def submit(self, query):
+        self._queries.inc()
+        return query

@@ -1,0 +1,12 @@
+"""COST003 true positive: the submit path re-registers the counter
+family on every call instead of resolving it once at init."""
+
+
+class ChattyBatcher:
+    def __init__(self, registry):
+        self.registry = registry
+
+    def submit(self, query):
+        c = self.registry.counter("pio_queries_total", "queries seen")
+        c.inc()
+        return query

@@ -1,0 +1,17 @@
+"""JAX002 true negative: the inner function captures nothing, and the
+jitted wrapper is cached by key (the repo's ``_jits`` idiom)."""
+
+import jax
+
+_cache = {}
+
+
+def scorer_for(key):
+    fn = _cache.get(key)
+    if fn is None:
+        def impl(x):
+            return x + 1.0
+
+        fn = jax.jit(impl)
+        _cache[key] = fn
+    return fn

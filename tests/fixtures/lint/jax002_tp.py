@@ -1,0 +1,12 @@
+"""JAX002 true positive: the jitted inner function closes over
+``scale`` from the enclosing call — a fresh closure (and recompile)
+per ``build_scorer`` call, with no cache in sight."""
+
+import jax
+
+
+def build_scorer(scale):
+    def impl(x):
+        return x * scale
+
+    return jax.jit(impl)

@@ -1,0 +1,15 @@
+"""JAX003 true negative: the jitted executable is built once at module
+import; requests only dispatch it."""
+
+import jax
+
+
+def _impl(y):
+    return y * 2.0
+
+
+_fn = jax.jit(_impl)
+
+
+def answer_query(x):
+    return _fn(x)

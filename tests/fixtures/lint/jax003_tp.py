@@ -1,0 +1,12 @@
+"""JAX003 true positive: jax.jit built inside the per-request function
+with no cache — recompiles on every call."""
+
+import jax
+
+
+def answer_query(x):
+    def impl(y):
+        return y * 2.0
+
+    fn = jax.jit(impl)
+    return fn(x)

@@ -1,0 +1,17 @@
+"""JAX004 true negative: the donate-and-rebind idiom — the name is
+re-pointed at the result buffer, so later uses read valid memory."""
+
+import jax
+
+
+def _accum_impl(table, vec):
+    return table + vec
+
+
+accum = jax.jit(_accum_impl, donate_argnums=(0,))
+
+
+def accumulate(table, vecs):
+    for vec in vecs:
+        table = accum(table, vec)
+    return table.sum()

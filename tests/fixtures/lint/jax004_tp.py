@@ -1,0 +1,17 @@
+"""JAX004 true positive: ``table`` is donated to the jitted update but
+read again afterwards — the buffer is invalid after donation."""
+
+import jax
+
+
+def _update_impl(table, vec):
+    return table + vec
+
+
+update = jax.jit(_update_impl, donate_argnums=(0,))
+
+
+def apply_update(table, vec):
+    out = update(table, vec)
+    norm = table.sum()
+    return out, norm

@@ -1,0 +1,19 @@
+"""LOCK001 true negative: both paths honor one global order
+(journal before catalog), so the lock graph is acyclic."""
+
+import threading
+
+_journal_lock = threading.Lock()
+_catalog_lock = threading.Lock()
+
+
+def write_entry(rec):
+    with _journal_lock:
+        with _catalog_lock:
+            return rec
+
+
+def rewrite_catalog(rows):
+    with _journal_lock:
+        with _catalog_lock:
+            return list(rows)

@@ -1,0 +1,19 @@
+"""LOCK001 true positive: the two functions acquire the same pair of
+module locks in opposite orders — a classic AB/BA deadlock."""
+
+import threading
+
+_commit_lock = threading.Lock()
+_index_lock = threading.Lock()
+
+
+def write_record(rec):
+    with _commit_lock:
+        with _index_lock:
+            return rec
+
+
+def rebuild_index(rows):
+    with _index_lock:
+        with _commit_lock:
+            return list(rows)

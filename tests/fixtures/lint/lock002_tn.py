@@ -1,0 +1,18 @@
+"""LOCK002 true negative: the lock covers only the in-memory ordering
+(write + flush); the fsync happens after release."""
+
+import os
+import threading
+
+
+class GroupJournal:
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def append(self, rec):
+        with self._lock:
+            self._f.write(rec)
+            self._f.flush()
+            fd = self._f.fileno()
+        os.fsync(fd)

@@ -1,0 +1,17 @@
+"""LOCK002 true positive: fsync runs inside the append lock — every
+concurrent writer convoys behind physical IO."""
+
+import os
+import threading
+
+
+class ConvoyJournal:
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def append(self, rec):
+        with self._lock:
+            self._f.write(rec)
+            self._f.flush()
+            os.fsync(self._f.fileno())

@@ -1,0 +1,25 @@
+"""LOCK003 true negative: the background loop's mutation runs under
+the instance lock."""
+
+import threading
+
+
+class GuardedPoller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(0.1):
+            with self._lock:
+                self.ticks = self.ticks + 1
+
+    def stats(self):
+        with self._lock:
+            return {"ticks": self.ticks}

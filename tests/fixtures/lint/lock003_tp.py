@@ -1,0 +1,24 @@
+"""LOCK003 true positive: the background loop mutates ``ticks`` with
+no lock while the foreground ``stats`` also reads it."""
+
+import threading
+import time
+
+
+class RacyPoller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(0.1):
+            self.ticks = self.ticks + 1
+
+    def stats(self):
+        return {"ticks": self.ticks}

@@ -1,0 +1,9 @@
+"""JAX001 true negative: ``np.asarray`` on plain host data (a request
+payload list) is not a device sync."""
+
+import numpy as np
+
+
+def parse_query(raw_rows):
+    arr = np.asarray(raw_rows)
+    return arr.reshape(-1)

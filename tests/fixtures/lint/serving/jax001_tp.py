@@ -1,0 +1,10 @@
+"""JAX001 true positive: per-query ``.item()`` on a device value in a
+serving-path module — one host sync per call."""
+
+import jax.numpy as jnp
+
+
+def score_one(query_vec, table):
+    scores = jnp.dot(table, query_vec)
+    best = scores.max()
+    return best.item()

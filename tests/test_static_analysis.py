@@ -1,0 +1,283 @@
+"""ISSUE 8: `pio lint` static analyzer — fixture suite (one true
+positive + one true negative per rule, asserted by rule id), the
+rule-id naming lint (mirroring test_metric_lint: ids are API), the
+whole-repo tier-1 gate (zero findings outside conf/lint_baseline.json,
+inside the <30 s budget), baseline hygiene (no blanket suppressions,
+justifications required, stale entries surfaced), and regression tests
+for the two genuine defects the analyzer's first run surfaced."""
+
+import json
+import os
+import re
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.analysis import RULES, run_lint
+from predictionio_tpu.analysis.baseline import (BaselineError,
+                                                load_baseline)
+from predictionio_tpu.analysis.core import RULE_ID_PATTERN
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+
+#: rule id -> (true-positive fixture, true-negative fixture), paths
+#: relative to tests/fixtures/lint/. Every registered rule MUST have a
+#: row here (asserted below) — a rule nobody can demonstrate is dead
+#: weight.
+RULE_FIXTURES = {
+    "LOCK001": ("lock001_tp.py", "lock001_tn.py"),
+    "LOCK002": ("lock002_tp.py", "lock002_tn.py"),
+    "LOCK003": ("lock003_tp.py", "lock003_tn.py"),
+    "JAX001": ("serving/jax001_tp.py", "serving/jax001_tn.py"),
+    "JAX002": ("jax002_tp.py", "jax002_tn.py"),
+    "JAX003": ("jax003_tp.py", "jax003_tn.py"),
+    "JAX004": ("jax004_tp.py", "jax004_tn.py"),
+    "COST001": ("cost001_tp/event_server.py",
+                "cost001_tn/event_server.py"),
+    "COST002": ("cost002_tp/server.py", "cost002_tn/server.py"),
+    "COST003": ("cost003_tp/batcher.py", "cost003_tn/batcher.py"),
+}
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    """One analyzer pass over the whole fixture tree; per-file rule-id
+    sets. Module-scoped — parsing is the expensive part."""
+    report = run_lint(root=FIXTURES, base=FIXTURES, use_baseline=False)
+    assert not report.parse_errors, report.parse_errors
+    by_path = {}
+    for f in report.findings:
+        by_path.setdefault(f.path, set()).add(f.rule_id)
+    return by_path
+
+
+class TestFixtures:
+    @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+    def test_true_positive_caught(self, fixture_findings, rule_id):
+        tp, _ = RULE_FIXTURES[rule_id]
+        assert rule_id in fixture_findings.get(tp, set()), (
+            f"{rule_id} did not fire on its true-positive fixture {tp} "
+            f"(fired: {sorted(fixture_findings.get(tp, set()))})")
+
+    @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+    def test_true_negative_clean(self, fixture_findings, rule_id):
+        _, tn = RULE_FIXTURES[rule_id]
+        fired = fixture_findings.get(tn, set())
+        assert rule_id not in fired, (
+            f"{rule_id} false-positived on its true-negative fixture "
+            f"{tn}")
+
+    def test_true_negatives_fully_clean(self, fixture_findings):
+        """TN fixtures are the idiomatic-good shapes; NO rule should
+        fire on any of them (a cross-rule false positive on a good
+        idiom is as bad as an in-rule one)."""
+        offenders = {tn: sorted(fixture_findings.get(tn, set()))
+                     for _, tn in RULE_FIXTURES.values()
+                     if fixture_findings.get(tn)}
+        assert not offenders, offenders
+
+    def test_every_rule_has_fixture_row(self):
+        assert set(RULE_FIXTURES) == set(RULES)
+
+    def test_fixture_files_exist(self):
+        for tp, tn in RULE_FIXTURES.values():
+            for rel in (tp, tn):
+                assert os.path.exists(os.path.join(FIXTURES, rel)), rel
+
+
+class TestRuleIdNamingLint:
+    """Rule ids are API (the baseline and docs key on them) — lint the
+    lint, the way test_metric_lint lints metric names."""
+
+    def test_ids_match_pattern(self):
+        bad = [r for r in RULES if not re.match(RULE_ID_PATTERN, r)]
+        assert not bad, f"rule ids must match {RULE_ID_PATTERN}: {bad}"
+
+    def test_ids_match_their_registration_key(self):
+        assert all(rule.id == key for key, rule in RULES.items())
+
+    def test_families_are_contiguous_from_001(self):
+        """LOCK001..LOCKn with no gaps — a renumbered or deleted rule
+        would silently orphan baseline entries."""
+        by_family = {}
+        for rid in RULES:
+            fam, num = rid[:-3], int(rid[-3:])
+            by_family.setdefault(fam, []).append(num)
+        for fam, nums in by_family.items():
+            assert sorted(nums) == list(range(1, len(nums) + 1)), (
+                f"{fam} ids not contiguous from 001: {sorted(nums)}")
+
+    def test_titles_and_descriptions(self):
+        for rule in RULES.values():
+            assert rule.title and len(rule.title) <= 60, rule.id
+            assert len(rule.description) >= 40, (
+                f"{rule.id}: description must explain the defect class")
+
+    def test_fixture_names_embed_rule_id(self):
+        for rid, (tp, tn) in RULE_FIXTURES.items():
+            assert rid.lower() in tp and rid.lower() in tn, (
+                f"{rid} fixtures must carry the rule id in their path")
+
+    def test_baseline_references_known_rules_only(self):
+        entries = load_baseline(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "conf", "lint_baseline.json"))
+        unknown = {e.fingerprint.split(":", 1)[0] for e in entries} \
+            - set(RULES)
+        assert not unknown, f"baseline cites unknown rules: {unknown}"
+
+
+class TestBaselineHygiene:
+    def _write(self, tmp_path, entries):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({"version": 1, "entries": entries}))
+        return str(p)
+
+    def test_wildcard_suppression_rejected(self, tmp_path):
+        p = self._write(tmp_path, [
+            {"fingerprint": "LOCK002:*", "justification":
+             "suppress everything in one line"}])
+        with pytest.raises(BaselineError, match="wildcard|blanket"):
+            load_baseline(p)
+
+    def test_missing_justification_rejected(self, tmp_path):
+        p = self._write(tmp_path, [
+            {"fingerprint": "LOCK002:a.py:F.m:os.fsync"}])
+        with pytest.raises(BaselineError, match="justification"):
+            load_baseline(p)
+
+    def test_duplicate_fingerprint_rejected(self, tmp_path):
+        e = {"fingerprint": "LOCK002:a.py:F.m:os.fsync",
+             "justification": "because of reasons, ten+ chars"}
+        p = self._write(tmp_path, [e, dict(e)])
+        with pytest.raises(BaselineError, match="duplicate"):
+            load_baseline(p)
+
+    def test_stale_entry_surfaced(self, tmp_path):
+        p = self._write(tmp_path, [
+            {"fingerprint": "LOCK002:no/such/file.py:F.m:os.fsync",
+             "justification": "this finding no longer exists"}])
+        report = run_lint(root=FIXTURES, base=FIXTURES,
+                          baseline_path=p)
+        assert "LOCK002:no/such/file.py:F.m:os.fsync" in report.stale
+
+
+class TestRepoGate:
+    """The tier-1 lane: the whole repo lints clean against the checked-
+    in baseline, inside the CI budget."""
+
+    def test_whole_repo_zero_new_findings(self):
+        t0 = time.monotonic()
+        report = run_lint()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 30.0, (
+            f"pio lint took {elapsed:.1f}s — over the 30 s tier-1 "
+            f"budget")
+        assert not report.parse_errors, report.parse_errors
+        assert not report.new, "NEW lint findings (fix or baseline " \
+            "with a justification):\n" + report.render()
+        assert not report.stale, (
+            "stale baseline entries (the finding was fixed — delete "
+            f"them): {sorted(report.stale)}")
+
+    def test_cli_json_contract(self, capsys):
+        from predictionio_tpu.analysis.runner import main
+        rc = main(["--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["ok"] is True
+        assert out["findings"] == []
+        assert out["suppressed"] > 0
+        assert out["files"] > 50          # whole repo, not a subdir
+
+
+class TestTriageRegressions:
+    """The two genuine defects the analyzer's first run surfaced
+    (ISSUE 8 satellite: fixed with regression tests)."""
+
+    def test_spill_checkpoint_cursor_io_off_append_lock(
+            self, tmp_path, monkeypatch):
+        """LOCK002 fix: a replayer checkpoint mid-cursor-persistence
+        must not block concurrent spill appends (the ingest ACK path
+        during recovery). Before the fix, append() waited on the
+        checkpoint's cursor fsync."""
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.resilience.spill import SpillWAL
+
+        def ev(i):
+            return Event(event="buy", entity_type="user",
+                         entity_id=f"u{i}")
+
+        wal = SpillWAL(str(tmp_path / "t.wal"), fsync=False)
+        wal.append(ev(0), 1)
+        wal.append(ev(1), 1)
+        first_end = next(wal.pending())[0]
+
+        entered, gate = threading.Event(), threading.Event()
+        orig = SpillWAL._write_cursor
+
+        def slow_write_cursor(self, offset):
+            entered.set()
+            assert gate.wait(10), "test gate never released"
+            return orig(self, offset)
+
+        monkeypatch.setattr(SpillWAL, "_write_cursor", slow_write_cursor)
+        t = threading.Thread(
+            target=lambda: wal.checkpoint(first_end, records=1),
+            daemon=True)
+        t.start()
+        assert entered.wait(10)
+        # cursor persistence is in flight and holding its IO lock —
+        # an append must land without waiting for it
+        t0 = time.monotonic()
+        wal.append(ev(2), 1)
+        append_s = time.monotonic() - t0
+        gate.set()
+        t.join(10)
+        assert append_s < 2.0, (
+            f"append blocked {append_s:.1f}s behind cursor IO")
+        assert wal.pending_count() == 2
+        ids = [e.entity_id for _, _, _, e in wal.pending()]
+        assert ids == ["u1", "u2"]
+        wal.close()
+
+    def test_spill_checkpoint_still_durable(self, tmp_path):
+        """The moved cursor write still persists: a reopened WAL
+        resumes from the checkpointed offset."""
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.resilience.spill import SpillWAL
+
+        path = str(tmp_path / "d.wal")
+        wal = SpillWAL(path, fsync=False)
+        for i in range(3):
+            wal.append(Event(event="buy", entity_type="user",
+                             entity_id=f"u{i}"), 1)
+        first_end = next(wal.pending())[0]
+        wal.checkpoint(first_end, records=1)
+        wal.close()
+        wal2 = SpillWAL(path, fsync=False)
+        assert wal2.pending_count() == 2
+        assert [e.entity_id for _, _, _, e in wal2.pending()] \
+            == ["u1", "u2"]
+        wal2.close()
+
+    def test_flight_write_errors_counted_under_lock(self, tmp_path):
+        """LOCK003 fix: write_errors had escaped the ISSUE 6 'self-
+        accounting counters lock-guarded' hardening. Behavioral check:
+        a failing disk sink still counts its errors (the counter is
+        now taken under FLIGHT._lock like dropped/spent_s)."""
+        from predictionio_tpu.obs.flight import FlightRecorder
+
+        blocker = tmp_path / "not_a_dir"
+        blocker.write_text("flight dir path occupied by a file")
+        rec = FlightRecorder(flight_dir=str(blocker))
+        try:
+            rec.record("model_load", note="regression")
+            deadline = time.monotonic() + 10
+            while rec.write_errors == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert rec.write_errors >= 1
+        finally:
+            rec.close()
