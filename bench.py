@@ -1092,6 +1092,100 @@ def bench_fold_tick(full_scale: bool):
     return out
 
 
+#: the cold/warm serve-first-query probe run in a fresh interpreter —
+#: the only honest way to measure process cold-start (this process's
+#: jit caches are already hot). Deploy-equivalent path: AOT warm
+#: (what EngineServer.load/swap_models runs) then one batch_predict.
+_COLDSTART_HELPER = r'''
+import json, sys, time
+import numpy as np
+from predictionio_tpu.compile.cache import enable_persistent_cache
+from predictionio_tpu.compile.aot import get_aot, warm_models
+from predictionio_tpu.models.recommendation import (ALSAlgorithm,
+    ALSAlgorithmParams, RecommendationModel)
+from predictionio_tpu.data.bimap import EntityIdIxMap
+from predictionio_tpu.ops.als import ALSModel
+from predictionio_tpu.obs import costmon
+enable_persistent_cache(root=sys.argv[1])
+rng = np.random.default_rng(0)
+n_u, n_i, rank = int(sys.argv[2]), int(sys.argv[3]), 16
+als = ALSModel(rng.random((n_u, rank), dtype=np.float32),
+               rng.random((n_i, rank), dtype=np.float32), rank)
+model = RecommendationModel(
+    als, EntityIdIxMap.build(["u%d" % i for i in range(n_u)]),
+    EntityIdIxMap.build(["i%d" % i for i in range(n_i)]))
+algo = ALSAlgorithm(ALSAlgorithmParams(rank=rank))
+t0 = time.perf_counter()
+warm_models([algo], [model], batch_hint=16)
+warm_s = time.perf_counter() - t0
+q = algo.query_class.from_dict({"user": "u1", "num": 10})
+t0 = time.perf_counter()
+algo.batch_predict(model, [(0, q)])
+first_ms = (time.perf_counter() - t0) * 1000
+pc = costmon.pcache_totals()
+print(json.dumps({
+    "warm_s": warm_s, "first_query_ms": first_ms,
+    "pcache_hits": pc["hits"], "pcache_misses": pc["misses"],
+    "hit_rate": get_aot().snapshot()["hitRate"]}))
+'''
+
+
+def bench_cold_start(full_scale: bool):
+    """Cold-start economics (ISSUE 9, schema-additive): two fresh
+    processes sharing one persistent-cache dir measure the
+    deploy(AOT warm)-to-first-query wall cold (empty cache: every
+    executable compiles) vs warm (every executable deserializes) — the
+    CPU container exercises the same code path the BENCH_r01 231.6 s
+    TPU warmup rides. ``swap_to_first_query_ms`` is the warm-process
+    number: a hot-swap runs exactly this warm + first dispatch."""
+    import shutil
+    import subprocess
+    import tempfile
+    out = {}
+    cache_root = tempfile.mkdtemp(prefix="pio_bench_xla_")
+    n_u, n_i = (20_000, 30_000) if full_scale else (2_000, 3_000)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    rows = []
+    try:
+        for phase in ("cold", "warm"):
+            try:
+                t0 = time.perf_counter()
+                res = subprocess.run(
+                    [sys.executable, "-c", _COLDSTART_HELPER, cache_root,
+                     str(n_u), str(n_i)],
+                    env=env, capture_output=True, text=True, timeout=600)
+                proc_s = time.perf_counter() - t0
+                row = json.loads(res.stdout.strip().splitlines()[-1])
+                row["process_s"] = proc_s
+                rows.append(row)
+            except Exception as e:
+                _beat(f"bench_cold_start {phase} failed: {e}")
+                return out
+    finally:
+        shutil.rmtree(cache_root, ignore_errors=True)
+    cold, warm = rows
+    out["aot_warm_cold_s"] = round(cold["warm_s"], 3)
+    out["aot_warm_warm_s"] = round(warm["warm_s"], 3)
+    out["serve_first_query_cold_ms"] = round(cold["first_query_ms"], 2)
+    out["serve_first_query_warm_ms"] = round(warm["first_query_ms"], 2)
+    d2fq_cold = (cold["warm_s"] * 1000) + cold["first_query_ms"]
+    d2fq_warm = (warm["warm_s"] * 1000) + warm["first_query_ms"]
+    out["deploy_to_first_query_cold_ms"] = round(d2fq_cold, 1)
+    out["deploy_to_first_query_warm_ms"] = round(d2fq_warm, 1)
+    out["swap_to_first_query_ms"] = round(d2fq_warm, 1)
+    if d2fq_warm > 0:
+        out["cold_warm_first_query_speedup"] = round(
+            d2fq_cold / d2fq_warm, 2)
+    if warm.get("hit_rate") is not None:
+        out["aot_cache_hit_rate"] = warm["hit_rate"]
+    out["pcache_misses_cold"] = int(cold["pcache_misses"])
+    out["pcache_hits_warm"] = int(warm["pcache_hits"])
+    return out
+
+
 def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
     """p50 of POST /queries.json against the trained model via the real
     engine server (loopback HTTP). `wait_ms` sets the micro-batcher's
@@ -1625,7 +1719,14 @@ def main():
         # trajectory finally covers the online path (schema-additive)
         _beat("bench_fold_tick")
         fold_stats = bench_fold_tick(full_scale)
-    _beat("assemble_output", **ingest_stats, **fold_stats)
+    coldstart_stats = {}
+    if not os.environ.get("PIO_BENCH_SKIP_COLDSTART"):
+        # compile plane (ISSUE 9): cold-vs-warm-process deploy-to-
+        # first-query through the persistent cache (schema-additive)
+        _beat("bench_cold_start")
+        coldstart_stats = bench_cold_start(full_scale)
+    _beat("assemble_output", **ingest_stats, **fold_stats,
+          **coldstart_stats)
     value = als_stats["ratings_per_sec_per_chip"]
     out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
@@ -1641,6 +1742,7 @@ def main():
         **baseline_stats,
         **ingest_stats,
         **fold_stats,
+        **coldstart_stats,
     }
     if baseline_stats:
         # the north-star ratio computed from two numbers measured on

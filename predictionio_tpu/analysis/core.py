@@ -175,16 +175,38 @@ def _lock_ctor_kind(value: ast.AST) -> Optional[str]:
     return None
 
 
+def _import_rooted_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """attr_chain for the ``__import__("jax").jit`` spelling: the root
+    Call's literal module name substitutes for the Name link (the
+    lazy-import idiom the kernel modules use at module scope)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "__import__" and node.args \
+            and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        parts.append(node.args[0].value)
+        return tuple(reversed(parts))
+    return None
+
+
+def _jit_ref_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    return attr_chain(node) or _import_rooted_chain(node)
+
+
 def _is_jit_call(value: ast.AST) -> bool:
-    """``jax.jit(...)``, ``jit(...)`` or ``functools.partial(jax.jit,
-    ...)`` — the three spellings the repo uses."""
+    """``jax.jit(...)``, ``jit(...)``, ``functools.partial(jax.jit,
+    ...)`` or the ``__import__("jax").jit(...)`` lazy-import spelling —
+    the forms the repo uses."""
     if not isinstance(value, ast.Call):
         return False
-    chain = attr_chain(value.func)
+    chain = _jit_ref_chain(value.func)
     if chain and chain[-1] == "jit":
         return True
     if chain and chain[-1] == "partial" and value.args:
-        inner = attr_chain(value.args[0])
+        inner = _jit_ref_chain(value.args[0])
         return bool(inner) and inner[-1] == "jit"
     return False
 
@@ -496,7 +518,7 @@ class _ModuleScanner:
         self.local_lock_names[fn.key] = locks
         for dec in getattr(node, "decorator_list", []):
             if _is_jit_call(dec) or (
-                    (attr_chain(dec) or ())[-1:] == ("jit",)):
+                    (_jit_ref_chain(dec) or ())[-1:] == ("jit",)):
                 donated = (jit_donated_positions(dec)
                            if isinstance(dec, ast.Call) else ())
                 self.mod.jitted[node.name] = donated

@@ -52,6 +52,17 @@ JAX004 = register_rule(
     "garbage or raises depending on backend (and silently breaks when "
     "donation is re-enabled on TPU).")
 
+JAX005 = register_rule(
+    "JAX005", "serve-zone jit dispatch bypasses compile plane",
+    "A module-level jitted callable is dispatched directly from "
+    "serve-zone code (serving/guard modules, fold_in.py, the serve "
+    "kernels ops/{als,similarity,topk}.py) by a function that never "
+    "touches the compile plane (predictionio_tpu/compile: AOT registry "
+    "dispatch, shared_jit, warm). Direct dispatch re-traces per shape "
+    "and pays a full XLA compile whenever a vocabulary/batch/k size "
+    "moves; plane dispatch gets shape-bucketed, deploy-warmed AOT "
+    "executables (ISSUE 9).")
+
 _HOT_SEGMENTS = {"serving", "ops", "guard"}
 
 
@@ -202,10 +213,18 @@ def _module_globals(fn: FunctionInfo) -> Set[str]:
     return out
 
 
+#: calls that hand a jitted callable to the compile plane for caching
+#: (AOTRegistry.adopt / shared_jit): the registry owns its lifetime,
+#: so the construction is a cached-jit pattern, not a recompile hazard
+_PLANE_ADOPT_NAMES = {"adopt", "shared_jit"}
+
+
 def _has_cache_exemption(fn: FunctionInfo, jit_store_name: str) -> bool:
     """The enclosing function visibly caches the jitted callable:
-    lru_cache-decorated, or the jit result is stored into a subscript
-    (``_CACHE[key] = fn``) somewhere in the function."""
+    lru_cache-decorated, the jit result stored into a subscript
+    (``_CACHE[key] = fn``), or handed to the AOT registry
+    (``AOT.adopt(key, jax.jit(impl))`` / stored then adopted) — the
+    compile-plane idiom (ISSUE 9)."""
     for dec in getattr(fn.node, "decorator_list", []):
         chain = attr_chain(dec if not isinstance(dec, ast.Call)
                            else dec.func)
@@ -220,6 +239,20 @@ def _has_cache_exemption(fn: FunctionInfo, jit_store_name: str) -> bool:
                         return True
                     if isinstance(v, ast.Call) and \
                             (attr_chain(v.func) or ())[-1:] == ("jit",):
+                        return True
+        elif isinstance(node, ast.Call):
+            # terminal attribute name, resolvable even through a
+            # call-rooted chain like get_aot().adopt(...)
+            tail = (node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else None)
+            if tail in _PLANE_ADOPT_NAMES:
+                for a in node.args:
+                    if isinstance(a, ast.Name) and jit_store_name \
+                            and a.id == jit_store_name:
+                        return True
+                    if isinstance(a, ast.Call) and \
+                            (attr_chain(a.func) or ())[-1:] == ("jit",):
                         return True
     return False
 
@@ -240,6 +273,69 @@ def check_jax003(repo: RepoModel) -> List[Finding]:
                 f"jit:{store_name or 'inline'}",
                 f"jax.jit constructed inside {fn.qualname} with no "
                 f"visible cache — recompiles on every invocation"))
+    return findings
+
+
+#: the serve zone: code dispatching device programs per query or per
+#: fold tick — where the compile plane's shape buckets + AOT warming
+#: are the contract. Narrower than the JAX001 hot zone: train-only
+#: kernels (markov, forest, ...) re-trace once per run, not per tick.
+_SERVE_KERNELS = {"als.py", "similarity.py", "topk.py"}
+
+
+def in_serve_zone(relpath: str) -> bool:
+    parts = relpath.split("/")
+    if {"serving", "guard"}.intersection(parts[:-1]):
+        return True
+    if parts[-1] == "fold_in.py":
+        return True
+    return "ops" in parts[:-1] and parts[-1] in _SERVE_KERNELS
+
+
+_PLANE_MODULE_PREFIX = "predictionio_tpu.compile"
+_PLANE_NAMES = {"get_aot", "shared_jit", "warm_models"}
+
+
+def _references_plane(fn: FunctionInfo) -> bool:
+    """Does this function resolve anything through the compile plane?
+    Either by name (get_aot / shared_jit / warm_models, however
+    imported) or through any alias the module imports from
+    predictionio_tpu.compile.*."""
+    imports = fn.module.imports
+    for ev in fn.events:
+        if not ev.chain:
+            continue
+        root = ev.chain[0]
+        if root in _PLANE_NAMES or "shared_jit" in ev.chain \
+                or "get_aot" in ev.chain:
+            return True
+        if imports.get(root, "").startswith(_PLANE_MODULE_PREFIX):
+            return True
+    return False
+
+
+def check_jax005(repo: RepoModel) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    for key, fn in repo.functions.items():
+        if not in_serve_zone(fn.module.relpath):
+            continue
+        roster = set(fn.module.jitted)
+        if not roster or _references_plane(fn):
+            continue
+        for ev in fn.events:
+            if ev.kind != "call" or len(ev.chain) != 1 \
+                    or ev.chain[0] not in roster:
+                continue
+            if (fn.key, ev.chain[0]) in seen:
+                continue
+            seen.add((fn.key, ev.chain[0]))
+            findings.append(Finding(
+                JAX005.id, fn.module.relpath, ev.line, fn.qualname,
+                f"jit_dispatch:{ev.chain[0]}",
+                f"{fn.qualname} dispatches jitted {ev.chain[0]} "
+                f"directly on a serve-zone path — no compile-plane "
+                f"resolution (shape buckets / AOT warm) covers it"))
     return findings
 
 

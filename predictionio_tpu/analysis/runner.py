@@ -30,6 +30,7 @@ CHECKERS: Sequence[Callable[[RepoModel], List[Finding]]] = (
     rules_jax.check_jax002,
     rules_jax.check_jax003,
     rules_jax.check_jax004,
+    rules_jax.check_jax005,
     rules_cost.check_cost001,
     rules_cost.check_cost002,
     rules_cost.check_cost003,

@@ -1,0 +1,46 @@
+"""The compile plane (ISSUE 9): kill cold-start.
+
+BENCH_r01 — the only real-TPU capture — put ``warmup_s`` at 231.6
+against ``train_s_per_iteration`` of 0.0039: XLA compilation is ~5
+orders of magnitude above steady-state, and every ``pio deploy``,
+hot-swap, canary stage and rollback used to pay it. This package is
+the subsystem that amortizes it away:
+
+- :mod:`predictionio_tpu.compile.cache` — JAX's persistent compilation
+  cache, managed: a versioned directory under ``base_dir()/xla_cache``
+  whose salt fingerprints the kernel sources (a kernel change rolls
+  the directory, so stale entries never shadow fresh code), plus the
+  ``pio cache {status,clear}`` surface.
+- :mod:`predictionio_tpu.compile.buckets` — the shape-bucket ladder:
+  next-pow2-style buckets for vocabulary rows, touched-row counts and
+  query batch sizes, so growth INSIDE a bucket never changes a traced
+  shape (zero recompiles) and bucket promotion is a single, predictable
+  compile that can run before the shape is needed.
+- :mod:`predictionio_tpu.compile.aot` — the AOT executable registry:
+  hot executables (``batch_predict``, the fold-in solves, the ALS
+  sweep, the gate probe) are ``jit(...).lower(...).compile()``-ed at
+  deploy/swap time against the bucket ladder and dispatched as held
+  ``Compiled`` objects — a warmed serve path runs zero trace and zero
+  compile per request.
+
+``PIO_AOT=off`` disables AOT dispatch/warming; ``PIO_XLA_CACHE=off``
+disables the persistent cache. Both fall back to plain jit dispatch.
+"""
+
+from predictionio_tpu.compile.buckets import (bucket_batch, bucket_rows,
+                                              bucket_key, occupancy,
+                                              PROMOTE_AT)
+from predictionio_tpu.compile.cache import (cache_status, clear_cache,
+                                            enable_persistent_cache,
+                                            persistent_cache_enabled)
+from predictionio_tpu.compile.aot import (AOTRegistry, aot_enabled,
+                                          get_aot, shared_jit,
+                                          warm_models)
+
+__all__ = [
+    "AOTRegistry", "aot_enabled", "bucket_batch", "bucket_key",
+    "bucket_rows", "cache_status", "clear_cache",
+    "enable_persistent_cache", "get_aot", "occupancy",
+    "persistent_cache_enabled", "PROMOTE_AT", "shared_jit",
+    "warm_models",
+]

@@ -1,0 +1,73 @@
+"""Shape-bucket ladder: the sizes the compile plane compiles for.
+
+Every traced program's cost model keys on shapes; every shape that
+changes is a recompile. The ladder quantizes the three dims that
+actually move in production — vocabulary rows (users/items grow with
+traffic), touched-row counts (fold ticks), and query batch sizes — to
+next-power-of-two buckets with a floor, so:
+
+- growth INSIDE a bucket changes no traced shape (zero recompiles);
+- a promotion (bucket -> 2x) is one predictable compile per
+  executable, cheap enough to run in the background before the shape
+  is needed (``occupancy`` past ``PROMOTE_AT`` is the trigger);
+- the program count per executable is bounded by log2(max size).
+
+Pure host math — no jax imports, safe everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+#: smallest vocabulary-row bucket: tiny models all share one program
+ROWS_FLOOR = 64
+#: smallest batch bucket (a single query is its own class)
+BATCH_FLOOR = 1
+#: smallest top-k bucket: client-chosen num in 1..16 shares one
+#: program (and one deploy-time warm spec); the extra top-k positions
+#: are noise next to the scoring matmul
+K_FLOOR = 16
+#: fraction of a bucket in use at which the next bucket should be
+#: pre-compiled in the background (before growth forces it on a tick)
+PROMOTE_AT = 0.75
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def bucket_rows(n: int, floor: int = ROWS_FLOOR) -> int:
+    """Row-count bucket covering ``n`` (vocab rows, touched rows)."""
+    return max(int(floor), _next_pow2(max(int(n), 1)))
+
+
+def bucket_batch(n: int, floor: int = BATCH_FLOOR) -> int:
+    """Query-batch bucket covering ``n``."""
+    return max(int(floor), _next_pow2(max(int(n), 1)))
+
+
+def occupancy(n: int, bucket: int) -> float:
+    """How full ``bucket`` is at current size ``n`` (0..1]."""
+    return float(n) / float(bucket) if bucket else 1.0
+
+
+def should_promote(n: int, bucket: int,
+                   threshold: float = PROMOTE_AT) -> bool:
+    """True when ``n`` is close enough to ``bucket`` that the next
+    bucket's executables should compile now, in the background."""
+    return occupancy(n, bucket) >= threshold
+
+
+def next_bucket(bucket: int) -> int:
+    return int(bucket) * 2
+
+
+def bucket_key(dims: Dict[str, int]) -> Tuple[Tuple[str, int], ...]:
+    """Canonical hashable key for a bucket-dim dict (sorted items)."""
+    return tuple(sorted((str(k), int(v)) for k, v in dims.items()))
+
+
+def bucket_label(dims: Dict[str, int]) -> str:
+    """Compact metric-label rendering: ``"b16-i2048-u1024"``. Bucket
+    combinations are log-bounded per dim, so cardinality stays small."""
+    return "-".join(f"{k}{v}" for k, v in bucket_key(dims))

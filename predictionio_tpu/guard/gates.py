@@ -321,7 +321,13 @@ class QualityGatekeeper:
         per-query jit-dispatch overhead dominated the gate's cost),
         else a predict loop. Runs under the ``gates_probe`` compile
         label (obs/costmon) so a probe-induced recompile is charged to
-        the gates, not to serving."""
+        the gates, not to serving.
+
+        Compile plane (ISSUE 9): batch_predict dispatches through the
+        AOT registry's shape buckets, and the deploy/swap warm set
+        covers the golden-replay batch bucket — so in steady state the
+        gate probe runs zero XLA compiles (its bucket was compiled
+        before the first tick's gate ever ran)."""
         from predictionio_tpu.obs import costmon
         with costmon.executable(costmon.GATES_PROBE):
             bp = getattr(algo, "batch_predict", None)

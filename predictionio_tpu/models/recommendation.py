@@ -480,13 +480,33 @@ class ALSAlgorithm(P2LAlgorithm):
         }
         return new_model, report
 
+    # -- compile plane (ISSUE 9) -------------------------------------------
+    def aot_warm_specs(self, model, batch_hint: int = 16):
+        """(label, bucket-dims) rows for this model's serve executables
+        — consumed by ``compile.aot.warm_models`` at deploy / hot-swap /
+        canary-stage time so the FIRST query after a swap compiles
+        nothing. Covers the micro-batcher's coalescing ladder (1..the
+        configured window, pow2) and the gates golden-replay bucket
+        (the probe answers through the same executable)."""
+        from predictionio_tpu.compile import buckets as B
+        from predictionio_tpu.obs import costmon
+        from predictionio_tpu.ops.als import (batch_predict_dims,
+                                              register_aot_specs)
+        register_aot_specs()
+        batches = sorted({1} | {1 << e for e in range(
+            1, B.bucket_batch(max(batch_hint, 1)).bit_length())})
+        return [(costmon.BATCH_PREDICT,
+                 batch_predict_dims(model.als, b, 16))
+                for b in batches]
+
     def batch_predict(self, model, queries):
         """Evaluation/serving path: one batched device top-k for all known
-        users (vs the reference's per-query driver loop). Queries carrying
-        category/year filters take a second batched call with per-query
-        candidate masks."""
-        from predictionio_tpu.ops.als import _users_topk
-        from predictionio_tpu.utils.device_cache import cached_put
+        users (vs the reference's per-query driver loop), through the
+        compile plane — vocab/batch/k shape-buckets + AOT registry
+        dispatch (ops.als.users_topk_serve), so a warmed server answers
+        with zero trace and zero compile. Queries carrying category/year
+        filters take a second batched call with per-query candidate
+        masks."""
         props_of = model.properties_of(self.params.return_properties)
         out = {ix: ItemScoreResult(()) for ix, _ in queries}
         plain, masked = [], []
@@ -498,29 +518,24 @@ class ALSAlgorithm(P2LAlgorithm):
             mask = model.allowed_mask(q)
             (plain if mask is None else masked).append((ix, q, uix, mask))
         if plain:
+            from predictionio_tpu.ops.als import users_topk_serve
+            from predictionio_tpu.ops.similarity import unpack_top_k_rows
             k_max = min(max(q.num for _, q, _, _ in plain),
                         model.als.n_items)
-            # pad the batch dim to a power of two so the jitted scorer
-            # compiles once per size class, not per request-batch size;
-            # only the [B] index vector crosses to the device
-            b = 1 << (len(plain) - 1).bit_length()
-            user_ixs = np.zeros(b, dtype=np.int32)
-            user_ixs[:len(plain)] = [uix for _, _, uix, _ in plain]
             # compile attribution (obs/costmon): a gates golden-query
             # replay keeps its gates_probe label; live serving books
             # under batch_predict
             from predictionio_tpu.obs import costmon
             with costmon.executable(costmon.BATCH_PREDICT,
                                     defer_to_outer=True):
-                scores, idx = _users_topk(
-                    cached_put(model.als.user_factors),
-                    cached_put(model.als.item_factors), user_ixs, k_max)
-            scores = np.asarray(scores)
-            idx = np.asarray(idx)
+                scores, idx = users_topk_serve(
+                    model.als, [uix for _, _, uix, _ in plain], k_max)
             for row, (ix, q, _, _) in enumerate(plain):
+                # bucketed k may exceed n_items: padding slots carry
+                # -inf and are dropped here
+                s, i = unpack_top_k_rows(scores[row], idx[row], q.num)
                 out[ix] = top_scores_to_result(
-                    model.item_ix, scores[row][:q.num], idx[row][:q.num],
-                    properties_of=props_of)
+                    model.item_ix, s, i, properties_of=props_of)
         if masked:
             from predictionio_tpu.ops.similarity import (masked_top_k_batch,
                                                          unpack_top_k_rows)
@@ -638,6 +653,12 @@ class MeshALSAlgorithm(ALSAlgorithm):
     def batch_predict(self, model, queries):
         # sharded ranking is already a collective per query; map predict
         return [(ix, self.predict(model, q)) for ix, q in queries]
+
+    def aot_warm_specs(self, model, batch_hint: int = 16):
+        # the sharded serve path runs GSPMD collectives per query —
+        # per-process AOT Compiled dispatch does not apply (and the
+        # single-device batch_predict executable is never used here)
+        return []
 
 
 class PrecisionAtK(Metric):

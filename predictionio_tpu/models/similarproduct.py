@@ -489,11 +489,33 @@ class ALSAlgorithm(P2LAlgorithm):
             model.item_ix, scores, idx,
             properties_of=model.properties_of(self.params.return_properties))
 
+    # -- compile plane (ISSUE 9) -------------------------------------------
+    def aot_warm_specs(self, model, batch_hint: int = 16):
+        """(label, bucket-dims) rows for the cosine serve executable —
+        compiled at deploy / hot-swap / canary-stage time by
+        ``compile.aot.warm_models`` so a fresh model's first query pays
+        no XLA compile. Covers the micro-batcher's pow2 coalescing
+        ladder; the gates golden-replay answers through the same
+        bucketed executable."""
+        from predictionio_tpu.compile import buckets as B
+        from predictionio_tpu.obs import costmon
+        from predictionio_tpu.ops.similarity import (masked_topk_dims,
+                                                     register_aot_specs)
+        table = model.item_factors_normalized
+        register_aot_specs()
+        batches = sorted({1} | {1 << e for e in range(
+            1, B.bucket_batch(max(batch_hint, 1)).bit_length())})
+        return [(costmon.BATCH_PREDICT_MASKED,
+                 masked_topk_dims(table.shape[0], table.shape[1], b, 16,
+                                  filter_positive=True))
+                for b in batches]
+
     def batch_predict(self, model, queries):
         """Batched path (serving coalescer + eval): the cosine score is
         linear over query items, so each query collapses to one summed
         normalized vector and the whole batch is a single masked matmul +
-        top-k device call (vs the reference's per-query driver scan)."""
+        top-k device call (vs the reference's per-query driver scan),
+        shape-bucketed and AOT-dispatched inside masked_top_k_batch."""
         from predictionio_tpu.ops.similarity import (masked_top_k_batch,
                                                      unpack_top_k_rows)
         out = {ix: ItemScoreResult(()) for ix, _ in queries}

@@ -50,6 +50,7 @@ logger = logging.getLogger(__name__)
 ALS_SWEEP = "als_sweep"
 FOLD_SIDE = "fold_side"
 BATCH_PREDICT = "batch_predict"
+BATCH_PREDICT_MASKED = "batch_predict_masked"
 GATES_PROBE = "gates_probe"
 
 _label_ctx: contextvars.ContextVar = contextvars.ContextVar(
@@ -61,6 +62,8 @@ _installed = False
 _c_seconds = None
 _c_hits = None
 _c_misses = None
+_c_pc_hits = None
+_c_pc_misses = None
 _g_flops = None
 _g_bytes = None
 
@@ -73,7 +76,8 @@ def _is_backend_compile(name: str) -> bool:
 
 def install(registry=None):
     """Register the listener + gauges. Idempotent; never raises."""
-    global _installed, _c_seconds, _c_hits, _c_misses, _g_flops, _g_bytes
+    global _installed, _c_seconds, _c_hits, _c_misses, _g_flops, \
+        _g_bytes, _c_pc_hits, _c_pc_misses
     with _lock:
         if _installed:
             return
@@ -100,6 +104,17 @@ def install(registry=None):
             "pio_executable_bytes_accessed",
             "XLA cost_analysis() bytes accessed of the last analyzed "
             "executable per label", labelnames=("executable",))
+        _c_pc_hits = reg.counter(
+            "pio_compile_pcache_hits_total",
+            "persistent compilation-cache hits (an executable "
+            "deserialized from disk instead of compiling) by the "
+            "executable label that dispatched it",
+            labelnames=("executable",))
+        _c_pc_misses = reg.counter(
+            "pio_compile_pcache_misses_total",
+            "persistent compilation-cache misses (a fresh XLA compile "
+            "whose result was then written to the cache) by executable",
+            labelnames=("executable",))
         reg.gauge_func(
             "pio_hbm_table_bytes",
             "Device bytes held by each named residency slot in "
@@ -108,6 +123,7 @@ def install(registry=None):
     try:
         from jax import monitoring
         monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
     except Exception as e:
         logger.debug("costmon monitoring listener unavailable: %s", e)
 
@@ -122,6 +138,23 @@ def _on_duration(name, secs, *a, **kw):
     _tls.compile_s = getattr(_tls, "compile_s", 0.0) + secs
     label = _label_ctx.get() or "unlabeled"
     _c_seconds.labels(executable=label).inc(secs)
+
+
+def _on_event(name, *a, **kw):
+    """Persistent compilation-cache hit/miss events (ISSUE 9): jax
+    fires them synchronously on the compiling thread, so the contextvar
+    label attributes each to the executable whose dispatch consulted
+    the disk cache."""
+    if not name.startswith("/jax/compilation_cache/cache_"):
+        return
+    label = _label_ctx.get() or "unlabeled"
+    try:
+        if name.endswith("cache_hits"):
+            _c_pc_hits.labels(executable=label).inc()
+        elif name.endswith("cache_misses"):
+            _c_pc_misses.labels(executable=label).inc()
+    except Exception:
+        pass
 
 
 def _hbm_table_samples():
@@ -222,3 +255,16 @@ def cache_counts() -> Dict[str, Dict[str, float]]:
     """{"hits": {label: n}, "misses": {label: n}}."""
     return {"hits": _labeled_values(_c_hits),
             "misses": _labeled_values(_c_misses)}
+
+
+def pcache_counts() -> Dict[str, Dict[str, float]]:
+    """Persistent-cache {"hits": {label: n}, "misses": {label: n}}."""
+    return {"hits": _labeled_values(_c_pc_hits),
+            "misses": _labeled_values(_c_pc_misses)}
+
+
+def pcache_totals() -> Dict[str, float]:
+    """Process-wide persistent-cache hit/miss totals (all labels)."""
+    c = pcache_counts()
+    return {"hits": sum(c["hits"].values()),
+            "misses": sum(c["misses"].values())}

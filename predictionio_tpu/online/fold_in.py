@@ -75,7 +75,12 @@ class FoldInConfig:
     compute_dtype: Optional[str] = None  # None = bf16 on TPU, f32 on CPU
     work_budget: int = 1 << 20
     sweep_chunk: int = 0
-    bucket_ratio: float = 1.125
+    # pow2 segment-length ladder (train defaults to 1.125): the fold
+    # tick's K classes must be a SMALL, quickly-saturated set so
+    # consecutive ticks re-dispatch compiled programs instead of
+    # minting near-duplicate shapes (ISSUE 9 zero-recompile contract);
+    # the extra padded gather work is noise at touched-row scale
+    bucket_ratio: float = 2.0
     dual_solve: str = "auto"
     solver_iters: Optional[int] = None
     dual_iters_cap: Optional[int] = None
@@ -146,28 +151,36 @@ def _als_config(cfg: FoldInConfig, rank: int, solver: str) -> ALSConfig:
         solver_iters=cfg.solver_iters, dual_iters_cap=cfg.dual_iters_cap)
 
 
-# -- small jitted helpers (built lazily; donation never needed) -------------
-
-_jits: dict = {}
-
+# -- small jitted helpers (resolved from the compile plane) -----------------
+#
+# ISSUE 9: the fold tick resolves its jitted helpers from the AOT
+# registry's shared-jit surface instead of a module-local cache — one
+# process-wide jit per key, visible in `pio status --telemetry` /
+# /stats.json, and the idiom the JAX003/JAX005 lint rules recognize.
 
 def _jitted(name: str, impl):
-    fn = _jits.get(name)
-    if fn is None:
-        import jax
-        fn = jax.jit(impl)
-        _jits[name] = fn
-    return fn
+    from predictionio_tpu.compile.aot import shared_jit
+    return shared_jit("fold." + name, impl)
+
+
+#: scatter-target sentinel for bucket padding: far out of range for any
+#: factor table, so `.at[dst].set(mode="drop")` discards the entry (a
+#: negative pad would WRAP under jax indexing and corrupt a real row)
+_DROP = np.int32(2**31 - 1)
 
 
 def _scatter_impl(table, solved, src, dst):
-    return table.at[dst].set(solved[src])
+    # padded dst entries carry _DROP (out of bounds) -> dropped
+    return table.at[dst].set(solved[src], mode="drop")
 
 
 def _scatter_gram_impl(table, gram, solved, src, dst):
-    rows = solved[src]
-    old = table[dst]
-    return (table.at[dst].set(rows),
+    import jax.numpy as jnp
+    n = table.shape[0]
+    valid = dst < n                       # bucket padding -> False
+    rows = jnp.where(valid[:, None], solved[src], 0.0)
+    old = jnp.where(valid[:, None], table[jnp.minimum(dst, n - 1)], 0.0)
+    return (table.at[dst].set(rows, mode="drop"),
             gram + rows.T @ rows - old.T @ old)
 
 
@@ -268,18 +281,86 @@ class _SidePrep:
     """One side's per-tick constants: the touched-row selection, solve
     plan and scatter targets are identical across sweeps (the satellite
     fix for the per-sweep np.isin recompute), so they are built — and
-    their plan uploaded — exactly once per tick."""
+    their plan uploaded — exactly once per tick.
+
+    Shape-bucketed (ISSUE 9): ``n_rows`` is the touched-row BUCKET (the
+    solved-table height), ``src``/``dst`` are padded to their own pow2
+    bucket with ``_DROP`` targets, and the plan's same-shape batch
+    groups are padded to pow2 counts — so consecutive ticks whose
+    touched sets differ in size (within a bucket) re-dispatch the
+    exact programs of the previous tick: zero recompiles."""
     groups: tuple          # device-resident stacked plan groups
-    src: np.ndarray        # rows of the solved [touched+1] table to take
+    src: np.ndarray        # rows of the solved [bucket+1] table to take
     dst: np.ndarray        # rows of the full table those land on
-    n_rows: int            # touched.size (solved-table height minus pad)
+    dst_real: np.ndarray   # unpadded dst (sentinel checks, stats)
+    n_rows: int            # touched-row bucket (solved height minus pad)
     nnz: int
+
+
+#: touched-row / scatter-length bucket floor: small ticks share one
+#: program class without inflating the solve beyond a few dozen rows
+_TOUCHED_FLOOR = 16
+
+
+def _pad_batch_rows(b, target: int):
+    """Pad one batch's entity dim to ``target`` rows with the kernel's
+    established padding convention (rows = -1 scatters to the dummy
+    tail, mask = 0 solves the pure-regularizer system to x = 0)."""
+    from predictionio_tpu.ops.ratings import SolveBatch
+    B, K = b.shape
+    if target <= B:
+        return b
+    pad = target - B
+    return SolveBatch(
+        rows=np.concatenate([b.rows,
+                             np.full(pad, -1, dtype=b.rows.dtype)]),
+        idx=np.vstack([b.idx, np.zeros((pad, K), dtype=b.idx.dtype)]),
+        val=np.vstack([b.val, np.zeros((pad, K), dtype=b.val.dtype)]),
+        mask=np.vstack([b.mask, np.zeros((pad, K), dtype=b.mask.dtype)]))
+
+
+def _pad_plan_batches(plan, batch_multiple: int = 1):
+    """Shape-stabilize a fold solve plan: pad every batch's entity dim
+    B to its pow2 bucket (floored so tiny ticks share one class), then
+    pad every same-shape batch GROUP to a pow2 count with fully inert
+    batches — so ticks whose touched-count histograms differ (within
+    buckets) re-dispatch byte-identical program shapes: zero
+    recompiles. Fold-tick only — a train pays this (< 2x, trivially
+    solved) padding nowhere."""
+    from predictionio_tpu.compile.buckets import bucket_batch
+    from predictionio_tpu.ops.ratings import SolveBatch, SolvePlan
+    by_shape = {}
+    dp = max(int(batch_multiple), 1)
+    for b in plan.batches:
+        target = max(bucket_batch(b.shape[0], floor=_TOUCHED_FLOOR), dp)
+        # the stacked upload shards the entity dim over the mesh data
+        # axis: the padded B must stay a MULTIPLE of it (a pow2 bucket
+        # alone breaks non-pow2 axes, e.g. dp=3)
+        target = ((target + dp - 1) // dp) * dp
+        b = _pad_batch_rows(b, target)
+        by_shape.setdefault(b.shape, []).append(b)
+    out = []
+    for shape in sorted(by_shape):
+        bs = by_shape[shape]
+        out.extend(bs)
+        target = bucket_batch(len(bs))
+        if target > len(bs):
+            B, K = shape
+            inert = SolveBatch(
+                rows=np.full(B, -1, dtype=np.int32),
+                idx=np.zeros((B, K), dtype=np.int32),
+                val=np.zeros((B, K), dtype=np.float32),
+                mask=np.zeros((B, K), dtype=np.float32))
+            out.extend([inert] * (target - len(bs)))
+    return SolvePlan(batches=out, n_entities=plan.n_entities,
+                     nnz=plan.nnz)
 
 
 def _prep_side(owner_idx: np.ndarray, counter_idx: np.ndarray,
                values: np.ndarray, touched: np.ndarray,
                cfg: FoldInConfig, mesh: MeshContext
                ) -> Optional[_SidePrep]:
+    from predictionio_tpu.compile.buckets import bucket_rows
     if touched.size == 0:
         return None
     sel = np.isin(owner_idx, touched)
@@ -287,25 +368,38 @@ def _prep_side(owner_idx: np.ndarray, counter_idx: np.ndarray,
     if nnz == 0:
         return None
     compact = np.searchsorted(touched, owner_idx[sel])
+    # touched-row bucket: the solved-table height (and so the sweep's
+    # scatter-output shape) quantizes to pow2, so tick-to-tick touched
+    # counts inside a bucket re-use every compiled program
+    n_slot = bucket_rows(int(touched.size), floor=_TOUCHED_FLOOR)
     plan = build_solve_plan(
         np.asarray(compact, dtype=np.int64),
         np.asarray(counter_idx[sel], dtype=np.int32),
         np.asarray(values[sel], dtype=np.float32),
-        int(touched.size), work_budget=cfg.work_budget,
+        n_slot, work_budget=cfg.work_budget,
         batch_multiple=mesh.data_parallelism,
         bucket_ratio=cfg.bucket_ratio)
     if not plan.batches:
         return None
+    plan = _pad_plan_batches(plan, batch_multiple=mesh.data_parallelism)
     chunk = resolve_sweep_chunk(cfg.sweep_chunk, mesh.n_devices)
     groups = _upload_plan(mesh, plan, chunk)
     # only scatter rows that actually had data: a touched entity whose
     # entries all vanished (e.g. deleted events) keeps its deployed row
     # rather than being zeroed
     has_data = np.bincount(compact, minlength=touched.size) > 0
-    return _SidePrep(groups=groups,
-                     src=np.nonzero(has_data)[0].astype(np.int32),
-                     dst=touched[has_data].astype(np.int32),
-                     n_rows=int(touched.size), nnz=nnz)
+    src_real = np.nonzero(has_data)[0].astype(np.int32)
+    dst_real = touched[has_data].astype(np.int32)
+    # scatter-index bucket: padded entries point src at row 0 (any
+    # valid row — their contribution is masked) and dst at _DROP (out
+    # of bounds -> dropped by the scatter, excluded from the Gram)
+    plen = bucket_rows(max(int(src_real.size), 1), floor=_TOUCHED_FLOOR)
+    src = np.zeros(plen, dtype=np.int32)
+    src[:src_real.size] = src_real
+    dst = np.full(plen, _DROP, dtype=np.int32)
+    dst[:dst_real.size] = dst_real
+    return _SidePrep(groups=groups, src=src, dst=dst,
+                     dst_real=dst_real, n_rows=n_slot, nnz=nnz)
 
 
 def _solve_side(prep: _SidePrep, counter_dev, counter_gram, out_dev,
@@ -400,20 +494,27 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         return als, stats
 
     # -- tables onto the device (once per tick, or not at all) --------------
+    # vocab shape-buckets (ISSUE 9): device tables live at pow2 row
+    # buckets, so vocabulary growth INSIDE a bucket re-uses every traced
+    # program (and, with residency, the device arrays themselves);
+    # promotion to the next bucket is one predictable re-pad + compile
+    from predictionio_tpu.compile.buckets import bucket_rows
+    n_users_b = bucket_rows(n_users)
+    n_items_b = bucket_rows(n_items)
     payload = device_cache.get_resident(
         resident_key, (als.user_factors, als.item_factors)) \
         if resident_key else None
     if payload is not None and payload.get("mesh") is mesh \
             and payload.get("implicit") == implicit:
-        U_dev = _grown_dev(payload["U"], n_users)
-        V_dev = _grown_dev(payload["V"], n_items)
+        U_dev = _grown_dev(payload["U"], n_users_b)
+        V_dev = _grown_dev(payload["V"], n_items_b)
         # appended zero rows contribute nothing to a Gram: carry it
         gram_u, gram_v = payload.get("GU"), payload.get("GV")
         incr = int(payload.get("incr", 0))
         stats.resident_hit = True
     else:
-        U_host = _grown_table(als.user_factors, n_users)
-        V_host = _grown_table(als.item_factors, n_items)
+        U_host = _grown_table(als.user_factors, n_users_b)
+        V_host = _grown_table(als.item_factors, n_items_b)
         U_dev = mesh.put_replicated(U_host)
         V_dev = mesh.put_replicated(V_host)
         _record_h2d(U_host.nbytes + V_host.nbytes)
@@ -459,10 +560,10 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
             U_dev, gram_u = _solve_side(
                 prep_u, V_dev, gram_v if implicit else None, U_dev,
                 gram_u if implicit else None, als_cfg, cfg, mesh, rank)
-            stats.n_user_rows += len(prep_u.dst)
+            stats.n_user_rows += len(prep_u.dst_real)
             stats.nnz_user_side += prep_u.nnz
             if sentinel is not None:
-                fault = _timed_check(U_dev, prep_u.dst,
+                fault = _timed_check(U_dev, prep_u.dst_real,
                                      "user-side solve")
                 if fault is not None:
                     break
@@ -470,10 +571,10 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
             V_dev, gram_v = _solve_side(
                 prep_i, U_dev, gram_u if implicit else None, V_dev,
                 gram_v if implicit else None, als_cfg, cfg, mesh, rank)
-            stats.n_item_rows += len(prep_i.dst)
+            stats.n_item_rows += len(prep_i.dst_real)
             stats.nnz_item_side += prep_i.nnz
             if sentinel is not None:
-                fault = _timed_check(V_dev, prep_i.dst,
+                fault = _timed_check(V_dev, prep_i.dst_real,
                                      "item-side solve")
                 if fault is not None:
                     break
@@ -491,8 +592,11 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         U_dev, V_dev, gram_u, gram_v = ckpt
         stats.sentinel_rollback = True
 
-    U_host = np.asarray(host_fetch(U_dev), dtype=np.float32)
-    V_host = np.asarray(host_fetch(V_dev), dtype=np.float32)
+    # slice the vocab-bucket padding back off: published models carry
+    # exact-sized host tables (the padding is a device-residency shape
+    # contract, not part of the model)
+    U_host = np.asarray(host_fetch(U_dev)[:n_users], dtype=np.float32)
+    V_host = np.asarray(host_fetch(V_dev)[:n_items], dtype=np.float32)
     # chaos opt-in: `fold.factors:corrupt=P` simulates a blow-up that
     # slipped past the sweep sentinel — the pre-swap gates' job
     U_host, cu = maybe_corrupt_array("fold.factors", U_host)

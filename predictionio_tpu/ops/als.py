@@ -745,14 +745,114 @@ def _pad_exclude(exclude, multiple: int = 64) -> np.ndarray:
 
 @functools.partial(__import__("jax").jit, static_argnames=("k",))
 def _users_topk(user_factors, item_factors, user_ixs, k: int):
-    """Batched serve/eval path: [B] user indices in, top-k out; factor
-    tables device-resident so only B int32s move host->device."""
+    """Batched top-k over EXACT-size tables — kept as the reference
+    implementation the compile plane's bucketed kernel
+    (``_users_topk_b`` via ``users_topk_serve``) is parity-tested
+    against, the same role ``solve_rows`` plays for ``fold_in_coo``.
+    Serving dispatches the bucketed path."""
     import jax
     import jax.numpy as jnp
     u = user_factors[user_ixs]                                # [B, R]
     scores = jnp.einsum("br,ir->bi", u, item_factors,
                         preferred_element_type=jnp.float32)
     return jax.lax.top_k(scores, k)
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("k",))
+def _users_topk_b(user_factors, item_factors, user_ixs, n_items, k: int):
+    """Bucket-stable serve kernel (ISSUE 9 compile plane): the factor
+    tables arrive padded to their vocab shape-buckets, so vocabulary
+    growth inside a bucket changes NO traced shape — ``n_items`` rides
+    along as a device scalar masking the padding rows (-inf, sorted
+    last, filtered by the caller). k is a pow2 bucket, so client-chosen
+    ``num`` never mints a program either."""
+    import jax
+    import jax.numpy as jnp
+    u = user_factors[user_ixs]                                # [B, R]
+    scores = jnp.einsum("br,ir->bi", u, item_factors,
+                        preferred_element_type=jnp.float32)
+    valid = jnp.arange(item_factors.shape[0]) < n_items
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def _aot_batch_predict_builder(u: int, i: int, b: int, k: int, r: int):
+    """(jit_fn, example avals, statics) for one batch_predict bucket —
+    what the AOT registry lowers+compiles at deploy/swap time."""
+    import jax
+    sds = jax.ShapeDtypeStruct
+    return (_users_topk_b,
+            (sds((u, r), np.float32), sds((i, r), np.float32),
+             sds((b,), np.int32), sds((), np.int32)),
+            {"k": k})
+
+
+_aot_specs_registered = False
+
+
+def register_aot_specs():
+    """Idempotently register this module's executable specs with the
+    compile plane (deferred off import so `import ops.als` stays
+    side-effect-light)."""
+    global _aot_specs_registered
+    if _aot_specs_registered:
+        return
+    from predictionio_tpu.obs import costmon
+    from predictionio_tpu.compile.aot import get_aot
+    get_aot().register(costmon.BATCH_PREDICT, _aot_batch_predict_builder)
+    _aot_specs_registered = True
+
+
+def batch_predict_dims(model: "ALSModel", batch: int, k: int) -> dict:
+    """The shape-bucket dims covering one batched top-k over ``model``
+    — shared by the serve dispatch and the deploy/swap warm path."""
+    from predictionio_tpu.compile import buckets as B
+    i_b = B.bucket_rows(model.n_items)
+    return {"u": B.bucket_rows(model.n_users), "i": i_b,
+            "b": B.bucket_batch(batch),
+            "k": min(B.bucket_batch(k, floor=B.K_FLOOR), i_b),
+            "r": model.rank}
+
+
+def users_topk_serve(model: "ALSModel", user_ixs, k: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched serve top-k through the compile plane: tables uploaded
+    at vocab-bucket shapes (cached), batch and k padded to their
+    buckets, dispatched via the AOT registry (a warmed bucket runs
+    zero trace / zero compile; a cold one falls back to the jit and
+    adopts in the background). Returns ([n, k_b], [n, k_b]) host
+    arrays — rows may carry -inf/padding entries past ``model.n_items``
+    valid items, which callers drop via their finite-filter."""
+    from predictionio_tpu.compile import buckets as B
+    from predictionio_tpu.compile.aot import get_aot
+    from predictionio_tpu.obs import costmon
+    from predictionio_tpu.utils.device_cache import cached_put_rows
+    register_aot_specs()
+    user_ixs = np.asarray(user_ixs, dtype=np.int32)
+    n = user_ixs.shape[0]
+    dims = batch_predict_dims(model, n, k)
+    ixs = np.zeros(dims["b"], dtype=np.int32)
+    ixs[:n] = user_ixs
+    U = cached_put_rows(model.user_factors, dims["u"])
+    V = cached_put_rows(model.item_factors, dims["i"])
+    k_b = dims["k"]
+    scores, idx = get_aot().dispatch(
+        costmon.BATCH_PREDICT, dims,
+        lambda *a: _users_topk_b(*a, k=k_b),
+        U, V, ixs, np.int32(model.n_items))
+    # bucket promotion: a vocab nearing its bucket pre-compiles the
+    # next bucket's executable in the background, BEFORE growth needs it
+    aot = get_aot()
+    if B.should_promote(model.n_items, dims["i"]):
+        aot.ensure(costmon.BATCH_PREDICT,
+                   dict(dims, i=B.next_bucket(dims["i"]),
+                        k=min(k_b, B.next_bucket(dims["i"]))),
+                   background=True)
+    if B.should_promote(model.n_users, dims["u"]):
+        aot.ensure(costmon.BATCH_PREDICT,
+                   dict(dims, u=B.next_bucket(dims["u"])),
+                   background=True)
+    return np.asarray(scores)[:n], np.asarray(idx)[:n]
 
 
 @functools.partial(__import__("jax").jit, static_argnames=("k",))

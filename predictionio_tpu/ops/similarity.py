@@ -49,6 +49,45 @@ def _batched_masked_topk(query_mat, item_table, allowed, k: int,
     return jax.lax.top_k(scores, k)
 
 
+def _aot_masked_topk_builder(b: int, i: int, r: int, k: int, fp: int):
+    """(jit_fn, example avals, statics) for one masked-top-k bucket
+    (the compile plane's batch_predict executable for the cosine /
+    filtered model families)."""
+    import jax
+    sds = jax.ShapeDtypeStruct
+    return (_batched_masked_topk,
+            (sds((b, r), np.float32), sds((i, r), np.float32),
+             sds((b, i), bool)),
+            {"k": k, "filter_positive": bool(fp)})
+
+
+_aot_specs_registered = False
+
+
+def register_aot_specs():
+    """Idempotently register the masked-top-k executable spec with the
+    compile plane (ISSUE 9)."""
+    global _aot_specs_registered
+    if _aot_specs_registered:
+        return
+    from predictionio_tpu.obs import costmon
+    from predictionio_tpu.compile.aot import get_aot
+    get_aot().register(costmon.BATCH_PREDICT_MASKED,
+                       _aot_masked_topk_builder)
+    _aot_specs_registered = True
+
+
+def masked_topk_dims(n_items: int, rank: int, batch: int, k: int,
+                     filter_positive: bool = True) -> dict:
+    """Shape-bucket dims for one masked-top-k call — shared by the
+    serve dispatch and the deploy/swap warm path."""
+    from predictionio_tpu.compile import buckets as B
+    i_b = B.bucket_rows(n_items)
+    return {"b": B.bucket_batch(batch), "i": i_b, "r": int(rank),
+            "k": min(B.bucket_batch(k, floor=B.K_FLOOR), i_b),
+            "fp": int(bool(filter_positive))}
+
+
 def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
                        masks: np.ndarray, k: int,
                        filter_positive: bool = True
@@ -57,24 +96,44 @@ def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
 
     query_vecs [B, R] (already in the scoring space: raw user factors for
     dot scoring, summed-normalized item vectors for cosine), masks [B, I]
-    bool. Both the batch dim and k are padded to powers of two so the
-    kernel compiles once per (batch, k) size class even though q.num is
-    client-controlled. filter_positive additionally drops score <= 0
-    (cosine-template semantics; explicit-ALS callers pass False). Returns
-    ([B, k'], [B, k']) numpy arrays with k' >= min(k, I); rows may contain
-    -inf for excluded slots (caller filters non-finite and slices to its
+    bool. Every moving dim is shape-bucketed (ISSUE 9 compile plane):
+    batch and k pad to powers of two, the item table uploads at its
+    vocab bucket (padding rows masked out), so neither request-batch
+    size, client-chosen num, NOR catalog growth inside a bucket mints a
+    new program — and the dispatch resolves through the AOT registry,
+    so a warmed bucket runs zero trace / zero compile.
+    filter_positive additionally drops score <= 0 (cosine-template
+    semantics; explicit-ALS callers pass False). Returns ([B, k'],
+    [B, k']) numpy arrays with k' >= min(k, I); rows may contain -inf
+    for excluded slots (caller filters non-finite and slices to its
     own num)."""
-    from predictionio_tpu.utils.device_cache import cached_put
+    from predictionio_tpu.compile import buckets as B
+    from predictionio_tpu.compile.aot import get_aot
+    from predictionio_tpu.obs import costmon
+    from predictionio_tpu.utils.device_cache import cached_put_rows
+    register_aot_specs()
     n_items = item_table.shape[0]
     n = query_vecs.shape[0]
-    b = 1 << max(0, (n - 1).bit_length())
-    qp = np.zeros((b, query_vecs.shape[1]), dtype=np.float32)
+    dims = masked_topk_dims(n_items, query_vecs.shape[1], n, k,
+                            filter_positive)
+    qp = np.zeros((dims["b"], query_vecs.shape[1]), dtype=np.float32)
     qp[:n] = query_vecs
-    mp = np.zeros((b, n_items), dtype=bool)
-    mp[:n] = masks
-    k_eff = min(1 << max(0, (k - 1).bit_length()), n_items)
-    scores, idx = _batched_masked_topk(qp, cached_put(item_table), mp, k_eff,
-                                       filter_positive)
+    # padding rows of the bucketed table stay masked False -> -inf
+    mp = np.zeros((dims["b"], dims["i"]), dtype=bool)
+    mp[:n, :n_items] = masks
+    k_eff = dims["k"]
+    item_dev = cached_put_rows(item_table, dims["i"])
+    scores, idx = get_aot().dispatch(
+        costmon.BATCH_PREDICT_MASKED, dims,
+        lambda *a: _batched_masked_topk(
+            *a, k=k_eff, filter_positive=filter_positive),
+        qp, item_dev, mp)
+    if B.should_promote(n_items, dims["i"]):
+        get_aot().ensure(
+            costmon.BATCH_PREDICT_MASKED,
+            dict(dims, i=B.next_bucket(dims["i"]),
+                 k=min(k_eff, B.next_bucket(dims["i"]))),
+            background=True)
     return np.asarray(scores)[:n], np.asarray(idx)[:n]
 
 

@@ -42,8 +42,32 @@ def configure_compilation_cache() -> None:
     are paid once per machine. Called at CLI process init and again
     lazily from _jax() (env vars may be latched before we run —
     sitecustomize imports jax at interpreter start — so this goes through
-    jax.config). Safe to call repeatedly/concurrently."""
+    jax.config). Safe to call repeatedly/concurrently.
+
+    Delegates to the compile plane's managed cache (ISSUE 9,
+    compile/cache.py: salted dir under ``base_dir()/xla_cache``,
+    hit/miss counters, ``pio cache`` lifecycle); the legacy per-user
+    ``~/.cache/pio_tpu/xla`` path remains only as the fallback when the
+    compile plane is unavailable."""
     global _compile_cache_set
+    if _compile_cache_set:
+        return
+    try:
+        from predictionio_tpu.compile.cache import (cache_disabled,
+                                                    enable_persistent_cache)
+        if cache_disabled():
+            _compile_cache_set = True    # operator kill switch: no cache
+            return
+        if enable_persistent_cache() is not None:
+            _compile_cache_set = True
+            return
+        # enable failed internally (unwritable base_dir, config error):
+        # fall through to the legacy per-user path rather than silently
+        # running with no cache at all
+        logger.debug("compile-plane cache enable failed; legacy path")
+    except Exception:
+        logger.debug("compile-plane cache unavailable; legacy path",
+                     exc_info=True)
     with _compile_cache_lock:
         if _compile_cache_set:
             return

@@ -193,6 +193,15 @@ class EngineServer:
             registry=self.metrics)
         self.last_good_version: Optional[str] = None
         self.on_canary_decision = None
+        # compile plane (ISSUE 9): swap-to-first-query measurement.
+        # _swap_marker = (version, t0, candidate_only) armed by every
+        # model change (load/swap/canary stage/promote); the first query
+        # completion that matches closes it into
+        # last_swap_to_first_query_ms + a flight record — the end-to-end
+        # number the AOT warm path exists to shrink.
+        self._swap_marker = None
+        self.last_swap_to_first_query_ms: Optional[float] = None
+        self.last_aot_warm: Optional[dict] = None
         self._register_metrics()
         self.batcher = None
         if config.micro_batch > 1:
@@ -244,6 +253,12 @@ class EngineServer:
                      "1 while a canary candidate version serves a "
                      "fraction of this server's traffic",
                      lambda: int(self.canary.active))
+        m.gauge_func("pio_engine_swap_to_first_query_ms",
+                     "Wall ms from the latest model change (load, "
+                     "hot-swap, canary stage/promote) to its first "
+                     "served query — compile-free when the AOT warm "
+                     "path did its job",
+                     lambda: self.last_swap_to_first_query_ms or 0.0)
         if self.coordinator is not None:
             m.gauge_func("pio_engine_mesh_processes",
                          "Processes in the serving mesh",
@@ -345,9 +360,72 @@ class EngineServer:
                 self.swap_count += 1  # /reload hot-swap, not first load
             logger.info("Engine instance %s loaded (%d algorithm(s))",
                         instance.id, len(self.algorithms))
+        # compile plane (ISSUE 9): AOT-compile the serving executables
+        # at deploy time — outside the serving lock (an in-flight query
+        # during /reload keeps answering from the jit path meanwhile)
+        self._warm_aot(self.models, instance.id)
+        self._arm_swap_marker(instance.id, models_token=self.models)
         FLIGHT.record("hot_swap" if was_loaded else "model_load",
                       model_version=instance.id, source="load")
         return self
+
+    # -- compile plane (ISSUE 9) --------------------------------------------
+    def _warm_aot(self, models, version: Optional[str]):
+        """AOT-compile the serving executables for ``models`` BEFORE
+        they take a request (the caller — scheduler publish thread,
+        canary stage, deploy load — pays the compile, never a query).
+        Fail-soft: a warm failure leaves the jit fallback path serving
+        correctly."""
+        try:
+            from predictionio_tpu.compile.aot import warm_models
+            summary = warm_models(self.algorithms, models,
+                                  batch_hint=max(self.config.micro_batch,
+                                                 1))
+            self.last_aot_warm = dict(summary, version=version)
+            if summary.get("compiled"):
+                FLIGHT.record("aot_warm", model_version=version,
+                              **{k: summary[k] for k in
+                                 ("compiled", "skipped", "wallS")
+                                 if k in summary})
+        except Exception:
+            logger.warning("AOT warm failed; serving falls back to "
+                           "jit dispatch", exc_info=True)
+
+    def _arm_swap_marker(self, version: Optional[str],
+                         candidate_only: bool = False,
+                         models_token=None):
+        """``models_token`` is the exact model-list object installed by
+        the change: only a query that SERVED it may close the marker (a
+        query already in flight against the old models at swap time
+        would otherwise bank a fake ~0 ms first-query wall). Canary
+        stages pass no token — the CANDIDATE arm check is the gate."""
+        with self._lock:
+            self._swap_marker = (version, time.perf_counter(),
+                                 candidate_only, models_token)
+
+    def _close_swap_marker(self, arm: str, models_used=None):
+        """First matching query after a model change: bank the
+        swap-to-first-query wall. Candidate-only markers (canary stage)
+        wait for the first CANDIDATE-served query — the one that would
+        pay any un-warmed compile."""
+        marker = self._swap_marker
+        if marker is None:
+            return
+        version, t0, candidate_only, token = marker
+        from predictionio_tpu.guard.canary import CANDIDATE
+        if candidate_only and arm != CANDIDATE:
+            return
+        if token is not None and models_used is not token:
+            return  # an in-flight query against the pre-swap models
+        with self._lock:
+            if self._swap_marker is not marker:
+                return
+            self._swap_marker = None
+            ms = (time.perf_counter() - t0) * 1000.0
+            self.last_swap_to_first_query_ms = ms
+        FLIGHT.record("first_query_after_swap", model_version=version,
+                      swapToFirstQueryMs=round(ms, 3),
+                      canary=candidate_only)
 
     def swap_models(self, models, version: Optional[str] = None,
                     fold_in_events: int = 0):
@@ -356,12 +434,20 @@ class EngineServer:
         a mixed-version set. The query paths snapshot (algorithms, models,
         serving) under the same lock, and fold-in produces NEW model
         objects rather than mutating deployed ones — both halves of the
-        no-torn-read guarantee."""
+        no-torn-read guarantee.
+
+        Compile plane (ISSUE 9): the incoming models' serving
+        executables are AOT-warmed HERE, on the publishing thread,
+        before the swap/stage — so the first query against the new
+        version (including a guarded rollback's return to the
+        incumbent, whose executables are already resident) runs zero
+        XLA compiles."""
         models = list(models)
         if len(models) != len(self.algorithms):
             raise ValueError(
                 f"swap_models got {len(models)} models for "
                 f"{len(self.algorithms)} algorithms")
+        self._warm_aot(models, version)
         # guarded deploys (ISSUE 5): with canarying on, the new version
         # becomes a CANDIDATE serving canary_fraction of traffic; the
         # watchdog promotes or rolls back — the incumbent keeps
@@ -373,6 +459,9 @@ class EngineServer:
                           or not self.coordinator.multi_process)
         if single_process and self.canary.stage(models, version,
                                                 int(fold_in_events)):
+            # the candidate is warm BEFORE its first routed request:
+            # measure stage -> first candidate-served query
+            self._arm_swap_marker(version, candidate_only=True)
             FLIGHT.record("canary_staged", model_version=version,
                           fraction=self.canary.config.fraction,
                           foldInEvents=int(fold_in_events))
@@ -387,6 +476,7 @@ class EngineServer:
             # a landed swap ends any stale-model degradation window
             self._last_swap_wall = time.time()
             self.publish_degraded = False
+        self._arm_swap_marker(version, models_token=models)
         FLIGHT.record("hot_swap", model_version=version,
                       source="fold_publish",
                       foldInEvents=int(fold_in_events))
@@ -448,6 +538,10 @@ class EngineServer:
                 self.last_good_version = self.model_version
                 self._last_swap_wall = time.time()
                 self.publish_degraded = False
+            # the promoted candidate's executables are already resident
+            # (warmed at stage): promote -> first query is compile-free
+            self._arm_swap_marker(decision["candidateVersion"],
+                                  models_token=decision["models"])
             FLIGHT.record("hot_swap",
                           model_version=decision["candidateVersion"],
                           source="canary_promote")
@@ -529,6 +623,7 @@ class EngineServer:
             self.predict_seconds += predict_dt
             self._lat_ring.append(dt)
         self._h_query.observe(dt)
+        self._close_swap_marker(arm, models_used=models)
         self._canary_observe(arm, pred_dicts=(pred_dict,), latency_s=dt)
         if canary_models is not None:
             # response tagging: the HTTP layer turns this into the
@@ -632,6 +727,7 @@ class EngineServer:
             self._lat_ring.extend([dt] * len(queries))
         for _ in queries:
             self._h_query.observe(dt)
+        self._close_swap_marker(arm, models_used=models)
         self._canary_observe(arm, pred_dicts=out, latency_s=dt,
                              n=len(queries))
         if canary_models is not None:
@@ -812,6 +908,11 @@ class EngineServer:
                 # in-memory rollback anchor
                 "canary": self.canary.stats(),
                 "lastGoodVersion": self.last_good_version,
+                # compile plane (ISSUE 9): how fast the last model
+                # change reached its first served query, and the last
+                # deploy-time warm summary
+                "swapToFirstQueryMs": self.last_swap_to_first_query_ms,
+                "aotWarm": self.last_aot_warm,
             }
             pct = self._ring_percentiles()
             if pct is not None:
@@ -831,7 +932,19 @@ class EngineServer:
                 out.update(self.batcher.stats())
             if self.coordinator is not None:
                 out["meshCoordinator"] = self.coordinator.health()
-            return Response(200, out)
+        # AOT registry + persistent-cache state (ISSUE 9 satellite):
+        # executables resident, buckets compiled, dispatch hit/miss and
+        # persistent-cache counters since start — outside the serving
+        # lock (snapshot takes the registry's own lock; cache status
+        # does a small dir listing)
+        try:
+            from predictionio_tpu.compile.aot import get_aot
+            from predictionio_tpu.compile.cache import cache_status
+            out["aot"] = get_aot().snapshot()
+            out["xlaCache"] = cache_status()
+        except Exception:
+            logger.debug("aot stats unavailable", exc_info=True)
+        return Response(200, out)
 
     def _profile(self, req: Request) -> Response:
         """jax.profiler trace control — beyond-parity observability

@@ -135,6 +135,27 @@ def _print_telemetry(args) -> None:
                f"version={st.get('modelVersion')}")
         _print_hist("queryLatency", st.get("queryLatency"))
         _print_hist("batchWait", st.get("batchWait"))
+        # compile plane (ISSUE 9): AOT registry + persistent-cache view
+        aot = st.get("aot") or {}
+        if aot:
+            _print(f"  aot: resident={aot.get('executablesResident')} "
+                   f"hitRate={aot.get('hitRate')} "
+                   f"compiles={aot.get('compileCount')} "
+                   f"({aot.get('compileSeconds')}s) "
+                   f"sharedJits={len(aot.get('sharedJits', []))}")
+            for label, bks in sorted(
+                    (aot.get("bucketsCompiled") or {}).items()):
+                _print(f"    {label}: {len(bks)} bucket(s) "
+                       f"[{', '.join(bks[:4])}"
+                       f"{', ...' if len(bks) > 4 else ''}]")
+        xc = st.get("xlaCache") or {}
+        if xc:
+            _print(f"  xlaCache: entries={xc.get('entries')} "
+                   f"hits={xc.get('hits')} misses={xc.get('misses')} "
+                   f"salt={xc.get('salt')}")
+        if st.get("swapToFirstQueryMs") is not None:
+            _print(f"  swapToFirstQuery="
+                   f"{st['swapToFirstQueryMs']:.1f}ms")
     _print("Event server telemetry...")
     ev = _fetch_json(f"{events}/stats.json?accessKey="
                      f"{getattr(args, 'accesskey', '') or ''}")
@@ -931,6 +952,28 @@ def cmd_lint(args) -> int:
     return lint_main(argv)
 
 
+def cmd_cache(args) -> int:
+    """`pio cache {status,clear}` (ISSUE 9): the persistent XLA compile
+    cache under base_dir()/xla_cache/<salt>. `status` reports the
+    active salted directory, entry count/bytes, dead-salt dirs left by
+    kernel changes, and the process's hit/miss counters; `clear`
+    removes the active salt's entries (safe live — jax re-creates them
+    on the next compile), `clear --all` also removes dead salts."""
+    import json as _json
+    from predictionio_tpu.compile.cache import (cache_status, clear_cache,
+                                                enable_persistent_cache)
+    if args.cache_cmd == "status":
+        enable_persistent_cache()
+        _print(_json.dumps(cache_status(), indent=2, default=str))
+        return 0
+    if args.cache_cmd == "clear":
+        out = clear_cache(all_salts=args.all)
+        _print(_json.dumps(out))
+        return 0
+    _print("cache command must be status|clear")
+    return 1
+
+
 def cmd_upgrade(args) -> int:
     """(Console upgrade / WorkflowUtils.checkUpgrade — the reference phones
     home for new versions; this build is offline, so upgrade is a no-op
@@ -1230,6 +1273,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "set (new entries get TODO justifications "
                          "you must edit)")
     ln.set_defaults(func=cmd_lint)
+
+    ca = sub.add_parser(
+        "cache", help="persistent XLA compile cache (ISSUE 9): the "
+        "salted executable store under base_dir()/xla_cache that makes "
+        "warmup compiles a once-per-machine cost")
+    casub = ca.add_subparsers(dest="cache_cmd", required=True)
+    casub.add_parser("status")
+    cacl = casub.add_parser("clear")
+    cacl.add_argument("--all", action="store_true",
+                      help="also remove dead-salt directories left by "
+                           "kernel changes")
+    ca.set_defaults(func=cmd_cache)
 
     rb = sub.add_parser(
         "rollback", help="guarded deploys (ISSUE 5): demote model "

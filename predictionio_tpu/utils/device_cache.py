@@ -76,6 +76,38 @@ def cached_put_padded(arr, sharding, row_multiple: int):
     return dev
 
 
+def cached_put_rows(arr, target_rows: int, sharding=None):
+    """cached_put with dim-0 zero-padded to ``target_rows`` — the
+    vocab-bucket upload of the compile plane (ISSUE 9): serving tables
+    are uploaded at their shape-bucket size so vocabulary growth inside
+    the bucket reuses both the resident device copy AND every compiled
+    executable that reads it. Memoized on (array identity, rows,
+    sharding); a smaller ``target_rows`` than the array has rows
+    uploads unpadded (callers pass a covering bucket)."""
+    import jax
+    import numpy as np
+
+    target = max(int(target_rows), arr.shape[0])
+    key = (id(arr), target, sharding)
+    with _lock:
+        entry = _cache.get(key)
+        if entry is not None and entry[0]() is arr:
+            return entry[1]
+    padded = arr if target == arr.shape[0] else np.concatenate(
+        [arr, np.zeros((target - arr.shape[0],) + arr.shape[1:],
+                       arr.dtype)])
+    dev = jax.device_put(padded, sharding) if sharding is not None \
+        else jax.device_put(padded)
+    _record_upload(padded)
+    try:
+        ref = weakref.ref(arr, lambda r, k=key: _cache.pop(k, None))
+    except TypeError:
+        return dev
+    with _lock:
+        _cache[key] = (ref, dev)
+    return dev
+
+
 def cache_size() -> int:
     with _lock:
         return len(_cache)

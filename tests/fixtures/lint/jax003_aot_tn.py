@@ -1,0 +1,16 @@
+"""JAX003 true negative (AOT-registry idiom): the jit construction is
+handed to the compile plane, which caches it process-wide
+(AOTRegistry.adopt / shared_jit) — a cached-jit pattern, not a
+per-call recompile."""
+
+import jax
+
+from predictionio_tpu.compile.aot import get_aot
+
+
+def resolve_executable(x):
+    def impl(y):
+        return y * 2.0
+
+    fn = jax.jit(impl)
+    return get_aot().adopt("demo.impl", fn)(x)

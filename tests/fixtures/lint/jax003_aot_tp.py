@@ -1,0 +1,18 @@
+"""JAX003 true positive (AOT-era): a jit built inside the per-request
+function and dispatched through a helper — handed to NOTHING that
+caches it (not the compile plane's registry, no module dict), so every
+invocation recompiles."""
+
+import jax
+
+
+def _run(fn, x):
+    return fn(x)
+
+
+def answer_query(x):
+    def impl(y):
+        return y * 2.0
+
+    fn = jax.jit(impl)
+    return _run(fn, x)

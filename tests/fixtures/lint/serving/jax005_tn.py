@@ -1,0 +1,18 @@
+"""JAX005 true negative: the serving dispatch resolves through the
+compile plane (AOT registry dispatch with shape buckets); the module
+jit is only the fallback callable, never dispatched directly."""
+
+import jax
+
+from predictionio_tpu.compile.aot import get_aot
+
+
+def _impl(y):
+    return y * 2.0
+
+
+_fn = jax.jit(_impl)
+
+
+def answer_query(x):
+    return get_aot().dispatch("demo", {"b": 1}, _fn, x)

@@ -1,0 +1,17 @@
+"""JAX005 true positive: a module-level jitted callable dispatched
+directly from a serving-path module — no compile-plane resolution, so
+every shape change re-traces and pays a full XLA compile on the
+request path."""
+
+import jax
+
+
+def _impl(y):
+    return y * 2.0
+
+
+_fn = jax.jit(_impl)
+
+
+def answer_query(x):
+    return _fn(x)

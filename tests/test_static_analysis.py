@@ -33,6 +33,7 @@ RULE_FIXTURES = {
     "JAX002": ("jax002_tp.py", "jax002_tn.py"),
     "JAX003": ("jax003_tp.py", "jax003_tn.py"),
     "JAX004": ("jax004_tp.py", "jax004_tn.py"),
+    "JAX005": ("serving/jax005_tp.py", "serving/jax005_tn.py"),
     "COST001": ("cost001_tp/event_server.py",
                 "cost001_tn/event_server.py"),
     "COST002": ("cost002_tp/server.py", "cost002_tn/server.py"),
@@ -84,6 +85,23 @@ class TestFixtures:
         for tp, tn in RULE_FIXTURES.values():
             for rel in (tp, tn):
                 assert os.path.exists(os.path.join(FIXTURES, rel)), rel
+
+
+class TestAOTIdiomJAX003:
+    """ISSUE 9 satellite: JAX003 recognizes the compile plane's
+    registry-adoption idiom as a cached-jit pattern (a second TP/TN
+    pair beyond the canonical RULE_FIXTURES row)."""
+
+    def test_adopt_idiom_is_cached_jit(self, fixture_findings):
+        fired = fixture_findings.get("jax003_aot_tn.py", set())
+        assert "JAX003" not in fired, (
+            "registry adoption (AOT.adopt(key, jax.jit(...))) must "
+            "count as a cached-jit pattern")
+        assert not fired, f"aot TN fixture not fully clean: {fired}"
+
+    def test_unadopted_per_call_jit_still_fires(self, fixture_findings):
+        assert "JAX003" in fixture_findings.get("jax003_aot_tp.py",
+                                                set())
 
 
 class TestRuleIdNamingLint:
