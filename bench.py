@@ -1089,6 +1089,12 @@ def bench_fold_tick(full_scale: bool):
                 k: int(v) for k, v in cache["hits"].items()}
             out["compile_cache_misses"] = {
                 k: int(v) for k, v in cache["misses"].items()}
+        # device-time attribution (ISSUE 11, schema-additive): the
+        # acceptance check that serve + fold executables both own
+        # non-zero estimated device seconds after one bench run
+        dev = _costmon.device_time_by_executable()
+        if dev:
+            out["device_time_s_by_executable"] = dev
     return out
 
 
@@ -1245,6 +1251,14 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
         # warmup observations already in the cumulative buckets
         q_hist = server.metrics.get("pio_engine_query_seconds")
         q_hist_pre = q_hist.bucket_counts()
+        # runtime attribution window markers (ISSUE 11): estimated
+        # device seconds + sampling-profiler wall spent DURING the
+        # timed traffic only
+        from predictionio_tpu.obs import costmon as _costmon
+        from predictionio_tpu.obs.profiler import PROFILER as _PROF
+        dev_pre = sum(_costmon.device_time_by_executable().values())
+        prof_pre = _PROF.spent_s
+        t_window0 = time.perf_counter()
         lat = []
         for u in users:
             t0 = time.perf_counter()
@@ -1313,6 +1327,27 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
                 v = wait_hist.percentile(q)
                 if v is not None:
                     out[f"batch_wait_hist_{suffix}"] = float(v * 1000)
+        # runtime attribution (ISSUE 11, schema-additive): where the
+        # serve window's time went — estimated device seconds over the
+        # timed wall (the ALX-style occupancy number), the queue-vs-
+        # device p99 decomposition, and the always-on profiler's own
+        # cost over the same window
+        window_s = time.perf_counter() - t_window0
+        dev_s = sum(_costmon.device_time_by_executable().values()) \
+            - dev_pre
+        if window_s > 0:
+            out["device_time_fraction"] = round(
+                min(dev_s / window_s, 1.0), 4)
+        if wait_hist is not None and wait_hist.count:
+            v = wait_hist.percentile(99)
+            if v is not None:
+                out["serve_queue_p99_ms"] = float(v * 1000)
+        dev_pct = _costmon.device_time_percentiles(
+            _costmon.BATCH_PREDICT)
+        if dev_pct is not None:
+            out["serve_device_p99_ms"] = dev_pct["p99_ms"]
+        out["profiler_overhead_ms"] = round(
+            (_PROF.spent_s - prof_pre) * 1000.0, 3)
         return out
     finally:
         client.close()

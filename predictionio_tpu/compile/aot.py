@@ -38,6 +38,7 @@ import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from predictionio_tpu.compile.buckets import bucket_key, bucket_label
+from predictionio_tpu.obs.costmon import device_timed
 
 logger = logging.getLogger(__name__)
 
@@ -191,13 +192,18 @@ class AOTRegistry:
         """Serve-path dispatch: the held executable when the bucket is
         warm (zero trace/compile), else the jit ``fallback`` — whose
         compile the persistent cache covers — plus a background
-        adoption so the NEXT request in this bucket hits."""
+        adoption so the NEXT request in this bucket hits.
+
+        Every dispatch — held executable and fallback alike — runs
+        under ``costmon.device_timed`` (ISSUE 11): dispatch wall is
+        counted per request and a 1-in-N sampled sync books true
+        device seconds to ``pio_device_time_seconds_total{label}``."""
         if not aot_enabled():
-            return fallback(*args)
+            return device_timed(label, fallback, *args)
         compiled = self._compiled.get((label, bucket_key(dims)))
         if compiled is not None:
             try:
-                out = compiled(*args)
+                out = device_timed(label, compiled, *args)
                 self._c_hits.labels(executable=label).inc()
                 return out
             except TypeError:
@@ -210,7 +216,7 @@ class AOTRegistry:
         else:
             self._c_misses.labels(executable=label).inc()
             self.ensure(label, dims, background=True)
-        return fallback(*args)
+        return device_timed(label, fallback, *args)
 
     # -- shared cached-jit surface ------------------------------------------
     def adopt(self, key: str, fn) -> Any:

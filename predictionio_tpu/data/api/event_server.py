@@ -906,13 +906,17 @@ class EventServer:
             return Response(404, {
                 "message": "To expose metrics, launch Event Server with "
                            "--stats argument."})
-        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+        from predictionio_tpu.utils.prometheus import (
+            CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE, wants_exemplars)
+        om = wants_exemplars(req)
         self._window_pin = self.stats.to_dict(None)
         try:
-            body = self.metrics.render()
+            body = self.metrics.render(exemplars=om)
         finally:
             self._window_pin = None
-        return Response(200, body, content_type=CONTENT_TYPE)
+        return Response(200, body,
+                        content_type=OPENMETRICS_CONTENT_TYPE if om
+                        else CONTENT_TYPE)
 
     def _traces(self, req: Request) -> Response:
         """GET /traces.json — recent span trees from the process-wide
@@ -943,6 +947,20 @@ class EventServer:
         per-app detail."""
         return Response(200, health_response(self.slo, extra={
             "breaker": self.breaker.state}))
+
+    def _profile(self, req: Request) -> Response:
+        """``/profile.json`` (ISSUE 11 satellite) — the same profiling
+        surface the engine server mounts (obs/profiler.py): jax trace
+        start/stop toggle + the sampling profiler's report. Gated like
+        /metrics: stacks name storage paths and internals, so a server
+        launched without --stats exposes nothing."""
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To expose profiling, launch Event Server "
+                           "with --stats argument."})
+        from predictionio_tpu.obs import profiler
+        status, body = profiler.profile_response_from_request(req)
+        return Response(status, body)
 
     def _webhook_json(self, req: Request) -> Response:
         access_key, channel_id = self._authenticate(req)
@@ -1010,6 +1028,8 @@ class EventServer:
         r.add("GET", "/traces.json", self._traces)
         r.add("GET", "/flight.json", self._flight)
         r.add("GET", "/health.json", self._health)
+        r.add("POST", "/profile.json", self._profile)
+        r.add("GET", "/profile.json", self._profile)
         r.add("POST", "/webhooks/<name>.json", guarded(self._webhook_json))
         r.add("GET", "/webhooks/<name>.json", guarded(self._webhook_get))
         r.add("POST", "/webhooks/<name>", guarded(self._webhook_form))
@@ -1023,6 +1043,9 @@ class EventServer:
         if self.config.spill and os.path.exists(self._spill_path()) \
                 and os.path.getsize(self._spill_path()) > 0:
             self._get_wal()
+        # always-on sampling profiler (ISSUE 11; PIO_PROFILER=off)
+        from predictionio_tpu.obs import profiler
+        profiler.ensure_started()
         srv = HttpServer(self.router, self.config.ip, self.config.port)
         self.server = srv
         srv.start(background=background)

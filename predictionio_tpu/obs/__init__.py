@@ -21,6 +21,16 @@ The diagnostics plane (ISSUE 6) layers on those primitives:
   per-resident-table HBM gauges.
 - ``obs.slo`` — burn-rate SLO engine (``GET /health.json``) and
   lock-wait contention probes.
+
+The runtime-attribution plane (ISSUE 11) completes the picture:
+
+- ``obs.costmon`` additionally attributes **device time** per
+  executable (sampled ``block_until_ready`` syncs) — see
+  ``device_timed``.
+- ``obs.profiler`` — always-on low-Hz folded-stack sampling profiler
+  plus the shared jax.profiler trace toggle (``/profile.json``).
+- ``obs.slowlog`` — slow-query stage waterfalls (``GET /slow.json``)
+  with exemplar trace ids.
 """
 
 from predictionio_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
@@ -38,6 +48,10 @@ from predictionio_tpu.obs.slo import (SLOEngine, SLOSpec,
                                       default_engine_specs,
                                       default_event_specs,
                                       health_response)
+from predictionio_tpu.obs.profiler import (PROFILER, SamplingProfiler,
+                                           get_profiler)
+from predictionio_tpu.obs.slowlog import (SLOWLOG, SlowQueryLog,
+                                          get_slowlog, slow_response)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "FuncCollector", "Gauge", "Histogram",
@@ -48,4 +62,6 @@ __all__ = [
     "INCIDENTS", "IncidentManager", "get_incidents",
     "SLOEngine", "SLOSpec", "default_engine_specs",
     "default_event_specs", "health_response",
+    "PROFILER", "SamplingProfiler", "get_profiler",
+    "SLOWLOG", "SlowQueryLog", "get_slowlog", "slow_response",
 ]

@@ -30,12 +30,31 @@ meant for bench/smoke paths that accept paying one compile.
 table device bytes sampled from ``utils/device_cache``'s residency
 slots at scrape time — the per-tenant HBM accounting the multi-tenant
 ROADMAP item builds on (ALX-style per-core memory budgeting).
+
+Device-time attribution (ISSUE 11): compile seconds explain the warmup;
+``device_timed(label, fn, *args)`` explains the steady state. Every
+AOT/jit dispatch through it counts its **dispatch wall** (the async
+enqueue — µs) into ``pio_dispatch_seconds_total{executable}``, and a
+1-in-N sampled dispatch additionally ``block_until_ready``s the result
+to measure the **true device wall**, incrementing
+``pio_device_time_seconds_total{executable}`` by ``wall * N`` (the
+standard sampled extrapolation — unbiased as long as the sampled
+dispatch is exchangeable with its window, which steady serving traffic
+is). The synced walls also feed a per-label rolling ring
+(``device_time_percentiles``) and the ``pio_device_occupancy`` EWMA
+gauge — the ALX-style "which executable owns the accelerator"
+accounting the sharding/multi-tenant ROADMAP items need.
+``PIO_DEVICE_SYNC_EVERY`` tunes N (default 16; 0 disables the sync,
+leaving only the dispatch-wall counters).
 """
 
 from __future__ import annotations
 
+import collections
 import contextvars
+import itertools
 import logging
+import os
 import threading
 import time
 from contextlib import contextmanager
@@ -66,6 +85,10 @@ _c_pc_hits = None
 _c_pc_misses = None
 _g_flops = None
 _g_bytes = None
+_c_dispatch_s = None
+_c_device_s = None
+_c_device_syncs = None
+_g_occupancy = None
 
 
 def _is_backend_compile(name: str) -> bool:
@@ -77,7 +100,8 @@ def _is_backend_compile(name: str) -> bool:
 def install(registry=None):
     """Register the listener + gauges. Idempotent; never raises."""
     global _installed, _c_seconds, _c_hits, _c_misses, _g_flops, \
-        _g_bytes, _c_pc_hits, _c_pc_misses
+        _g_bytes, _c_pc_hits, _c_pc_misses, _c_dispatch_s, \
+        _c_device_s, _c_device_syncs, _g_occupancy
     with _lock:
         if _installed:
             return
@@ -120,6 +144,26 @@ def install(registry=None):
             "Device bytes held by each named residency slot in "
             "utils/device_cache (per-table HBM accounting)",
             _hbm_table_samples)
+        _c_dispatch_s = reg.counter(
+            "pio_dispatch_seconds_total",
+            "Wall time spent in device dispatch calls (the async "
+            "enqueue, NOT device execution) by executable label",
+            labelnames=("executable",))
+        _c_device_s = reg.counter(
+            "pio_device_time_seconds_total",
+            "Estimated device execution wall time by executable: each "
+            "1-in-N sampled dispatch is synced (block_until_ready) and "
+            "its wall extrapolated by the sampling factor",
+            labelnames=("executable",))
+        _c_device_syncs = reg.counter(
+            "pio_device_syncs_total",
+            "Sampled dispatches that paid a block_until_ready to "
+            "measure true device wall", labelnames=("executable",))
+        _g_occupancy = reg.gauge(
+            "pio_device_occupancy",
+            "EWMA fraction of wall-clock time the device spent "
+            "executing attributed work (clamped to 1; from the sampled "
+            "device-time estimates)")
     try:
         from jax import monitoring
         monitoring.register_event_duration_secs_listener(_on_duration)
@@ -202,6 +246,179 @@ def executable(label: str, defer_to_outer: bool = False):
                     _c_hits.labels(executable=label).inc()
             except Exception:
                 pass
+
+
+# -- device-time attribution (ISSUE 11) ---------------------------------
+
+def _sync_every_default() -> int:
+    try:
+        return max(0, int(os.environ.get("PIO_DEVICE_SYNC_EVERY", 16)))
+    except (TypeError, ValueError):
+        return 16
+
+
+class _DeviceState:
+    """Per-label hot-path state: pre-resolved counter children (no
+    .labels() lock per dispatch), an atomic dispatch tick for the
+    1-in-N sampling decision, and a bounded ring of sampled device
+    walls for percentile views."""
+
+    __slots__ = ("dispatch_s", "device_s", "syncs", "tick", "ring",
+                 "every")
+
+    def __init__(self, label: str, every: int):
+        self.dispatch_s = _c_dispatch_s.labels(executable=label)
+        self.device_s = _c_device_s.labels(executable=label)
+        self.syncs = _c_device_syncs.labels(executable=label)
+        self.tick = itertools.count()       # next() is GIL-atomic
+        self.ring = collections.deque(maxlen=128)
+        self.every = every
+
+
+_dev_lock = threading.Lock()
+_dev_state: Dict[str, _DeviceState] = {}
+_block_until_ready = None
+# process occupancy state: estimated device seconds ACCUMULATE into a
+# ~1s wall window shared by every label, and the EWMA updates once per
+# window — a single last-sample timestamp would let two interleaved
+# labels' syncs divide one label's 16-dispatch estimate by the OTHER
+# label's 10ms-old stamp and read "saturated" at modest load
+_OCC_WINDOW_S = 1.0
+_occ_window_t0: Optional[float] = None
+_occ_acc = 0.0
+_occ_ewma = 0.0
+
+
+def _device_state(label: str) -> _DeviceState:
+    st = _dev_state.get(label)
+    if st is None:
+        if not _installed:
+            install()
+        with _dev_lock:
+            st = _dev_state.get(label)
+            if st is None:
+                st = _DeviceState(label, _sync_every_default())
+                _dev_state[label] = st
+    return st
+
+
+def _note_device_time(est_s: float):
+    """Fold one sampled dispatch's extrapolated device seconds into the
+    occupancy window; when the window (~1s) closes, its accumulated
+    estimate over its wall becomes the instantaneous occupancy feeding
+    the EWMA (clamped to 1 — concurrent dispatch threads can attribute
+    more than wall)."""
+    global _occ_window_t0, _occ_acc, _occ_ewma
+    with _dev_lock:
+        now = time.monotonic()
+        if _occ_window_t0 is None:
+            _occ_window_t0 = now
+        _occ_acc += est_s
+        wall = now - _occ_window_t0
+        if wall >= _OCC_WINDOW_S:
+            inst = min(_occ_acc / wall, 1.0)
+            _occ_ewma = (inst if _occ_ewma == 0.0
+                         else 0.7 * _occ_ewma + 0.3 * inst)
+            _g_occupancy.set(round(_occ_ewma, 4))
+            _occ_window_t0 = now
+            _occ_acc = 0.0
+
+
+def device_timed(label: str, fn, *args):
+    """Dispatch ``fn(*args)`` under device-time attribution for
+    ``label``. The unsampled path costs two perf_counter reads, one
+    dict get, one atomic tick, and one cached-child counter inc
+    (~1 µs — guarded by tests/test_obs_overhead.py). Every
+    ``PIO_DEVICE_SYNC_EVERY``-th dispatch per label (first included)
+    additionally blocks until the result is device-complete and books
+    the measured wall, extrapolated by the sampling factor, as device
+    time — separating true device seconds from dispatch wall without
+    paying a sync per request. Inside an active trace the sampled sync
+    annotates the current span (``deviceMs``) so slow-query waterfalls
+    gain a device_sync stage."""
+    st = _device_state(label)
+    t0 = time.perf_counter()
+    compile_before = getattr(_tls, "compile_s", 0.0)
+    out = fn(*args)
+    dispatch_dt = time.perf_counter() - t0
+    st.dispatch_s.inc(dispatch_dt)
+    if st.every and next(st.tick) % st.every == 0:
+        global _block_until_ready
+        if _block_until_ready is None:
+            from jax import block_until_ready
+            _block_until_ready = block_until_ready
+        try:
+            _block_until_ready(out)
+        except Exception:
+            pass   # host-side fallback output: already complete
+        wall = time.perf_counter() - t0
+        if getattr(_tls, "compile_s", 0.0) > compile_before:
+            # the sampled dispatch paid an XLA compile (cold jit
+            # fallback — the backend_compile listener fired on this
+            # thread): the wall is compile, not steady-state device
+            # time, and extrapolating it by N would poison the
+            # attribution for the process lifetime (BENCH_r01: one
+            # compile is ~5 orders over an iteration). Skip the
+            # estimate — the next sampled dispatch is warm.
+            return out
+        est = wall * st.every
+        st.device_s.inc(est)
+        st.syncs.inc()
+        with _dev_lock:   # scrape-time percentile reads copy under it
+            st.ring.append(wall)
+        _note_device_time(est)
+        try:
+            from predictionio_tpu.obs.trace import TRACER
+            TRACER.annotate(deviceMs=round(wall * 1000.0, 3),
+                            deviceSampled=st.every)
+        except Exception:
+            pass
+    return out
+
+
+def device_time_by_executable() -> Dict[str, float]:
+    """{label: estimated device seconds} — the bench/stats view."""
+    return {k: round(v, 4)
+            for k, v in _labeled_values(_c_device_s).items()}
+
+
+def dispatch_seconds_by_executable() -> Dict[str, float]:
+    return {k: round(v, 4)
+            for k, v in _labeled_values(_c_dispatch_s).items()}
+
+
+def device_time_percentiles(label: str) -> Optional[Dict[str, float]]:
+    """p50/p99 of the SAMPLED per-dispatch device walls (ms) for one
+    label; None before the first sampled sync."""
+    st = _dev_state.get(label)
+    if st is None:
+        return None
+    with _dev_lock:   # appenders hold it too — no mutation mid-sort
+        walls = sorted(st.ring)
+    if not walls:
+        return None
+    def pick(q):
+        return walls[min(len(walls) - 1, int(q / 100.0 * len(walls)))]
+    return {"p50_ms": round(pick(50) * 1000.0, 4),
+            "p99_ms": round(pick(99) * 1000.0, 4),
+            "samples": len(walls)}
+
+
+def device_snapshot() -> Dict[str, object]:
+    """The /stats.json ``deviceTime`` block: estimated device seconds
+    per executable, the occupancy EWMA, and the sampling factor."""
+    out = {
+        "secondsByExecutable": device_time_by_executable(),
+        "dispatchSecondsByExecutable":
+            dispatch_seconds_by_executable(),
+        "occupancy": round(_occ_ewma, 4),
+        "syncEvery": _sync_every_default(),
+    }
+    pct = {label: device_time_percentiles(label)
+           for label in list(_dev_state)}
+    out["sampledWallMs"] = {k: v for k, v in pct.items()
+                            if v is not None}
+    return out
 
 
 def record_cost_analysis(label: str, compiled) -> Optional[dict]:

@@ -28,6 +28,15 @@ Histograms use Prometheus cumulative buckets (``_bucket{le=...}`` +
 ``_sum``/``_count``) and derive p50/p95/p99 by linear interpolation
 inside the owning bucket for JSON surfaces (``/stats.json``, bench
 artifacts) — one instrument, both expositions.
+
+Exemplars (ISSUE 11): every ``observe()`` made inside an active trace
+stamps the trace id onto the bucket the observation landed in (last
+writer wins), so a tail bucket in a scrape names a concrete,
+replayable request — the ``# {trace_id="..."} value ts`` OpenMetrics
+suffix on ``_bucket`` lines, and the ``exemplars`` block of the
+``/stats.json`` histogram view. One contextvar read plus a tuple
+store under the existing bucket lock: the no-trace hot path (lock
+probes, scheduler internals) pays only the contextvar read.
 """
 
 from __future__ import annotations
@@ -35,6 +44,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 # Default latency buckets (seconds): sub-ms serving paths up through
@@ -44,6 +54,21 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 _INF = float("inf")
+
+
+_trace_id_fn: Optional[Callable] = None
+
+
+def _current_trace_id() -> Optional[str]:
+    """The active trace id, resolved through obs.trace lazily (metrics
+    is the bottom of the obs import stack; a module-level import would
+    cycle through obs/__init__)."""
+    global _trace_id_fn
+    fn = _trace_id_fn
+    if fn is None:
+        from predictionio_tpu.obs.trace import TRACER
+        fn = _trace_id_fn = TRACER.current_trace_id
+    return fn()
 
 
 def _label_key(labelnames: Sequence[str], labels: Dict[str, str]):
@@ -179,6 +204,10 @@ class Histogram:
         self._counts = [0] * (len(bounds) + 1)  # + the +Inf bucket
         self._sum = 0.0
         self._count = 0
+        # per-bucket (trace_id, value, unix_ts) — the most recent
+        # in-trace observation that landed in that bucket
+        self._exemplars: List[Optional[Tuple[str, float, float]]] = \
+            [None] * (len(bounds) + 1)
         self._children: Dict[Tuple[str, ...], "Histogram"] = {}
 
     def labels(self, **labels) -> "Histogram":
@@ -194,10 +223,13 @@ class Histogram:
         if self.labelnames:
             raise ValueError(f"{self.name} is labeled; use .labels()")
         i = bisect.bisect_left(self.bounds, value)
+        tid = _current_trace_id()
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if tid is not None:
+                self._exemplars[i] = (tid, value, time.time())
 
     @property
     def count(self) -> int:
@@ -258,11 +290,32 @@ class Histogram:
             v = self.percentile(q)
             if v is not None:
                 out[k] = v
+        ex = self.exemplars()
+        if ex:
+            # every tail bucket names a replayable trace (ISSUE 11):
+            # the ids resolve via GET /traces.json?trace_id=
+            out["exemplars"] = ex
+        return out
+
+    def exemplars(self) -> Dict[str, dict]:
+        """{le-label: {"traceId", "value", "ts"}} for buckets that have
+        one — the /stats.json exemplar block (only buckets an in-trace
+        observation actually landed in appear)."""
+        with self._lock:
+            ex = list(self._exemplars)
+        out = {}
+        for i, bound in enumerate(list(self.bounds) + [_INF]):
+            if ex[i] is None:
+                continue
+            le = "+Inf" if bound == _INF else format(bound, "g")
+            tid, value, ts = ex[i]
+            out[le] = {"traceId": tid, "value": value, "ts": ts}
         return out
 
     def _own_samples(self, label_base: Optional[dict]):
         with self._lock:
             counts = list(self._counts)
+            ex = list(self._exemplars)
             s, total = self._sum, self._count
         out = []
         cum = 0
@@ -271,7 +324,15 @@ class Histogram:
             le = "+Inf" if bound == _INF else format(bound, "g")
             labels = dict(label_base or {})
             labels["le"] = le
-            out.append(("_bucket", labels, cum))
+            if ex[i] is not None:
+                tid, value, ts = ex[i]
+                # 4-tuple: the renderer appends the OpenMetrics
+                # exemplar suffix to this _bucket line only
+                out.append(("_bucket", labels, cum,
+                            {"labels": {"trace_id": tid},
+                             "value": value, "ts": ts}))
+            else:
+                out.append(("_bucket", labels, cum))
         out.append(("_sum", label_base, s))
         out.append(("_count", label_base, total))
         return out
@@ -412,11 +473,16 @@ class MetricsRegistry:
                     out.append(fam)
         return out
 
-    def render(self, include_parent: bool = True) -> str:
+    def render(self, include_parent: bool = True,
+               exemplars: bool = False) -> str:
         """Prometheus text exposition of everything this registry knows
-        — THE producer behind every ``GET /metrics`` in the stack."""
+        — THE producer behind every ``GET /metrics`` in the stack.
+        ``exemplars=True`` emits the OpenMetrics exemplar-bearing form
+        (``# {trace_id=...}`` bucket suffixes + ``# EOF``); the default
+        stays parseable by the classic 0.0.4 scraper."""
         from predictionio_tpu.utils.prometheus import render_metrics
-        return render_metrics(self.collect(include_parent=include_parent))
+        return render_metrics(self.collect(include_parent=include_parent),
+                              exemplars=exemplars)
 
     def snapshot(self) -> dict:
         """Compact JSON view (own families only): scalar for plain

@@ -246,6 +246,13 @@ class Tracer:
         with self._lock:
             return self._event_traces.get(str(event_id))
 
+    def get(self, trace_id: str) -> Optional[Trace]:
+        """The committed Trace for ``trace_id``, or None once it has
+        rotated out of its ring (O(1); slow-query waterfalls read the
+        batch trace that answered a request this way)."""
+        with self._lock:
+            return self._by_id.get(trace_id)
+
     def link_completed(self, trace_id: str, other_trace_id: str):
         """Add a link onto an already-committed trace (the back-link
         from an event's ingest trace to the fold tick that absorbed

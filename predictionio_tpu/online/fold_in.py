@@ -263,7 +263,9 @@ def solve_rows(counter_factors: np.ndarray,
         gram = gram_of(counter_dev)
     from predictionio_tpu.obs import costmon
     with costmon.executable(costmon.FOLD_SIDE):
-        solved = _run_side(groups, out_dev, counter_dev, als_cfg, gram)
+        solved = costmon.device_timed(
+            costmon.FOLD_SIDE, _run_side, groups, out_dev, counter_dev,
+            als_cfg, gram)
     return np.asarray(host_fetch(solved)[:n_rows], dtype=np.float32)
 
 
@@ -414,9 +416,14 @@ def _solve_side(prep: _SidePrep, counter_dev, counter_gram, out_dev,
     zeros = mesh.put_replicated(
         np.zeros((prep.n_rows + 1, rank), dtype=np.float32))
     with costmon.executable(costmon.FOLD_SIDE):
-        solved = _run_side(prep.groups, zeros, counter_dev, als_cfg,
-                           _solver_gram(counter_gram,
-                                        cfg.dual_solve == "auto"))
+        # device-time attribution (ISSUE 11): the fold solve is the
+        # other big device consumer next to serving — a sampled sync
+        # here is what lets `pio_device_time_seconds_total` compare
+        # fold_side against batch_predict honestly
+        solved = costmon.device_timed(
+            costmon.FOLD_SIDE, _run_side, prep.groups, zeros,
+            counter_dev, als_cfg,
+            _solver_gram(counter_gram, cfg.dual_solve == "auto"))
     if out_gram is None:
         out_dev = _jitted("scatter", _scatter_impl)(
             out_dev, solved, prep.src, prep.dst)

@@ -170,6 +170,42 @@ class DeltaTrainingScheduler:
             cursor if cursor is not None
             else self._instance_cursor(instance))
         self._seen_at_cursor: Set[str] = set()
+        # attach-time boundary dedup (ISSUE 11 triage): event times are
+        # stored at millisecond precision, so events that landed in the
+        # SAME millisecond the cursor anchor was stamped in sit exactly
+        # AT the cursor instant — and the tail's inclusive-start read
+        # would re-count them as fresh on every (re)attach, although
+        # they are already inside the model this scheduler resumes from
+        # (training reads its corpus after start_time is stamped; a
+        # lineage cursor is the max event time the fold consumed).
+        # Seed the boundary-dedup set the running tail already
+        # maintains with the ids currently at the cursor instant. A
+        # failed pre-read degrades to the old behavior: those events
+        # double-count once.
+        # Trade, chosen deliberately: an event whose (client-supplied)
+        # event_time lands in the anchor's exact millisecond AND that
+        # was ingested in the gap between the corpus/fold read and
+        # this attach gets marked seen without having been folded. The
+        # alternative re-folds EVERY genuine boundary event on EVERY
+        # attach (the bug this fixes). The skipped event stays in the
+        # store — the next entity touch or any retrain (drift
+        # escalation, `pio train`) reads it — whereas the old behavior
+        # corrupted fold accounting on every restart unconditionally.
+        if self._cursor is not None:
+            try:
+                self._seen_at_cursor = {
+                    e.event_id for e in self.events.find(
+                        app_name=config.app_name,
+                        channel_name=config.channel_name,
+                        start_time=self._cursor,
+                        until_time=self._cursor
+                        + _dt.timedelta(milliseconds=1),
+                        event_names=self._event_names())
+                    if e.event_id is not None}
+            except Exception:
+                logger.debug(
+                    "cursor-boundary pre-read failed; boundary events "
+                    "may double-count once", exc_info=True)
         self._user_deltas: Dict[str, EntityDelta] = {}
         self._item_deltas: Dict[str, EntityDelta] = {}
         self._pending_events = 0   # fresh events since last fold (1/event)

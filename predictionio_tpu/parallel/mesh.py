@@ -101,6 +101,22 @@ def init_distributed(coordinator: Optional[str] = None,
         return
     num_processes = num_processes or int(os.environ["PIO_NUM_PROCESSES"])
     process_id = process_id or int(os.environ["PIO_PROCESS_ID"])
+    # CPU multi-process meshes need an explicit cross-host collectives
+    # implementation: the default XLA CPU client answers every
+    # multi-process computation with "Multiprocess computations aren't
+    # implemented on the CPU backend". jaxlib ships gloo for exactly
+    # this; select it BEFORE the backend initializes (the config
+    # latches at first device use). TPU/GPU backends have their own
+    # fabric and ignore this knob; older/newer jax without the option
+    # falls through untouched.
+    if num_processes > 1 and os.environ.get(
+            "JAX_PLATFORMS", "").strip().lower() == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            logger.debug("cpu collectives implementation not "
+                         "configurable on this jax", exc_info=True)
     jax.distributed.initialize(coordinator, num_processes, process_id)
     logger.info("jax.distributed initialized: process %d/%d via %s",
                 process_id, num_processes, coordinator)

@@ -278,6 +278,7 @@ class MicroBatcher:
                 first = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
+            t_first = time.perf_counter()   # batch-formation stage t0
             batch = [first]
             # Drain-first batching: take the backlog that accumulated
             # while the previous batch was on the device (the
@@ -343,7 +344,8 @@ class MicroBatcher:
                 for p in batch:
                     self.wait_hist.observe(t_dispatch - p.t_enqueue)
             try:
-                results = self._run_batch(batch)
+                results = self._run_batch(
+                    batch, formation_s=t_dispatch - t_first)
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"batch handler returned {len(results)} results "
@@ -367,16 +369,20 @@ class MicroBatcher:
                                     else 0.8 * self._service_ewma_s
                                     + 0.2 * dt)
 
-    def _run_batch(self, batch):
+    def _run_batch(self, batch, formation_s: float = 0.0):
         """One dispatch. When any member carries an ingress trace, the
         device call runs under its own batch_predict trace linked both
         ways — the dispatch thread has no request context, so the link
-        set is how /traces.json ties a query to its window."""
+        set is how /traces.json ties a query to its window.
+        ``formation_s`` (first dequeue -> dispatch) rides the trace as
+        the slow-query waterfall's batch_formation stage."""
         member_traces = [p.trace_id for p in batch if p.trace_id]
         if not member_traces:
             return self.process_batch([p.query for p in batch])
         from predictionio_tpu.obs import TRACER
-        with TRACER.trace("batch_predict", batch=len(batch)) as bt:
+        with TRACER.trace("batch_predict", batch=len(batch),
+                          formationMs=round(formation_s * 1000.0, 3)
+                          ) as bt:
             for tid in member_traces:
                 bt.link(tid)
             for p in batch:

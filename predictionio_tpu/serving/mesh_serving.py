@@ -123,7 +123,15 @@ class MeshQueryCoordinator:
 
     def _bcast(self, buf: np.ndarray) -> np.ndarray:
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        # the broadcast is a psum, and some backends promote its u8
+        # operand (CPU gloo returns int32): values are intact but
+        # _decode slices BYTES, so restore the wire dtype — without
+        # this the worker json-parses NUL-interleaved text, dies, and
+        # the primary's next collective hangs into the watchdog
+        if out.dtype != np.uint8:
+            out = out.astype(np.uint8)
+        return out
 
     def _bcast_watched(self, buf: np.ndarray) -> np.ndarray:
         """Primary-side broadcast under a watchdog. The collective blocks

@@ -34,7 +34,9 @@ from predictionio_tpu.obs import (FLIGHT, MetricsRegistry, SLOEngine,
                                   TRACER, default_engine_specs,
                                   flight_response, get_incidents,
                                   get_registry, health_response, jaxmon,
-                                  traces_response)
+                                  slow_response, traces_response)
+from predictionio_tpu.obs.slowlog import (capture_slow_query,
+                                          slow_threshold_s)
 from predictionio_tpu.serving.plugins import EngineServerPluginContext
 from predictionio_tpu.utils.http import (HttpServer, Request, Response,
                                          Router)
@@ -150,9 +152,6 @@ class EngineServer:
         self._last_swap_wall = time.time()
         self.start_time = utcnow()
         self.server: Optional[HttpServer] = None
-        # jax.profiler trace state for the idempotent /profile.json
-        # toggle (a second start used to 500 out of jax.profiler)
-        self._profile_dir: Optional[str] = None
         # ISSUE 2: this server's metrics registry, chained onto the
         # process-wide one (JAX telemetry, fold/train instruments ride
         # along on /metrics). Per-server counters keep the server as
@@ -174,6 +173,9 @@ class EngineServer:
         FLIGHT.add_source(self.metrics)
         self.slo = SLOEngine(default_engine_specs(),
                              registries=[self.metrics])
+        # last-seen status per SLO name: the ok->breached transition
+        # detector behind the ISSUE 11 auto-capture in _health
+        self._slo_status: dict = {}
         get_incidents().register_provider("engine_server",
                                           self._incident_state)
         # guarded deploys (ISSUE 5): canary controller + rollback
@@ -593,18 +595,21 @@ class EngineServer:
         query = qc.from_dict(query_dict) if qc is not None else query_dict
         try:
             with self._spmd_guard(query_dict):
-                supplemented = serving.supplement(query)
+                with TRACER.span("supplement"):
+                    supplemented = serving.supplement(query)
                 tp = time.perf_counter()
                 with TRACER.span("predict", algorithms=len(algorithms)):
                     predictions = [algo.predict(model, supplemented)
                                    for algo, model in zip(algorithms,
                                                           models)]
                 predict_dt = time.perf_counter() - tp
-            prediction = serving.serve(query, predictions)
-            pred_dict = (prediction.to_dict()
-                         if hasattr(prediction, "to_dict") else prediction)
-            if not isinstance(pred_dict, dict):
-                pred_dict = {"result": pred_dict}
+            with TRACER.span("post_process"):
+                prediction = serving.serve(query, predictions)
+                pred_dict = (prediction.to_dict()
+                             if hasattr(prediction, "to_dict")
+                             else prediction)
+                if not isinstance(pred_dict, dict):
+                    pred_dict = {"result": pred_dict}
         except Exception:
             self._canary_observe(arm, error=True,
                                  latency_s=time.perf_counter() - t0)
@@ -689,8 +694,9 @@ class EngineServer:
                    for d in query_dicts]
         try:
             with self._spmd_guard(query_dicts):
-                indexed = [(i, serving.supplement(q))
-                           for i, q in enumerate(queries)]
+                with TRACER.span("supplement"):
+                    indexed = [(i, serving.supplement(q))
+                               for i, q in enumerate(queries)]
                 tp = time.perf_counter()
                 with TRACER.span("predict", batch=len(queries),
                                  algorithms=len(algorithms)):
@@ -698,19 +704,21 @@ class EngineServer:
                                 for algo, model in zip(algorithms, models)]
                 predict_dt = time.perf_counter() - tp
             out = []
-            for i, (q, d) in enumerate(zip(queries, query_dicts)):
-                prediction = serving.serve(q, [pa[i] for pa in per_algo])
-                pred_dict = (prediction.to_dict()
-                             if hasattr(prediction, "to_dict")
-                             else prediction)
-                if not isinstance(pred_dict, dict):
-                    pred_dict = {"result": pred_dict}
-                if self.config.feedback:
-                    pr_id = d.get("prId") or self.engine_instance.id
-                    pred_dict = dict(pred_dict, prId=pr_id)
-                    self._send_feedback(d, pred_dict, pr_id)
-                out.append(self.plugin_context.apply_output(
-                    self.engine_instance, d, pred_dict))
+            with TRACER.span("post_process"):
+                for i, (q, d) in enumerate(zip(queries, query_dicts)):
+                    prediction = serving.serve(
+                        q, [pa[i] for pa in per_algo])
+                    pred_dict = (prediction.to_dict()
+                                 if hasattr(prediction, "to_dict")
+                                 else prediction)
+                    if not isinstance(pred_dict, dict):
+                        pred_dict = {"result": pred_dict}
+                    if self.config.feedback:
+                        pr_id = d.get("prId") or self.engine_instance.id
+                        pred_dict = dict(pred_dict, prId=pr_id)
+                        self._send_feedback(d, pred_dict, pr_id)
+                    out.append(self.plugin_context.apply_output(
+                        self.engine_instance, d, pred_dict))
         except Exception:
             self._canary_observe(arm, error=True,
                                  latency_s=time.perf_counter() - t0,
@@ -834,11 +842,13 @@ class EngineServer:
         # work happens under the batcher thread's own batch_predict
         # trace; submit() records the two-way link so /traces.json ties
         # a query to the coalesced window that answered it.
-        with TRACER.trace("query"):
+        with TRACER.trace("query") as qt:
+            t_q0 = time.perf_counter()
             if self.batcher is not None:
                 out = self.batcher.submit(d, deadline_s=deadline_s)
             else:
                 out = self.handle_query(d)
+            total_s = time.perf_counter() - t_q0
             headers = self._degraded_headers()
             if isinstance(out, dict) and "_pioCanary" in out:
                 # the canary tag rides the result dict out of the (
@@ -848,7 +858,45 @@ class EngineServer:
                 version = out.pop("_pioCanary")
                 headers = dict(headers or {})
                 headers["X-PIO-Canary"] = str(version)
+            if total_s >= slow_threshold_s():
+                # slow-query forensics (ISSUE 11): this request already
+                # blew the SLO latency bound — capture its stage
+                # waterfall (all capture work is off the fast path by
+                # construction)
+                self._capture_slow(qt, d, out, total_s)
             return Response(200, out, headers=headers)
+
+    def _capture_slow(self, qt, query_dict: dict, out, total_s: float):
+        """Build + record the slow request's waterfall; never raises
+        into the response path."""
+        try:
+            # the serialize stage IS a second json.dumps of the
+            # response: tens of µs on a request that already took
+            # >=250 ms (<0.05%), paid only on the slow path — and when
+            # the payload is big enough for this to matter, a
+            # serialize-dominated tail is exactly the diagnosis the
+            # stage exists to surface
+            t0 = time.perf_counter()
+            try:
+                json.dumps(out, default=str)
+            except Exception:
+                pass
+            serialize_s = time.perf_counter() - t0
+            # the batcher's submit() linked the coalesced window's
+            # batch_predict trace onto this query trace
+            batch_tid = next(iter(qt.links), None)
+            capture_slow_query(qt, total_s, query=query_dict,
+                               model_version=self.model_version,
+                               serialize_s=serialize_s,
+                               batch_trace_id=batch_tid)
+        except Exception:
+            logger.debug("slow-query capture failed", exc_info=True)
+
+    def _slow(self, req: Request) -> Response:
+        """GET /slow.json — recent slow-query stage waterfalls
+        (?n=; obs/slowlog.py). Each entry's traceId resolves via
+        /traces.json?trace_id= to the full span tree."""
+        return Response(200, slow_response(req.params))
 
     def _reload(self, req: Request) -> Response:
         """Hot-swap to the latest COMPLETED instance (:337-358)."""
@@ -944,71 +992,44 @@ class EngineServer:
             out["xlaCache"] = cache_status()
         except Exception:
             logger.debug("aot stats unavailable", exc_info=True)
+        # runtime attribution (ISSUE 11): estimated device seconds per
+        # executable + occupancy — where the accelerator's time goes
+        try:
+            from predictionio_tpu.obs import costmon
+            out["deviceTime"] = costmon.device_snapshot()
+        except Exception:
+            logger.debug("device time stats unavailable",
+                         exc_info=True)
         return Response(200, out)
 
     def _profile(self, req: Request) -> Response:
-        """jax.profiler trace control — beyond-parity observability
-        (SURVEY.md §5 tracing). POST /profile.json {"action": "start",
-        "dir": "/tmp/trace"} | {"action": "stop"}.
-
-        Idempotent (ISSUE 2 satellite): a second start while tracing —
-        which used to raise out of jax.profiler.start_trace and 500 the
-        endpoint — reports the running trace instead, a stop without a
-        trace reports idle, and every response carries the current
-        state."""
-        import jax
-        d = req.json() or {}
-        action = d.get("action")
-        if action == "start":
-            with self._lock:
-                if self._profile_dir is not None:
-                    return Response(200, {
-                        "message": "already tracing",
-                        "tracing": True, "dir": self._profile_dir})
-                trace_dir = d.get("dir", "/tmp/pio_trace")
-                try:
-                    jax.profiler.start_trace(trace_dir)
-                except RuntimeError as e:
-                    # jax-level tracer already running (started outside
-                    # this endpoint): adopt it so a later stop can
-                    # actually stop it, and report instead of 500ing
-                    self._profile_dir = trace_dir
-                    return Response(200, {
-                        "message": f"profiler already active: {e}",
-                        "tracing": True, "dir": trace_dir})
-                self._profile_dir = trace_dir
-            return Response(200, {"message": "tracing", "tracing": True,
-                                  "dir": trace_dir})
-        if action == "stop":
-            with self._lock:
-                if self._profile_dir is None:
-                    return Response(200, {"message": "not tracing",
-                                          "tracing": False})
-                trace_dir = self._profile_dir
-                self._profile_dir = None
-                try:
-                    jax.profiler.stop_trace()
-                except RuntimeError as e:
-                    # adopted/raced trace already gone: still idle
-                    return Response(200, {
-                        "message": f"trace already stopped: {e}",
-                        "tracing": False, "dir": trace_dir})
-            return Response(200, {"message": "trace stopped",
-                                  "tracing": False, "dir": trace_dir})
-        with self._lock:
-            tracing = self._profile_dir is not None
-        return Response(400, {"message": "action must be start|stop",
-                              "tracing": tracing})
+        """``/profile.json`` — profiling surface (obs/profiler.py,
+        ISSUE 11): POST ``{"action": "start"|"stop"}`` toggles the
+        jax.profiler device trace with the ISSUE 2 idempotent
+        semantics (state machine now lives in obs/profiler so the
+        event server shares it); ``action=report`` (GET or POST)
+        returns the always-on sampling profiler's folded-stack
+        report."""
+        from predictionio_tpu.obs import profiler
+        status, body = profiler.profile_response_from_request(req)
+        return Response(status, body)
 
     def _metrics(self, req: Request) -> Response:
         """Prometheus text exposition, rendered solely by the shared
         metrics registry (ISSUE 2): this server's families (counters,
         quantile summary, query/batch-wait histograms, batcher and mesh
         collectors) plus the process-wide ones (JAX runtime, fold/train
-        instruments) through the parent chain."""
-        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
-        return Response(200, self.metrics.render(),
-                        content_type=CONTENT_TYPE)
+        instruments) through the parent chain. ``?exemplars=1`` (or an
+        OpenMetrics Accept header) switches to the exemplar-bearing
+        OpenMetrics exposition (ISSUE 11) — the default body stays
+        classic-parser safe."""
+        from predictionio_tpu.utils.prometheus import (
+            CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE, wants_exemplars)
+        om = wants_exemplars(req)
+        return Response(
+            200, self.metrics.render(exemplars=om),
+            content_type=OPENMETRICS_CONTENT_TYPE if om
+            else CONTENT_TYPE)
 
     def _traces(self, req: Request) -> Response:
         """GET /traces.json — recent span trees from the process-wide
@@ -1023,10 +1044,42 @@ class EngineServer:
     def _health(self, req: Request) -> Response:
         """GET /health.json — SLO verdicts with fast/slow burn rates
         (ISSUE 6): serve p99, fold-tick duration, model staleness and
-        the guarded-deploys event budget."""
-        return Response(200, health_response(self.slo, extra={
+        the guarded-deploys event budget. A latency SLO transitioning
+        into ``breached`` auto-captures an incident bundle (ISSUE 11):
+        the slow_queries + profiler providers put the top waterfalls
+        and the sampling profiler's stacks into it, so the p99
+        postmortem starts with evidence, not with reproduction."""
+        out = health_response(self.slo, extra={
             "modelVersion": self.model_version,
-            "publishDegraded": self.publish_degraded}))
+            "publishDegraded": self.publish_degraded})
+        try:
+            self._note_slo_breaches(out)
+        except Exception:
+            logger.debug("slo breach capture failed", exc_info=True)
+        return Response(200, out)
+
+    def _note_slo_breaches(self, health: dict):
+        """Fire one incident capture per ok->breached transition of a
+        latency SLO (the per-kind cooldown in IncidentManager bounds a
+        flapping SLO). State is per-server, in-memory — a restart
+        re-captures, which is the right bias for forensics."""
+        for s in health.get("slo", ()):
+            name, status = s.get("name"), s.get("status")
+            if name is None:
+                continue
+            prev = self._slo_status.get(name)
+            self._slo_status[name] = status
+            if status == "breached" and prev != "breached" \
+                    and s.get("kind") == "latency":
+                FLIGHT.record("slo_breach", slo=name,
+                              burnFast=s.get("burnFast"),
+                              burnSlow=s.get("burnSlow"))
+                get_incidents().capture(
+                    "slo_breach",
+                    f"latency SLO {name} breached "
+                    f"(burn fast/slow = {s.get('burnFast')}/"
+                    f"{s.get('burnSlow')})",
+                    context={"slo": s})
 
     def _build_router(self) -> Router:
         r = Router()
@@ -1042,11 +1095,18 @@ class EngineServer:
         r.add("GET", "/traces.json", self._traces)
         r.add("GET", "/flight.json", self._flight)
         r.add("GET", "/health.json", self._health)
+        r.add("GET", "/slow.json", self._slow)
         r.add("POST", "/profile.json", self._profile)
+        r.add("GET", "/profile.json", self._profile)
         return r
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, background: bool = True) -> "EngineServer":
+        # always-on sampling profiler (ISSUE 11; PIO_PROFILER=off to
+        # disable): server processes sample from first request on, so
+        # a p99 postmortem never starts with "restart with profiling"
+        from predictionio_tpu.obs import profiler
+        profiler.ensure_started()
         srv = HttpServer(self.router, self.config.ip, self.config.port)
         self.server = srv
         srv.start(background=background)

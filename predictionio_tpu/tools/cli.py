@@ -974,6 +974,55 @@ def cmd_cache(args) -> int:
     return 1
 
 
+def cmd_profile(args) -> int:
+    """`pio profile top` (ISSUE 11): the running server's always-on
+    sampling profiler, as a folded-stack top table — where the process
+    spends its Python time RIGHT NOW, no restart, no instrumentation
+    deploy. `pio profile trace {start,stop}` toggles the jax.profiler
+    device trace on the same endpoint."""
+    from predictionio_tpu.utils.http import fetch_json as _fetch_json
+    base = f"http://{args.ip}:{args.port}"
+    if args.profile_command == "top":
+        out = _fetch_json(
+            f"{base}/profile.json?action=report&top={args.n}")
+        if "error" in out:
+            _print(f"unreachable: {out['error']}")
+            return 1
+        _print(f"Sampling profiler at {base} "
+               f"(hz={out.get('hz')}, samples={out.get('samples')}, "
+               f"wall={out.get('wallS')}s, "
+               f"overhead={out.get('overheadPct')}%)")
+        stacks = out.get("topStacks") or []
+        if not stacks:
+            _print("  no samples yet (PIO_PROFILER=off, or the server "
+                   "just started)")
+            return 0
+        for s in stacks:
+            _print(f"  {s['pct']:6.2f}%  {s['count']:6d}  "
+                   f"{s['stack']}")
+        return 0
+    if args.profile_command == "trace":
+        import json as _json
+        import urllib.request
+        body = {"action": args.trace_action}
+        if args.trace_action == "start" and args.dir:
+            body["dir"] = args.dir
+        req = urllib.request.Request(
+            f"{base}/profile.json",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                _print(_json.dumps(_json.loads(resp.read()), indent=2))
+            return 0
+        except Exception as e:
+            _print(f"unreachable: {e}")
+            return 1
+    _print("profile command must be top|trace")
+    return 1
+
+
 def cmd_upgrade(args) -> int:
     """(Console upgrade / WorkflowUtils.checkUpgrade — the reference phones
     home for new versions; this build is offline, so upgrade is a no-op
@@ -1338,6 +1387,26 @@ def build_parser() -> argparse.ArgumentParser:
     ine.add_argument("--out", help="output path (default ./<id>.tar.gz)")
     ine.add_argument("--dir")
     inc.set_defaults(func=cmd_incidents)
+
+    pf = sub.add_parser(
+        "profile", help="runtime attribution (ISSUE 11): read the "
+        "running server's always-on sampling profiler, or toggle a "
+        "jax.profiler device trace")
+    pfsub = pf.add_subparsers(dest="profile_command", required=True)
+    pft = pfsub.add_parser("top")
+    pft.add_argument("-n", type=int, default=20,
+                     help="stacks to show (default 20)")
+    pft.add_argument("--ip", default="127.0.0.1")
+    pft.add_argument("--port", type=int, default=8000,
+                     help="server to read (engine 8000; the event "
+                          "server exposes the same endpoint behind "
+                          "--stats)")
+    pftr = pfsub.add_parser("trace")
+    pftr.add_argument("trace_action", choices=("start", "stop"))
+    pftr.add_argument("--dir", help="trace output dir (start only)")
+    pftr.add_argument("--ip", default="127.0.0.1")
+    pftr.add_argument("--port", type=int, default=8000)
+    pf.set_defaults(func=cmd_profile)
 
     fl = sub.add_parser(
         "faults", help="chaos-harness control: validate a PIO_FAULTS "

@@ -133,6 +133,22 @@ class Dashboard:
             f"<td>{t.get('durationMs')}</td>"
             f"<td>{len(t.get('links', []))}</td></tr>"
             for t in traces if isinstance(t, dict))
+        # slow-query waterfalls (ISSUE 11): the engine server's ring,
+        # each row a stage breakdown whose trace id is replayable via
+        # /traces.json?trace_id=
+        slow = self._fetch_json(
+            f"{cfg.engine_url}/slow.json?n=10").get("slow", [])
+        slow_rows = ""
+        for e in slow:
+            if not isinstance(e, dict):
+                continue
+            waterfall = " → ".join(
+                "{} {}ms".format(st.get("stage"), st.get("ms"))
+                for st in e.get("stages", ()))
+            slow_rows += (
+                f"<tr><td>{_html.escape(str(e.get('traceId', '')))}"
+                f"</td><td>{e.get('totalMs')}</td>"
+                f"<td>{_html.escape(waterfall)}</td></tr>")
         reg_rows = ""
         for name, val in sorted(get_registry().snapshot().items()):
             if isinstance(val, dict) and "count" in val:
@@ -152,6 +168,9 @@ class Dashboard:
 <h2>Slowest recent traces</h2>
 <table border=1><tr><th>kind</th><th>trace</th><th>ms</th>
 <th>links</th></tr>{trace_rows}</table>
+<h2>Slow-query waterfalls</h2>
+<table border=1><tr><th>trace</th><th>total ms</th>
+<th>stages</th></tr>{slow_rows}</table>
 <h2>This process's registry</h2>
 <table border=1>{reg_rows}</table>
 </body></html>"""

@@ -28,6 +28,15 @@ if "xla_force_host_platform_device_count" not in flags:
 # on, and the canary-warm acceptance tests opt back in.
 os.environ.setdefault("PIO_XLA_CACHE", "off")
 os.environ.setdefault("PIO_AOT_WARM", "off")
+# Likewise the ISSUE 11 runtime-attribution background work: the
+# always-on sampling profiler (a 19 Hz stack walker) and the slow-query
+# capture (every >250 ms request builds a waterfall — under a saturated
+# 2-core CI box MOST requests cross that) add load the suite's
+# timing-sensitive tests (hot-swap hammering, scheduler staleness
+# windows) must not absorb. Production servers keep both always-on;
+# the profiler/slowlog tests opt back in via monkeypatch.
+os.environ.setdefault("PIO_PROFILER", "off")
+os.environ.setdefault("PIO_SLOW_QUERY_MS", "1e9")
 
 import jax  # noqa: E402
 

@@ -103,6 +103,106 @@ class TestNoDuplicateRegistrations:
                                                  "different type")
 
 
+class TestExemplarConformance:
+    """ISSUE 11 satellite: OpenMetrics exemplars — rendered ONLY on
+    ``_bucket`` lines, correctly escaped, and never breaking the
+    line-oriented parse of a full scrape of either server."""
+
+    EXEMPLAR_RE = re.compile(
+        r'^\S+_bucket\{[^}]*\} \S+ '
+        r'# \{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+        r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\} '
+        r'[0-9.eE+-]+( [0-9.]+)?$')
+
+    @pytest.fixture(autouse=True)
+    def _seed_exemplars(self, registries):
+        """Observe inside a trace so both servers' scrapes actually
+        carry exemplar suffixes."""
+        from predictionio_tpu.obs.trace import TRACER
+        with TRACER.trace("lint_exemplar") as t:
+            t.discard = True
+            for reg in registries.values():
+                for name, mtype, _h, _s in reg.collect(
+                        include_parent=False):
+                    fam = reg.get(name)
+                    if isinstance(fam, Histogram) \
+                            and not fam.labelnames:
+                        fam.observe(0.003)
+
+    def test_exemplars_only_on_bucket_lines(self, registries):
+        for where, reg in registries.items():
+            for line in reg.render(exemplars=True).splitlines():
+                if " # {" not in line:
+                    continue
+                assert "_bucket{" in line, (
+                    f"{where}: exemplar on a non-bucket line: {line}")
+                assert self.EXEMPLAR_RE.match(line), (
+                    f"{where}: malformed exemplar: {line}")
+
+    def test_exemplars_present_after_traced_observe(self, registries):
+        scrape = registries["engine_server"].render(exemplars=True)
+        assert " # {" in scrape, "no exemplar landed in the scrape"
+        assert 'trace_id="' in scrape
+        # OpenMetrics bodies terminate with the EOF marker
+        assert scrape.rstrip("\n").endswith("# EOF")
+
+    def test_default_render_is_classic_parser_safe(self, registries):
+        """A stock 0.0.4 scraper must never see an exemplar suffix:
+        the default render drops them (and the EOF marker) even when
+        the histograms carry exemplars."""
+        for where, reg in registries.items():
+            scrape = reg.render()
+            assert " # {" not in scrape, (
+                f"{where}: exemplar leaked into the classic render")
+            assert "# EOF" not in scrape
+
+    def test_exemplar_escaping(self):
+        """A trace id carrying quote/backslash/newline must render
+        with the label-value escaping rules (same as sample labels)."""
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+        from predictionio_tpu.obs.trace import Tracer
+        tracer = Tracer()
+        reg = MetricsRegistry()
+        h = reg.histogram("lint_escape_seconds", "h")
+        evil = 'a"b\\c\nd'
+        import predictionio_tpu.obs.metrics as m
+        old = m._trace_id_fn
+        m._trace_id_fn = lambda: evil
+        try:
+            h.observe(0.003)
+        finally:
+            m._trace_id_fn = old
+        line = next(l for l in reg.render(exemplars=True).splitlines()
+                    if " # {" in l)
+        assert '\\"' in line and "\\\\" in line and "\\n" in line
+        assert "\n" not in line
+        _ = tracer  # silence unused
+
+    def test_scrape_still_line_parseable(self, registries):
+        """Every non-comment line still splits into
+        name{labels} value [exemplar] — the minimal property any
+        Prometheus/OpenMetrics scraper relies on."""
+        sample_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+naif-]+"
+            r"( # \{.*\} \S+( \S+)?)?$")
+        for where, reg in registries.items():
+            for exemplars in (False, True):
+                for line in reg.render(exemplars=exemplars).splitlines():
+                    if not line or line.startswith("#"):
+                        continue
+                    assert sample_re.match(line), f"{where}: {line!r}"
+
+    def test_stats_histogram_block_carries_exemplars(self, registries):
+        """The /stats.json histogram view names the same trace ids the
+        scrape exposes."""
+        fam = registries["engine_server"].get("pio_engine_query_seconds")
+        assert isinstance(fam, Histogram)
+        snap = fam.snapshot()
+        assert "exemplars" in snap
+        for le, ex in snap["exemplars"].items():
+            assert set(ex) >= {"traceId", "value"}
+
+
 class TestIssue6FamiliesPresent:
     """The diagnostics plane's own families ride both scrapes."""
 

@@ -95,3 +95,68 @@ def test_whole_trace_under_budget():
                 pass
 
     assert _best_us(run, 5_000) < 200.0
+
+
+# -- ISSUE 11: exemplar + device-time attribution hot paths ---------------
+
+def test_histogram_observe_with_exemplar_under_budget():
+    """Exemplar recording (observe inside an active trace: one
+    contextvar read + a tuple store under the existing lock) must stay
+    in the same budget class as a plain observe."""
+    from predictionio_tpu.obs.trace import TRACER
+    h = MetricsRegistry().histogram("g_ex_seconds", "h")
+
+    def run(n):
+        with TRACER.trace("t") as t:
+            t.discard = True
+            for _ in range(n):
+                h.observe(0.003)
+
+    assert _best_us(run, 50_000) < 15.0
+    assert h.exemplars()   # the exemplar actually landed
+
+
+def test_device_timed_unsampled_path_under_budget():
+    """The 1-in-N sampled sync must leave the OTHER N-1 dispatches
+    cheap: two perf_counter reads, a dict get, an atomic tick and one
+    cached-child inc. Measured with the sync disabled so only the
+    unsampled path is priced."""
+    from predictionio_tpu.obs import costmon
+
+    st = costmon._device_state("overhead_probe")
+    st.every = 0          # no syncs: pure unsampled path
+
+    def fn():
+        return None
+
+    def run(n):
+        for _ in range(n):
+            costmon.device_timed("overhead_probe", fn)
+
+    assert _best_us(run, 50_000) < 15.0
+
+
+def test_device_timed_sync_sampling_is_exactly_one_in_n():
+    """The sync path is BOUNDED: exactly ceil(n/N) dispatches pay the
+    block_until_ready (first included), the rest never touch jax."""
+    from predictionio_tpu.obs import costmon
+
+    label = "sampling_probe"
+    st = costmon._device_state(label)
+    st.every = 8
+    synced_before = sum(
+        v for lab, v in costmon.get_registry().get(
+            "pio_device_syncs_total").samples()
+        if lab and lab.get("executable") == label) \
+        if costmon.get_registry().get("pio_device_syncs_total") else 0
+
+    for _ in range(33):
+        costmon.device_timed(label, lambda: 1.0)
+
+    fam = costmon.get_registry().get("pio_device_syncs_total")
+    synced = sum(v for lab, v in fam.samples()
+                 if lab and lab.get("executable") == label)
+    # ticks 0,8,16,24,32 -> 5 syncs for the 33 dispatches
+    assert synced - synced_before == 5
+    # sampled walls banked for percentile views
+    assert costmon.device_time_percentiles(label)["samples"] >= 5
