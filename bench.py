@@ -1136,6 +1136,78 @@ print(json.dumps({
 '''
 
 
+def bench_sharded(full_scale: bool):
+    """Sharded online plane (ISSUE 12, schema-additive): fold-tick and
+    serve cost with the factor tables model-sharded across every local
+    device, next to the replicated numbers the rest of the artifact
+    carries. Emits ``fold_tick_p50_ms_sharded`` (steady-state sharded
+    fold_in_coo wall), ``serve_p50_ms_sharded`` (batched sharded top-k
+    wall), ``hbm_table_bytes_per_shard`` (per-device bytes of the
+    resident tables — ~1/N of the replicated footprint) and
+    ``fold_h2d_bytes_sharded`` (tick-2 uploads: touched-row plans
+    only, the no-full-table-round-trip claim as a number). Skips —
+    emitting nothing — on a single-device backend."""
+    import jax
+
+    from predictionio_tpu.obs import jaxmon
+    from predictionio_tpu.online.fold_in import FoldInConfig, fold_in_coo
+    from predictionio_tpu.ops.als import (ALSConfig, als_train,
+                                          users_topk_serve)
+    from predictionio_tpu.ops.ratings import RatingsCOO
+    from predictionio_tpu.parallel.mesh import model_mesh
+    from predictionio_tpu.utils import device_cache
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {}
+    n_users = 20_000 if full_scale else 2_000
+    n_items = 50_000 if full_scale else 8_000
+    rank = 32 if full_scale else 16
+    nnz = 400_000 if full_scale else 60_000
+    rng = np.random.default_rng(101)
+    coo = RatingsCOO(rng.integers(0, n_users, nnz),
+                     rng.integers(0, n_items, nnz),
+                     rng.uniform(1, 5, nnz).astype(np.float32),
+                     n_users, n_items)
+    mesh = model_mesh(n_dev)
+    model = als_train(coo, ALSConfig(rank=rank, iterations=2, seed=5,
+                                     factor_sharding="model",
+                                     keep_sharded=True), mesh=mesh)
+    cfg = FoldInConfig(sweeps=1, factor_sharding="model")
+    touched = max(8, n_users // 100)
+    walls, h2ds = [], []
+    cur = model
+    for tick in range(4):
+        tu = rng.integers(0, n_users, touched)
+        ti = rng.integers(0, n_items, touched)
+        h0 = jaxmon.thread_h2d_total()
+        t0 = time.perf_counter()
+        cur, st = fold_in_coo(cur, coo, tu, ti, cfg,
+                              resident_key="bench_sharded")
+        walls.append((time.perf_counter() - t0) * 1000)
+        h2ds.append(jaxmon.h2d_delta(h0))
+    out = {
+        "fold_tick_p50_ms_sharded": round(float(np.median(walls[1:])),
+                                          2),
+        "fold_h2d_bytes_sharded": int(h2ds[1]),
+        "sharded_n_shards": n_dev,
+    }
+    sizes = device_cache.resident_sizes()
+    if "bench_sharded" in sizes:
+        out["hbm_table_bytes_per_shard"] = int(sizes["bench_sharded"])
+    users = list(rng.integers(0, n_users, 16))
+    users_topk_serve(cur, users, 10)   # warm the serve bucket
+    serve_walls = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        users_topk_serve(cur, users, 10)
+        serve_walls.append((time.perf_counter() - t0) * 1000)
+    out["serve_p50_ms_sharded"] = round(float(np.median(serve_walls)),
+                                        3)
+    device_cache.drop_resident("bench_sharded")
+    return out
+
+
 def bench_cold_start(full_scale: bool):
     """Cold-start economics (ISSUE 9, schema-additive): two fresh
     processes sharing one persistent-cache dir measure the
@@ -1754,6 +1826,13 @@ def main():
         # trajectory finally covers the online path (schema-additive)
         _beat("bench_fold_tick")
         fold_stats = bench_fold_tick(full_scale)
+    sharded_stats = {}
+    if not os.environ.get("PIO_BENCH_SKIP_SHARDED"):
+        # sharded online plane (ISSUE 12): model-sharded fold/serve
+        # rows next to the replicated ones (schema-additive; no-op on
+        # a single-device backend)
+        _beat("bench_sharded")
+        sharded_stats = bench_sharded(full_scale)
     coldstart_stats = {}
     if not os.environ.get("PIO_BENCH_SKIP_COLDSTART"):
         # compile plane (ISSUE 9): cold-vs-warm-process deploy-to-
@@ -1761,7 +1840,7 @@ def main():
         _beat("bench_cold_start")
         coldstart_stats = bench_cold_start(full_scale)
     _beat("assemble_output", **ingest_stats, **fold_stats,
-          **coldstart_stats)
+          **sharded_stats, **coldstart_stats)
     value = als_stats["ratings_per_sec_per_chip"]
     out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
@@ -1777,6 +1856,7 @@ def main():
         **baseline_stats,
         **ingest_stats,
         **fold_stats,
+        **sharded_stats,
         **coldstart_stats,
     }
     if baseline_stats:

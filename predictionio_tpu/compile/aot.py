@@ -362,6 +362,24 @@ def shared_jit(key: str, impl: Callable, **jit_kwargs):
     return get_aot().shared_jit(key, impl, **jit_kwargs)
 
 
+def sharded_aval(shape, dtype, *axes, mesh=None):
+    """A ``ShapeDtypeStruct`` carrying a ``NamedSharding`` over the
+    (current) mesh — the sharding-aware aval sharded spec builders
+    lower with, so the bucket ladder and swap-time warmup cover the
+    model-sharded serve executables exactly like the replicated ones
+    (an aval without a sharding would lower a single-device program
+    and the held executable would reject every sharded argument).
+    ``axes`` is the per-dim mesh axis name (or None), e.g.
+    ``sharded_aval((i, r), np.float32, "model", None)``."""
+    import jax
+    from predictionio_tpu.parallel.mesh import current_mesh
+    ctx = mesh or current_mesh()
+    return jax.ShapeDtypeStruct(
+        shape, dtype,
+        sharding=jax.sharding.NamedSharding(
+            ctx.mesh, jax.sharding.PartitionSpec(*axes)))
+
+
 def warm_enabled() -> bool:
     """Deploy/swap-time warming can be disabled separately from AOT
     dispatch (``PIO_AOT_WARM=off``): dispatch + background adoption

@@ -46,6 +46,17 @@ def bucket_batch(n: int, floor: int = BATCH_FLOOR) -> int:
     return max(int(floor), _next_pow2(max(int(n), 1)))
 
 
+def bucket_rows_sharded(n: int, shards: int,
+                        floor: int = ROWS_FLOOR) -> int:
+    """Row bucket for a model-axis-sharded table: the pow2 bucket
+    rounded up to a multiple of the shard count, so every shard gets
+    an equal contiguous row slice (pow2 shard counts divide pow2
+    buckets for free; a 3-way mesh axis still gets a legal layout)."""
+    b = bucket_rows(n, floor=floor)
+    s = max(int(shards), 1)
+    return ((b + s - 1) // s) * s
+
+
 def occupancy(n: int, bucket: int) -> float:
     """How full ``bucket`` is at current size ``n`` (0..1]."""
     return float(n) / float(bucket) if bucket else 1.0

@@ -94,6 +94,8 @@ def _factor_tables(model) -> Dict[str, np.ndarray]:
         if isinstance(v, np.ndarray) and v.ndim == 2 \
                 and np.issubdtype(v.dtype, np.floating):
             out[k] = v
+        elif _is_sharded(v):
+            out[k] = v
     return out
 
 
@@ -109,7 +111,32 @@ def _score_pair(tables: Dict[str, np.ndarray]
     return u, v
 
 
-def _max_row_norm(t: np.ndarray) -> float:
+def _is_sharded(t) -> bool:
+    from predictionio_tpu.parallel.sharded_table import is_sharded
+    return is_sharded(t)
+
+
+def _all_finite(t) -> bool:
+    """Finiteness over either layout: per-shard host-mirror scans for
+    a ShardedTable (no device involved — the gates must not force a
+    cross-shard gather), plain numpy otherwise."""
+    if _is_sharded(t):
+        return t.all_finite()
+    return bool(np.isfinite(t).all())
+
+
+def _probe_rows(t, idx) -> np.ndarray:
+    """Sampled rows for the score-distribution probe: host shard
+    mirrors for sharded tables, fancy indexing for numpy — the gates
+    run the same statistics over both layouts (no silent gate bypass
+    for sharded models)."""
+    from predictionio_tpu.parallel.sharded_table import table_rows
+    return table_rows(t, idx)
+
+
+def _max_row_norm(t) -> float:
+    if _is_sharded(t):
+        return t.max_row_norm()
     if t.size == 0:
         return 0.0
     with np.errstate(over="ignore", invalid="ignore"):
@@ -174,7 +201,7 @@ class QualityGatekeeper:
     # -- individual gates ---------------------------------------------------
     def _gate_finite(self, cand_tables: Dict[str, np.ndarray]) -> dict:
         bad = [name for name, t in cand_tables.items()
-               if t.size and not np.isfinite(t).all()]
+               if t.size and not _all_finite(t)]
         if not cand_tables:
             return {"gate": "finite", "verdict": "skip",
                     "detail": "no factor tables"}
@@ -228,8 +255,8 @@ class QualityGatekeeper:
         iv = rng.choice(ni, size=min(cfg.sample_entities, ni),
                         replace=False)
         with np.errstate(over="ignore", invalid="ignore"):
-            s_live = lu[iu] @ lv[iv].T
-            s_cand = cu[iu] @ cv[iv].T
+            s_live = _probe_rows(lu, iu) @ _probe_rows(lv, iv).T
+            s_cand = _probe_rows(cu, iu) @ _probe_rows(cv, iv).T
         if not np.isfinite(s_cand).all():
             return {"gate": "score_drift", "verdict": "fail",
                     "detail": "candidate probe scores non-finite"}

@@ -268,6 +268,12 @@ class ALSAlgorithmParams(Params):
     # sweep_chunk / fuse_iteration; 0 = auto)
     sweep_chunk: int = 0
     fuse_iteration: bool = False
+    # sharded online plane (ISSUE 12): 'model' trains, folds AND
+    # serves the factor tables row-sharded over the mesh model axis
+    # (ShardedTable handles end to end) — the configuration for
+    # vocabularies whose table bytes exceed one device's budget.
+    # 'replicated' (default) keeps the single-device-table layout.
+    factor_sharding: str = "replicated"
 
 
 @dataclass
@@ -339,17 +345,28 @@ class ALSAlgorithm(P2LAlgorithm):
         if pd.ratings_coo.nnz == 0:
             raise ValueError("No ratings to train on")
         from predictionio_tpu.ops.als import default_compute_dtype
+        sharded = getattr(p, "factor_sharding", "replicated") == "model"
+        mesh = None
+        if sharded:
+            # the process-wide model mesh: fold ticks and server
+            # threads resolve the same one for this shard count
+            from predictionio_tpu.parallel.mesh import model_mesh
+            import jax
+            mesh = model_mesh(len(jax.devices()))
         cfg = ALSConfig(rank=p.rank, iterations=p.num_iterations, lam=p.lam,
                         sweep_chunk=p.sweep_chunk,
                         fuse_iteration=p.fuse_iteration,
                         seed=p.seed if p.seed is not None else 0,
                         compute_dtype=p.compute_dtype
-                        or default_compute_dtype())
+                        or default_compute_dtype(),
+                        factor_sharding=("model" if sharded
+                                         else "replicated"),
+                        keep_sharded=sharded)
         # per-phase timing of the train that just ran (plan/upload/iters/
         # fetch) — consumed by bench.py's product-path mode; the hard
         # syncs it adds are negligible next to a real train
         self.last_train_telemetry = {}
-        model = als_train(pd.ratings_coo, cfg,
+        model = als_train(pd.ratings_coo, cfg, mesh=mesh,
                           telemetry=self.last_train_telemetry)
         item_properties = None
         if pd.items is not None:
@@ -368,7 +385,20 @@ class ALSAlgorithm(P2LAlgorithm):
             return ItemScoreResult(())
         props_of = model.properties_of(self.params.return_properties)
         mask = model.allowed_mask(query)
+        from predictionio_tpu.parallel.sharded_table import (is_sharded,
+                                                             table_rows)
         if mask is None:
+            if is_sharded(model.als.item_factors):
+                # sharded single-query route: the same per-shard
+                # top-k + merge executables the batched path runs
+                from predictionio_tpu.ops.als import users_topk_serve
+                from predictionio_tpu.ops.similarity import \
+                    unpack_top_k_rows
+                scores, idx = users_topk_serve(model.als, [int(uix)],
+                                               query.num)
+                s, i = unpack_top_k_rows(scores[0], idx[0], query.num)
+                return top_scores_to_result(model.item_ix, s, i,
+                                            properties_of=props_of)
             scores, idx = recommend_products(model.als, int(uix), query.num)
             return top_scores_to_result(model.item_ix, scores, idx,
                                         properties_of=props_of)
@@ -378,7 +408,7 @@ class ALSAlgorithm(P2LAlgorithm):
                                                      unpack_top_k_rows)
         scores, idx = masked_top_k_batch(
             model.als.item_factors,
-            model.als.user_factors[int(uix)][None], mask[None],
+            table_rows(model.als.user_factors, [int(uix)]), mask[None],
             query.num, filter_positive=False)
         s, i = unpack_top_k_rows(scores[0], idx[0], query.num)
         return top_scores_to_result(model.item_ix, s, i,
@@ -439,10 +469,13 @@ class ALSAlgorithm(P2LAlgorithm):
         tu = user_ix.to_indices([str(u) for u in touched_users])
         ti = item_ix.to_indices([str(i) for i in touched_items])
         from predictionio_tpu.ops.als import default_compute_dtype
+        from predictionio_tpu.parallel.sharded_table import is_sharded
+        sharded = is_sharded(model.als.user_factors)
         cfg = FoldInConfig(
             lam=p.lam, sweeps=2,
             compute_dtype=p.compute_dtype or default_compute_dtype(),
-            sweep_chunk=p.sweep_chunk)
+            sweep_chunk=p.sweep_chunk,
+            factor_sharding="model" if sharded else "replicated")
         # residency slot per deployed algorithm instance: consecutive
         # ticks through the same scheduler reuse the device tables and
         # upload only touched-row plans (fold_in_coo validates the slot
@@ -478,6 +511,10 @@ class ALSAlgorithm(P2LAlgorithm):
             "sentinelRollback": stats.sentinel_rollback,
             "guardWallS": stats.guard_wall_s,
         }
+        if stats.sharded:
+            report["sharding"] = {
+                "layout": "model",
+                "shards": new_als.user_factors.n_shards}
         return new_model, report
 
     # -- compile plane (ISSUE 9) -------------------------------------------
@@ -539,11 +576,12 @@ class ALSAlgorithm(P2LAlgorithm):
         if masked:
             from predictionio_tpu.ops.similarity import (masked_top_k_batch,
                                                          unpack_top_k_rows)
+            from predictionio_tpu.parallel.sharded_table import table_rows
             k_max = max(q.num for _, q, _, _ in masked)
             scores, idx = masked_top_k_batch(
                 model.als.item_factors,
-                np.stack([model.als.user_factors[uix]
-                          for _, _, uix, _ in masked]),
+                table_rows(model.als.user_factors,
+                           [uix for _, _, uix, _ in masked]),
                 np.stack([mask for _, _, _, mask in masked]),
                 k_max, filter_positive=False)
             for row, (ix, q, _, _) in enumerate(masked):
@@ -567,13 +605,18 @@ class ShardedALSModelCheckpoint(PersistentModel, PersistentModelLoader):
 
     def save(self, instance_id: str, params) -> bool:
         import os
+        from predictionio_tpu.parallel.sharded_table import is_sharded
         from predictionio_tpu.utils.checkpoint import (checkpoint_dir,
                                                        save_sharded)
+
+        def _np(t):
+            return t.to_numpy() if is_sharded(t) else t
+
         d = checkpoint_dir(instance_id)
         ok = save_sharded(
             os.path.join(d, "factors"),
-            {"user_factors": self.model.als.user_factors,
-             "item_factors": self.model.als.item_factors})
+            {"user_factors": _np(self.model.als.user_factors),
+             "item_factors": _np(self.model.als.item_factors)})
         np.savez(os.path.join(d, "vocab.npz"),
                  users=np.asarray(self.model.user_ix._ids, dtype=str),
                  items=np.asarray(self.model.item_ix._ids, dtype=str))

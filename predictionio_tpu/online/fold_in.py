@@ -89,6 +89,16 @@ class FoldInConfig:
     # zero rows for a brand-new item, so its solution is refined once the
     # item side has produced a real row.
     sweeps: int = 1
+    # sharded online plane (ISSUE 12): 'model' keeps the factor
+    # tables device-resident under a NamedSharding over the mesh model
+    # axis for the whole tick — solves gather touched counterpart rows
+    # cross-shard (GSPMD collectives over ICI), solved rows scatter
+    # back to their owning shard on-device, and the publish patches
+    # only the touched rows into the per-shard host mirrors. The
+    # layout is normally inferred from the model's tables
+    # (ShardedTable -> 'model'); the config field records intent and
+    # lets parity harnesses force a layout.
+    factor_sharding: str = "replicated"
     # numerical sentinels (ISSUE 5): after each side's solve, the
     # touched rows are checked on-device for finiteness and norm
     # explosion (> max(floor, ratio * incumbent max row norm)). A breach
@@ -112,6 +122,9 @@ class FoldInStats:
     nnz_item_side: int = 0
     sweeps: int = 0
     wall_s: float = 0.0
+    # ISSUE 12: the tick ran the model-sharded layout (tables resident
+    # under a model-axis NamedSharding; publish patched host mirrors)
+    sharded: bool = False
     # True when the tick reused device-resident tables from the previous
     # tick (no full-table upload happened)
     resident_hit: bool = False
@@ -215,6 +228,60 @@ def _grown_dev(table, n_new: int):
 def _record_h2d(nbytes: int):
     from predictionio_tpu.obs import jaxmon
     jaxmon.record_h2d(int(nbytes))
+
+
+# -- sharded-layout helpers (ISSUE 12) --------------------------------------
+
+def _take_rows_impl(table, idx):
+    return table[idx]
+
+
+def _sharded_jit(name: str, impl, mesh: MeshContext, out_shardings):
+    """Per-mesh shared jit for the sharded tick's scatter/gather
+    programs: the explicit ``out_shardings`` pin the updated table to
+    its model-axis layout (GSPMD propagation alone may re-replicate a
+    scatter output), and the AOT-adopt key includes the mesh so two
+    meshes never share a latched sharding."""
+    import jax
+    from predictionio_tpu.compile.aot import get_aot
+    key = (f"fold.{name}.sharded:{id(mesh.mesh)}:"
+           f"{mesh.model_parallelism}")
+    return get_aot().adopt(key, jax.jit(impl,
+                                        out_shardings=out_shardings))
+
+
+def _pad_pow2_idx(idx: np.ndarray) -> np.ndarray:
+    """Pad a row-index vector to its compile-plane row bucket (floored
+    at the touched-row floor so tiny ticks share ONE gather program —
+    bare pow2 would mint classes 1/2/4/8 and recompile across steady
+    ticks) by repeating its first entry (duplicate fetches are
+    harmless)."""
+    from predictionio_tpu.compile.buckets import bucket_rows
+    n = int(idx.size)
+    m = bucket_rows(n, floor=_TOUCHED_FLOOR)
+    if m == n:
+        return idx
+    out = np.empty(m, dtype=np.int32)
+    out[:n] = idx
+    out[n:] = idx[0] if n else 0
+    return out
+
+
+def _fetch_rows(table_dev, idx: np.ndarray, mesh: MeshContext
+                ) -> np.ndarray:
+    """Device->host fetch of the touched rows only — the ONLY d2h a
+    steady-state sharded tick pays (the publish patches these into the
+    host shard mirrors; the table itself never crosses the link)."""
+    from predictionio_tpu.obs import jaxmon
+    if idx.size == 0:
+        return np.zeros((0, table_dev.shape[1]), dtype=np.float32)
+    padded = _pad_pow2_idx(np.asarray(idx, dtype=np.int32))
+    take = _sharded_jit("take_rows", _take_rows_impl, mesh,
+                        mesh.replicated())
+    rows = np.asarray(host_fetch(take(table_dev, padded)),
+                      dtype=np.float32)[:idx.size]
+    jaxmon.record_d2h(rows.nbytes)
+    return rows
 
 
 def solve_rows(counter_factors: np.ndarray,
@@ -406,12 +473,19 @@ def _prep_side(owner_idx: np.ndarray, counter_idx: np.ndarray,
 
 def _solve_side(prep: _SidePrep, counter_dev, counter_gram, out_dev,
                 out_gram, als_cfg: ALSConfig, cfg: FoldInConfig,
-                mesh: MeshContext, rank: int):
+                mesh: MeshContext, rank: int, sharded: bool = False):
     """One side of one sweep, entirely on device: solve the touched rows
     against the resident counterpart table, scatter them into the
     resident owned table, and (implicit) apply the rank-k Gram
     correction for the rows that moved. Returns the updated
-    (out_dev, out_gram)."""
+    (out_dev, out_gram).
+
+    Sharded layout: the counterpart gathers and the scatter run
+    against model-axis-sharded tables — GSPMD inserts the cross-shard
+    row gathers (O(touched) rows over ICI, never a table gather), and
+    the scatter's explicit ``out_shardings`` keeps the updated table
+    on its owning shards (the ``.at[].set(mode="drop")`` OOB-sentinel
+    padding convention is layout-independent)."""
     from predictionio_tpu.obs import costmon
     zeros = mesh.put_replicated(
         np.zeros((prep.n_rows + 1, rank), dtype=np.float32))
@@ -424,12 +498,18 @@ def _solve_side(prep: _SidePrep, counter_dev, counter_gram, out_dev,
             costmon.FOLD_SIDE, _run_side, prep.groups, zeros,
             counter_dev, als_cfg,
             _solver_gram(counter_gram, cfg.dual_solve == "auto"))
+    if sharded:
+        scatter = _sharded_jit("scatter", _scatter_impl, mesh,
+                               mesh.model_sharded(2))
+        scatter_gram = _sharded_jit(
+            "scatter_gram", _scatter_gram_impl, mesh,
+            (mesh.model_sharded(2), mesh.replicated()))
+    else:
+        scatter = _jitted("scatter", _scatter_impl)
+        scatter_gram = _jitted("scatter_gram", _scatter_gram_impl)
     if out_gram is None:
-        out_dev = _jitted("scatter", _scatter_impl)(
-            out_dev, solved, prep.src, prep.dst)
-        return out_dev, None
-    return _jitted("scatter_gram", _scatter_gram_impl)(
-        out_dev, out_gram, solved, prep.src, prep.dst)
+        return scatter(out_dev, solved, prep.src, prep.dst), None
+    return scatter_gram(out_dev, out_gram, solved, prep.src, prep.dst)
 
 
 def fold_in_coo(als: ALSModel, coo: RatingsCOO,
@@ -456,7 +536,21 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
     in-place on device and the tick uploads only its solve plans.
     """
     t0 = time.perf_counter()
+    from predictionio_tpu.parallel.sharded_table import is_sharded, \
+        layout_of
+    sharded = is_sharded(als.user_factors)
+    if sharded != is_sharded(als.item_factors):
+        raise ValueError(
+            "fold_in_coo needs both factor tables in the same layout; "
+            f"got user={type(als.user_factors).__name__} "
+            f"item={type(als.item_factors).__name__}")
+    if mesh is None and sharded:
+        # serve/fold threads must resolve the SAME mesh for a given
+        # shard count (current_mesh is thread-local)
+        from predictionio_tpu.parallel.mesh import model_mesh
+        mesh = model_mesh(als.user_factors.n_shards)
     mesh = mesh or current_mesh()
+    layout_token = layout_of(als.user_factors)
     rank = als.rank
     n_users = max(coo.n_users, als.n_users)
     n_items = max(coo.n_items, als.n_items)
@@ -505,23 +599,64 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
     # buckets, so vocabulary growth INSIDE a bucket re-uses every traced
     # program (and, with residency, the device arrays themselves);
     # promotion to the next bucket is one predictable re-pad + compile
-    from predictionio_tpu.compile.buckets import bucket_rows
-    n_users_b = bucket_rows(n_users)
-    n_items_b = bucket_rows(n_items)
+    from predictionio_tpu.compile.buckets import (bucket_rows,
+                                                  bucket_rows_sharded)
+    U_tab = V_tab = None
+    if sharded:
+        stats.sharded = True
+        mp = mesh.model_parallelism
+        U_tab, V_tab = als.user_factors, als.item_factors
+        n_users_b = max(bucket_rows_sharded(n_users, mp),
+                        U_tab.padded_rows)
+        n_items_b = max(bucket_rows_sharded(n_items, mp),
+                        V_tab.padded_rows)
+        # bucket promotion: the one O(table) host reshuffle + upload,
+        # paid per 2x vocabulary growth (steady-state ticks never
+        # enter these branches)
+        if n_users_b > U_tab.padded_rows:
+            U_tab = U_tab.grown(als.n_users, n_users_b)
+        if n_items_b > V_tab.padded_rows:
+            V_tab = V_tab.grown(als.n_items, n_items_b)
+    else:
+        n_users_b = bucket_rows(n_users)
+        n_items_b = bucket_rows(n_items)
     payload = device_cache.get_resident(
-        resident_key, (als.user_factors, als.item_factors)) \
-        if resident_key else None
+        resident_key, (als.user_factors, als.item_factors),
+        sharding=layout_token) if resident_key else None
     if payload is not None and payload.get("mesh") is mesh \
-            and payload.get("implicit") == implicit:
-        U_dev = _grown_dev(payload["U"], n_users_b)
-        V_dev = _grown_dev(payload["V"], n_items_b)
+            and payload.get("implicit") == implicit \
+            and (not sharded
+                 or (payload["U"].shape[0] == n_users_b
+                     and payload["V"].shape[0] == n_items_b)):
+        U_dev = payload["U"] if sharded \
+            else _grown_dev(payload["U"], n_users_b)
+        V_dev = payload["V"] if sharded \
+            else _grown_dev(payload["V"], n_items_b)
         # appended zero rows contribute nothing to a Gram: carry it
         gram_u, gram_v = payload.get("GU"), payload.get("GV")
         incr = int(payload.get("incr", 0))
         stats.resident_hit = True
+    elif sharded:
+        # residency miss: the tables' own attached device handles are
+        # the second-chance fast path (a just-trained or just-swapped
+        # ShardedTable arrives with its arrays still resident); only a
+        # genuinely cold table uploads — per-shard slices, budget-
+        # checked at 1/N of the table
+        U_dev = U_tab.device(mesh)
+        V_dev = V_tab.device(mesh)
+        gram_u = gram_v = None
+        incr = 0
     else:
         U_host = _grown_table(als.user_factors, n_users_b)
         V_host = _grown_table(als.item_factors, n_items_b)
+        # the enforced per-device budget (ISSUE 12): a replicated fold
+        # costs each device the FULL table — refuse loudly instead of
+        # silently overcommitting HBM (factor_sharding='model' is the
+        # supported path past the budget)
+        device_cache.check_table_budget(U_host.nbytes,
+                                        table="fold user table")
+        device_cache.check_table_budget(V_host.nbytes,
+                                        table="fold item table")
         U_dev = mesh.put_replicated(U_host)
         V_dev = mesh.put_replicated(V_host)
         _record_h2d(U_host.nbytes + V_host.nbytes)
@@ -545,7 +680,12 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         # ticks stay O(touched)
         baseline = getattr(als, "_pio_guard_norm", None)
         if baseline is None:
-            baseline = host_max_norm(als.user_factors, als.item_factors)
+            if sharded:
+                baseline = max(als.user_factors.max_row_norm(),
+                               als.item_factors.max_row_norm())
+            else:
+                baseline = host_max_norm(als.user_factors,
+                                         als.item_factors)
         sentinel = SweepSentinel(
             "fold_in", baseline,
             norm_ratio=cfg.sentinel_norm_ratio,
@@ -566,7 +706,8 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         if prep_u is not None:
             U_dev, gram_u = _solve_side(
                 prep_u, V_dev, gram_v if implicit else None, U_dev,
-                gram_u if implicit else None, als_cfg, cfg, mesh, rank)
+                gram_u if implicit else None, als_cfg, cfg, mesh, rank,
+                sharded=sharded)
             stats.n_user_rows += len(prep_u.dst_real)
             stats.nnz_user_side += prep_u.nnz
             if sentinel is not None:
@@ -577,7 +718,8 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         if prep_i is not None:
             V_dev, gram_v = _solve_side(
                 prep_i, U_dev, gram_u if implicit else None, V_dev,
-                gram_v if implicit else None, als_cfg, cfg, mesh, rank)
+                gram_v if implicit else None, als_cfg, cfg, mesh, rank,
+                sharded=sharded)
             stats.n_item_rows += len(prep_i.dst_real)
             stats.nnz_item_side += prep_i.nnz
             if sentinel is not None:
@@ -599,6 +741,42 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         U_dev, V_dev, gram_u, gram_v = ckpt
         stats.sentinel_rollback = True
 
+    if sharded:
+        # sharded publish (ISSUE 12): ONLY the touched rows cross the
+        # device->host link; they are patched copy-on-write into the
+        # per-shard host mirrors, and the tick's final device arrays
+        # ride along as the resident fast path — the table as a whole
+        # never moves, which is exactly what the over-budget scenario
+        # asserts via pio_fold_upload_bytes_total
+        idx_u = prep_u.dst_real if prep_u is not None \
+            else np.zeros(0, dtype=np.int32)
+        idx_v = prep_i.dst_real if prep_i is not None \
+            else np.zeros(0, dtype=np.int32)
+        rows_u = _fetch_rows(U_dev, idx_u, mesh)
+        rows_v = _fetch_rows(V_dev, idx_v, mesh)
+        # chaos opt-in: `fold.factors:corrupt=P` — poisons the patched
+        # rows, so the host mirrors the gates probe see the corruption
+        rows_u, cu = maybe_corrupt_array("fold.factors", rows_u)
+        rows_v, cv = maybe_corrupt_array("fold.factors", rows_v)
+        U_out = U_tab.with_rows(idx_u, rows_u, n_rows=n_users)
+        V_out = V_tab.with_rows(idx_v, rows_v, n_rows=n_items)
+        if not (cu or cv):
+            U_out.attach_device(U_dev)
+            V_out.attach_device(V_dev)
+            if resident_key:
+                device_cache.put_resident(
+                    resident_key, (U_out, V_out),
+                    {"U": U_dev, "V": V_dev, "GU": gram_u,
+                     "GV": gram_v, "mesh": mesh, "implicit": implicit,
+                     "incr": incr + 1},
+                    sharding=layout_token)
+        stats.wall_s = time.perf_counter() - t0
+        out = ALSModel(user_factors=U_out, item_factors=V_out,
+                       rank=rank)
+        if sentinel is not None and not (cu or cv):
+            out._pio_guard_norm = sentinel.observed_max
+        return out, stats
+
     # slice the vocab-bucket padding back off: published models carry
     # exact-sized host tables (the padding is a device-residency shape
     # contract, not part of the model)
@@ -615,7 +793,8 @@ def fold_in_coo(als: ALSModel, coo: RatingsCOO,
         device_cache.put_resident(
             resident_key, (U_host, V_host),
             {"U": U_dev, "V": V_dev, "GU": gram_u, "GV": gram_v,
-             "mesh": mesh, "implicit": implicit, "incr": incr + 1})
+             "mesh": mesh, "implicit": implicit, "incr": incr + 1},
+            sharding=layout_token)
     stats.wall_s = time.perf_counter() - t0
     out = ALSModel(user_factors=U_host, item_factors=V_host, rank=rank)
     if sentinel is not None and not (cu or cv):

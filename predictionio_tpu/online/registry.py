@@ -79,6 +79,20 @@ class ModelVersionRegistry:
         now = _dt.datetime.now(_dt.timezone.utc)
         lineage = dict(meta or {})
         lineage["baseInstance"] = base_instance.id
+        # sharded online plane (ISSUE 12): a version whose models carry
+        # model-sharded factor tables records the layout in its lineage
+        # tag — the blob holds per-shard host slices (ShardedTable
+        # serialization), so `pio status` and a restarted follower can
+        # tell the layouts apart without deserializing models
+        try:
+            from predictionio_tpu.parallel.sharded_table import \
+                sharding_meta
+            info = sharding_meta(models)
+            if info is not None:
+                lineage.setdefault("sharding", info)
+        except Exception:
+            logger.debug("sharding lineage detection failed",
+                         exc_info=True)
         instance = base_instance.with_(
             id="", status="INIT", start_time=now, end_time=now,
             batch=f"{ONLINE_BATCH_TAG}:{json.dumps(lineage, sort_keys=True)}")

@@ -541,6 +541,14 @@ class DeltaTrainingScheduler:
             # which read path the cost model chose, and what it cost
             **read_info,
         }
+        # sharded online plane (ISSUE 12): the tick's table layout
+        # rides the report + trace so MULTICHIP artifacts and
+        # /traces.json can separate sharded from replicated ticks
+        sharding = next((r.get("sharding") for r in reports
+                         if r.get("sharding")), None)
+        if sharding is not None:
+            report["sharding"] = sharding
+            TRACER.annotate(sharding=sharding)
         TRACER.annotate(h2dBytes=report["h2dBytes"])
         if read_info.get("readRows") is not None:
             self._c_fold_read_rows.labels(

@@ -91,6 +91,14 @@ class ALSConfig:
     # large for one device's HBM); GSPMD inserts the all-gathers the
     # per-batch index gathers need — the analog of MLlib's factor-block
     # shuffles, but compiler-scheduled over ICI.
+    keep_sharded: bool = False
+    # With factor_sharding='model': return the trained tables as
+    # ShardedTable handles (per-shard host slices via
+    # host_fetch_sharded + the resident device arrays attached) instead
+    # of gathering one monolithic host table — the entry point of the
+    # sharded online plane, where the full table never crosses the
+    # host link again (fold ticks patch the mirrors, serving ranks
+    # per shard). False keeps the legacy gather-to-host behavior.
     sweep_chunk: int = 0
     # Merge this many same-shape solve batches into one scan step (one
     # solver call over chunk*B systems). The measured solver cost is
@@ -702,6 +710,27 @@ def als_train(ratings: RatingsCOO, cfg: ALSConfig,
                                    / max(cfg.iterations, 1))
         t0 = _time.perf_counter()
     from predictionio_tpu.parallel.mesh import host_fetch
+    if cfg.factor_sharding == "model" and cfg.keep_sharded:
+        # sharded online plane: the tables leave training as
+        # ShardedTable handles — per-shard host mirrors (each process
+        # fetches only its addressable slices) plus the trained device
+        # arrays attached as the resident fast path for the first fold
+        # tick / serve call. No replicating gather ever runs.
+        from predictionio_tpu.parallel.mesh import host_fetch_sharded
+        from predictionio_tpu.parallel.sharded_table import ShardedTable
+
+        def _as_sharded(dev, n_rows):
+            offsets, slices = host_fetch_sharded(dev)
+            t = ShardedTable(slices, offsets, n_rows,
+                             int(dev.shape[0]), mesh.model_parallelism)
+            return t.attach_device(dev)
+
+        U_t = _as_sharded(U, ratings.n_users)
+        V_t = _as_sharded(V, ratings.n_items)
+        if telemetry is not None:
+            telemetry["fetch_s"] = _time.perf_counter() - t0
+        return ALSModel(user_factors=U_t, item_factors=V_t,
+                        rank=cfg.rank)
     if cfg.factor_sharding == "model":
         # gather the model-sharded tables through a replicating jit (a
         # direct np.asarray on a cross-process sharded array is illegal)
@@ -776,11 +805,34 @@ def _users_topk_b(user_factors, item_factors, user_ixs, n_items, k: int):
     return jax.lax.top_k(scores, k)
 
 
-def _aot_batch_predict_builder(u: int, i: int, b: int, k: int, r: int):
+def _aot_batch_predict_builder(u: int = 0, i: int = 0, b: int = 0,
+                               k: int = 0, r: int = 0, s: int = 0):
     """(jit_fn, example avals, statics) for one batch_predict bucket —
-    what the AOT registry lowers+compiles at deploy/swap time."""
+    what the AOT registry lowers+compiles at deploy/swap time.
+
+    ``s`` > 0 selects the model-sharded layout (sharded online plane):
+    the item table's aval carries a NamedSharding over the ``s``-wide
+    model axis and the program is the two-phase per-shard top-k +
+    cross-shard merge (ops/topk) — so the bucket ladder and swap-time
+    warmup cover both layouts through one label."""
     import jax
     sds = jax.ShapeDtypeStruct
+    if s:
+        from predictionio_tpu.compile.aot import sharded_aval
+        from predictionio_tpu.ops.topk import (make_batched_sharded_topk,
+                                               sharded_k_split)
+        from predictionio_tpu.parallel.mesh import model_mesh
+        mesh = model_mesh(s)
+        k_local, k_final = sharded_k_split(k, i, s)
+        fn = make_batched_sharded_topk(mesh, k_local, k_final,
+                                       has_mask=False,
+                                       filter_positive=False)
+        return (fn,
+                (sharded_aval((b, r), np.float32, mesh=mesh),
+                 sharded_aval((i, r), np.float32, "model", None,
+                              mesh=mesh),
+                 sds((), np.int32)),
+            {})
     return (_users_topk_b,
             (sds((u, r), np.float32), sds((i, r), np.float32),
              sds((b,), np.int32), sds((), np.int32)),
@@ -805,8 +857,20 @@ def register_aot_specs():
 
 def batch_predict_dims(model: "ALSModel", batch: int, k: int) -> dict:
     """The shape-bucket dims covering one batched top-k over ``model``
-    — shared by the serve dispatch and the deploy/swap warm path."""
+    — shared by the serve dispatch and the deploy/swap warm path.
+    Model-sharded tables get the sharded-layout dims (``s`` = shard
+    count, item bucket = the table's resident sharded bucket, no user
+    dim — query vectors come from the host shard mirrors), so the same
+    warm path covers both layouts."""
     from predictionio_tpu.compile import buckets as B
+    from predictionio_tpu.parallel.sharded_table import is_sharded
+    if is_sharded(model.item_factors):
+        V = model.item_factors
+        i_b = max(V.padded_rows,
+                  B.bucket_rows_sharded(model.n_items, V.n_shards))
+        return {"i": i_b, "b": B.bucket_batch(batch),
+                "k": min(B.bucket_batch(k, floor=B.K_FLOOR), i_b),
+                "r": model.rank, "s": V.n_shards}
     i_b = B.bucket_rows(model.n_items)
     return {"u": B.bucket_rows(model.n_users), "i": i_b,
             "b": B.bucket_batch(batch),
@@ -826,11 +890,14 @@ def users_topk_serve(model: "ALSModel", user_ixs, k: int
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.compile.aot import get_aot
     from predictionio_tpu.obs import costmon
+    from predictionio_tpu.parallel.sharded_table import is_sharded
     from predictionio_tpu.utils.device_cache import cached_put_rows
     register_aot_specs()
     user_ixs = np.asarray(user_ixs, dtype=np.int32)
     n = user_ixs.shape[0]
     dims = batch_predict_dims(model, n, k)
+    if is_sharded(model.item_factors):
+        return _users_topk_serve_sharded(model, user_ixs, dims)
     ixs = np.zeros(dims["b"], dtype=np.int32)
     ixs[:n] = user_ixs
     U = cached_put_rows(model.user_factors, dims["u"])
@@ -853,6 +920,44 @@ def users_topk_serve(model: "ALSModel", user_ixs, k: int
                    dict(dims, u=B.next_bucket(dims["u"])),
                    background=True)
     return np.asarray(scores)[:n], np.asarray(idx)[:n]
+
+
+def _users_topk_serve_sharded(model: "ALSModel", user_ixs: np.ndarray,
+                              dims: dict) -> Tuple[np.ndarray, np.ndarray]:
+    """The sharded serve route of :func:`users_topk_serve`: query
+    vectors gathered from the USER table's host shard mirrors (the
+    user table needs no serving HBM at all), the item table resident
+    model-sharded, ranking via per-shard top-k + cross-shard merge
+    (ops/topk.batched_sharded_top_k) dispatched through the AOT
+    registry under the same ``batch_predict`` label — warmed sharded
+    buckets run zero trace / zero compile, exactly like replicated
+    ones."""
+    from predictionio_tpu.compile import buckets as B
+    from predictionio_tpu.compile.aot import get_aot
+    from predictionio_tpu.obs import costmon
+    from predictionio_tpu.ops.topk import batched_sharded_top_k
+    from predictionio_tpu.parallel.mesh import model_mesh
+    from predictionio_tpu.parallel.sharded_table import table_rows
+    V = model.item_factors
+    mesh = model_mesh(V.n_shards)
+    n = user_ixs.shape[0]
+    q = np.zeros((dims["b"], model.rank), dtype=np.float32)
+    q[:n] = table_rows(model.user_factors, user_ixs)
+    # a table padded below its covering sharded bucket (e.g. fresh
+    # from training) uploads AT the bucket (zero-filled tail) and the
+    # handle stays resident — the published model object is never
+    # mutated from the serve path (real promotions are the fold
+    # tick's job, where the host mirrors must follow)
+    scores, idx = batched_sharded_top_k(
+        V.device(mesh, target_rows=dims["i"]), q, model.n_items,
+        dims["k"], mesh, label=costmon.BATCH_PREDICT, dims=dims)
+    if B.should_promote(model.n_items, dims["i"]):
+        nxt = B.bucket_rows_sharded(dims["i"] + 1, V.n_shards,
+                                    floor=B.next_bucket(dims["i"]))
+        get_aot().ensure(costmon.BATCH_PREDICT,
+                         dict(dims, i=nxt, k=min(dims["k"], nxt)),
+                         background=True)
+    return scores[:n], idx[:n]
 
 
 @functools.partial(__import__("jax").jit, static_argnames=("k",))
@@ -895,16 +1000,26 @@ def recommend_products_sharded(model: ALSModel, user_ix: int, k: int,
     top-k over ICI (ops/topk.sharded_top_k). Nothing is ever replicated."""
     import jax
     from predictionio_tpu.ops.topk import sharded_top_k
+    from predictionio_tpu.parallel.sharded_table import is_sharded
     from predictionio_tpu.utils.device_cache import cached_put_padded
 
     from predictionio_tpu.utils.device_cache import cached_put
 
+    if mesh is None and is_sharded(model.item_factors):
+        from predictionio_tpu.parallel.mesh import model_mesh
+        mesh = model_mesh(model.item_factors.n_shards)
     mesh = mesh or current_mesh()
     mp = mesh.model_parallelism
     sh = mesh.model_sharded(2)
     mask_sh = mesh.sharding(mesh.MODEL_AXIS)
-    U = cached_put_padded(model.user_factors, sh, mp)
-    V = cached_put_padded(model.item_factors, sh, mp)
+
+    def _dev(table):
+        # a ShardedTable already owns a resident sharded device copy
+        return table.device(mesh) if is_sharded(table) \
+            else cached_put_padded(table, sh, mp)
+
+    U = _dev(model.user_factors)
+    V = _dev(model.item_factors)
     has_filter = (allowed_mask is not None or
                   (exclude is not None and len(np.atleast_1d(exclude))))
     if not has_filter:
@@ -941,6 +1056,21 @@ def predict_ratings(model: ALSModel, user_ix: np.ndarray,
     """Pointwise r_hat = u . v for parallel (user, item) index arrays."""
     import jax.numpy as jnp
     import jax
+
+    from predictionio_tpu.parallel.sharded_table import (is_sharded,
+                                                         table_rows)
+    if is_sharded(model.user_factors) or is_sharded(model.item_factors):
+        # sharded tables: row gathers run against the host shard
+        # mirrors (O(pairs * rank) host flops — the fold-tick loss
+        # probe's pairs are the touched histories, not the corpus) so
+        # the loss never forces a device gather of a replicated table
+        out = np.empty(len(user_ix), dtype=np.float32)
+        for lo in range(0, len(user_ix), chunk):
+            sl = slice(lo, lo + chunk)
+            out[sl] = np.sum(
+                table_rows(model.user_factors, user_ix[sl])
+                * table_rows(model.item_factors, item_ix[sl]), axis=-1)
+        return out
 
     @jax.jit
     def _dot(U, V, ui, ii):

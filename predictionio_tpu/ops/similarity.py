@@ -49,12 +49,32 @@ def _batched_masked_topk(query_mat, item_table, allowed, k: int,
     return jax.lax.top_k(scores, k)
 
 
-def _aot_masked_topk_builder(b: int, i: int, r: int, k: int, fp: int):
+def _aot_masked_topk_builder(b: int = 0, i: int = 0, r: int = 0,
+                             k: int = 0, fp: int = 0, s: int = 0):
     """(jit_fn, example avals, statics) for one masked-top-k bucket
     (the compile plane's batch_predict executable for the cosine /
-    filtered model families)."""
+    filtered model families). ``s`` > 0 lowers the model-sharded
+    variant with sharding-aware avals (item table over the model axis,
+    masks sharded on the item dim)."""
     import jax
     sds = jax.ShapeDtypeStruct
+    if s:
+        from predictionio_tpu.compile.aot import sharded_aval
+        from predictionio_tpu.ops.topk import (make_batched_sharded_topk,
+                                               sharded_k_split)
+        from predictionio_tpu.parallel.mesh import model_mesh
+        mesh = model_mesh(s)
+        k_local, k_final = sharded_k_split(k, i, s)
+        fn = make_batched_sharded_topk(mesh, k_local, k_final,
+                                       has_mask=True,
+                                       filter_positive=bool(fp))
+        return (fn,
+                (sharded_aval((b, r), np.float32, mesh=mesh),
+                 sharded_aval((i, r), np.float32, "model", None,
+                              mesh=mesh),
+                 sds((), np.int32),
+                 sharded_aval((b, i), bool, None, "model", mesh=mesh)),
+                {})
     return (_batched_masked_topk,
             (sds((b, r), np.float32), sds((i, r), np.float32),
              sds((b, i), bool)),
@@ -110,8 +130,12 @@ def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.compile.aot import get_aot
     from predictionio_tpu.obs import costmon
+    from predictionio_tpu.parallel.sharded_table import is_sharded
     from predictionio_tpu.utils.device_cache import cached_put_rows
     register_aot_specs()
+    if is_sharded(item_table):
+        return _masked_top_k_batch_sharded(item_table, query_vecs,
+                                           masks, k, filter_positive)
     n_items = item_table.shape[0]
     n = query_vecs.shape[0]
     dims = masked_topk_dims(n_items, query_vecs.shape[1], n, k,
@@ -135,6 +159,41 @@ def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
                  k=min(k_eff, B.next_bucket(dims["i"]))),
             background=True)
     return np.asarray(scores)[:n], np.asarray(idx)[:n]
+
+
+def _masked_top_k_batch_sharded(item_table, query_vecs: np.ndarray,
+                                masks: np.ndarray, k: int,
+                                filter_positive: bool
+                                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Sharded route of :func:`masked_top_k_batch`: the item table
+    stays model-sharded in HBM (its resident handle), the padded
+    [B, I] candidate mask uploads sharded over the item dim, and the
+    ranking is the per-shard top-k + cross-shard merge. Same
+    ``batch_predict_masked`` label; the ``s`` dim keeps sharded and
+    replicated buckets from ever aliasing in the AOT registry."""
+    from predictionio_tpu.compile import buckets as B
+    from predictionio_tpu.obs import costmon
+    from predictionio_tpu.ops.topk import batched_sharded_top_k
+    from predictionio_tpu.parallel.mesh import model_mesh
+    mesh = model_mesh(item_table.n_shards)
+    n_items = item_table.shape[0]
+    n = query_vecs.shape[0]
+    i_b = max(item_table.padded_rows,
+              B.bucket_rows_sharded(n_items, item_table.n_shards))
+    dims = {"b": B.bucket_batch(n), "i": i_b,
+            "r": int(query_vecs.shape[1]),
+            "k": min(B.bucket_batch(k, floor=B.K_FLOOR), i_b),
+            "fp": int(bool(filter_positive)),
+            "s": item_table.n_shards}
+    qp = np.zeros((dims["b"], query_vecs.shape[1]), dtype=np.float32)
+    qp[:n] = query_vecs
+    mp_ = np.zeros((dims["b"], dims["i"]), dtype=bool)
+    mp_[:n, :n_items] = masks
+    scores, idx = batched_sharded_top_k(
+        item_table.device(mesh, target_rows=i_b), qp, n_items,
+        dims["k"], mesh, masks=mp_, filter_positive=filter_positive,
+        label=costmon.BATCH_PREDICT_MASKED, dims=dims)
+    return scores[:n], idx[:n]
 
 
 def unpack_top_k_rows(scores_row: np.ndarray, idx_row: np.ndarray,

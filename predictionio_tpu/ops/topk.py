@@ -20,6 +20,15 @@ import numpy as np
 from predictionio_tpu.parallel.mesh import MeshContext, current_mesh
 
 
+def _shard_map():
+    try:
+        from jax import shard_map
+        return shard_map, {"check_vma": False}
+    except ImportError:   # jax < 0.5 spelling (and check_rep keyword)
+        from jax.experimental.shard_map import shard_map
+        return shard_map, {"check_rep": False}
+
+
 def sharded_top_k(item_factors_sharded, query_vec, k: int,
                   mesh: Optional[MeshContext] = None,
                   allowed_mask_sharded=None
@@ -29,12 +38,7 @@ def sharded_top_k(item_factors_sharded, query_vec, k: int,
     """
     import jax
     import jax.numpy as jnp
-    try:
-        from jax import shard_map
-        _vma_kw = {"check_vma": False}
-    except ImportError:   # jax < 0.5 spelling (and check_rep keyword)
-        from jax.experimental.shard_map import shard_map
-        _vma_kw = {"check_rep": False}
+    shard_map, _vma_kw = _shard_map()
     from jax.sharding import PartitionSpec as P
 
     mesh = mesh or current_mesh()
@@ -73,3 +77,127 @@ def sharded_top_k(item_factors_sharded, query_vec, k: int,
     scores, idx = _local_then_global(item_factors_sharded, q,
                                      allowed_mask_sharded)
     return np.asarray(scores)[:k_final], np.asarray(idx)[:k_final]
+
+
+# ---------------------------------------------------------------------------
+# Batched, masked, bucket-stable serve path (sharded online plane)
+#
+# The single-query `sharded_top_k` above is the GSPMD reference; the
+# functions below are the SERVE-plane siblings: every moving dim is
+# shape-bucketed (ISSUE 9 compile plane), query vectors arrive as one
+# [B, R] host batch (gathered from the published model's host shard
+# mirrors — the user table never needs serving HBM), the item table
+# stays model-sharded in HBM, and the ranking runs the two-phase
+# reduction per shard: local top-k over the shard's rows, a k*shards
+# candidate all-gather over the model axis, and a global top-k — the
+# full [B, I] score matrix is never replicated to one device.
+# ---------------------------------------------------------------------------
+
+def sharded_k_split(k: int, padded_rows: int,
+                    n_shards: int) -> Tuple[int, int]:
+    """(k_local, k_final) for one sharded ranking: a shard contributes
+    at most its row count, and the final answer at most ``n_shards *
+    k_local`` candidates — exact for any k (see sharded_top_k). A pure
+    function of BUCKET dims only (never of the live ``n_items``), so
+    vocabulary growth inside a bucket keeps every compiled shape;
+    columns past the valid items carry -inf, dropped by the callers'
+    finite-filter exactly as on the replicated path."""
+    shard_rows = max(padded_rows // n_shards, 1)
+    k_local = min(k, shard_rows)
+    return k_local, min(k, n_shards * k_local)
+
+
+def make_batched_sharded_topk(mesh: MeshContext, k_local: int,
+                              k_final: int, has_mask: bool,
+                              filter_positive: bool):
+    """The jitted batched two-phase top-k for one (mesh, statics)
+    combination, resolved through the compile plane's shared-jit
+    surface (one process-wide jit per key; the AOT registry lowers the
+    same callable with sharded avals at warm time).
+
+    Signature of the returned callable:
+    ``(q [B, R] replicated, v_shard [I, R] model-sharded, n_items ()
+    int32[, mask [B, I] bool sharded on dim 1]) -> (scores [B, k_final],
+    global_indices [B, k_final])``."""
+    import jax
+    import jax.numpy as jnp
+    from predictionio_tpu.compile.aot import get_aot
+
+    shard_map, vma_kw = _shard_map()
+    P = jax.sharding.PartitionSpec
+    in_specs = [P(), P("model", None), P()]
+    if has_mask:
+        in_specs.append(P(None, "model"))
+
+    @functools.partial(shard_map, mesh=mesh.mesh,
+                       in_specs=tuple(in_specs), out_specs=(P(), P()),
+                       **vma_kw)
+    def _kernel(q, v_shard, n_items, *mask):
+        scores = jnp.einsum("br,ir->bi", q, v_shard,
+                            preferred_element_type=jnp.float32)
+        ax = jax.lax.axis_index("model")
+        base = ax * v_shard.shape[0]
+        # bucket-padding rows (global index >= n_items) rank last
+        valid = (jnp.arange(v_shard.shape[0]) + base) < n_items
+        allowed = valid[None, :]
+        if has_mask:
+            allowed = allowed & mask[0]
+        if filter_positive:
+            allowed = allowed & (scores > 0)
+        scores = jnp.where(allowed, scores, -jnp.inf)
+        local_s, local_i = jax.lax.top_k(scores, k_local)
+        local_i = local_i + base
+        all_s = jnp.moveaxis(
+            jax.lax.all_gather(local_s, "model"), 0, 1
+        ).reshape(local_s.shape[0], -1)
+        all_i = jnp.moveaxis(
+            jax.lax.all_gather(local_i, "model"), 0, 1
+        ).reshape(local_i.shape[0], -1)
+        top_s, pos = jax.lax.top_k(all_s, k_final)
+        return top_s, jnp.take_along_axis(all_i, pos, axis=1)
+
+    # one process-wide jit per (mesh, statics) key: the compile plane
+    # constructs and holds it (shared_jit), so repeated calls here only
+    # rebuild the cheap shard_map wrapper, never a fresh jit closure
+    key = (f"topk.sharded_batched:{id(mesh.mesh)}:"
+           f"{mesh.model_parallelism}:{k_local}:{k_final}:"
+           f"{int(has_mask)}:{int(filter_positive)}")
+    return get_aot().shared_jit(key, _kernel)
+
+
+def batched_sharded_top_k(item_dev, query_vecs: np.ndarray,
+                          n_items: int, k_bucket: int,
+                          mesh: MeshContext,
+                          masks: Optional[np.ndarray] = None,
+                          filter_positive: bool = False,
+                          label: Optional[str] = None,
+                          dims: Optional[dict] = None
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Rank ``query_vecs`` (already padded to their batch bucket)
+    against the resident model-sharded ``item_dev`` table. ``masks``
+    (padded [B, I_bucket] bool, or None) is uploaded sharded over the
+    item dim. Dispatches through the AOT registry when ``label`` /
+    ``dims`` are given (warmed buckets run zero trace / zero
+    compile), else calls the shared jit directly."""
+    import jax
+    from predictionio_tpu.obs import jaxmon
+
+    padded_rows = int(item_dev.shape[0])
+    k_local, k_final = sharded_k_split(k_bucket, padded_rows,
+                                       mesh.model_parallelism)
+    fn = make_batched_sharded_topk(mesh, k_local, k_final,
+                                   masks is not None, filter_positive)
+    q = np.ascontiguousarray(query_vecs, dtype=np.float32)
+    args = [q, item_dev, np.int32(n_items)]
+    if masks is not None:
+        mask_dev = jax.device_put(masks, mesh.sharding(None, "model"))
+        jaxmon.record_h2d(masks.nbytes)
+        args.append(mask_dev)
+    jaxmon.record_h2d(q.nbytes)
+    if label is not None and dims is not None:
+        from predictionio_tpu.compile.aot import get_aot
+        scores, idx = get_aot().dispatch(label, dims, fn, *args)
+    else:
+        from predictionio_tpu.obs.costmon import device_timed
+        scores, idx = device_timed(label or "sharded_topk", fn, *args)
+    return np.asarray(scores), np.asarray(idx)

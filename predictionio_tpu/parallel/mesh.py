@@ -240,8 +240,46 @@ def host_fetch(x) -> np.ndarray:
     if shard.shape != x.shape:
         raise ValueError(
             f"host_fetch needs a replicated array; got sharded shape "
-            f"{shard.shape} of global {x.shape}")
+            f"{shard.shape} of global {x.shape} — use "
+            f"host_fetch_sharded to gather the per-shard slices this "
+            f"process can address")
     return np.asarray(shard)
+
+
+def host_fetch_sharded(x):
+    """Device array sharded on dim 0 -> the per-shard host slices this
+    process can address, as ``(offsets, slices)`` sorted by global row
+    offset. Replicas (e.g. a model-sharded table's copies across the
+    data axis) are deduplicated by offset — each row range is fetched
+    once. The sharded sibling of :func:`host_fetch`: where that gathers
+    one complete value, this hands back exactly the slices a
+    ``ShardedTable`` host mirror wants, with no cross-shard gather and
+    no remote-process traffic."""
+    shards = getattr(x, "addressable_shards", None)
+    if shards is None:
+        return [0], [np.asarray(x)]
+    by_offset = {}
+    for sh in shards:
+        index = sh.index or (slice(None),)
+        # only dim-0 partitioning is a row sharding: an array split on
+        # a LATER dim has every shard at row offset 0, and deduping by
+        # that offset would silently return one partial shard as the
+        # whole value — refuse instead (host_fetch's loud-misuse
+        # discipline)
+        for d, dim_slice in enumerate(index[1:], start=1):
+            full = (dim_slice.start in (None, 0)
+                    and dim_slice.stop in (None, x.shape[d]))
+            if not full:
+                raise ValueError(
+                    f"host_fetch_sharded needs an array sharded on "
+                    f"dim 0 only; got shard index {index} of global "
+                    f"{x.shape}")
+        rows = index[0]
+        start = rows.start or 0
+        if start not in by_offset:
+            by_offset[start] = np.asarray(sh.data)
+    offsets = sorted(by_offset)
+    return offsets, [by_offset[o] for o in offsets]
 
 
 def make_mesh(devices=None, model_parallelism: int = 1) -> MeshContext:
@@ -255,6 +293,30 @@ def current_mesh() -> MeshContext:
         ctx = make_mesh()
         _local.mesh = ctx
     return ctx
+
+
+_model_mesh_lock = threading.Lock()
+_model_meshes: dict = {}
+
+
+def model_mesh(n_shards: int) -> MeshContext:
+    """A mesh whose model axis is ``n_shards`` wide — the mesh a
+    model-sharded table serves and folds on. The thread's active mesh
+    wins when its model axis already matches (tests and explicit
+    ``use_mesh`` scopes); otherwise a PROCESS-wide mesh per shard
+    count is built and cached, so every server thread resolves the
+    SAME mesh for the same layout (``current_mesh``'s thread-local
+    default would hand each HTTP handler thread its own 1-wide model
+    axis and silently re-replicate a sharded table)."""
+    ctx = getattr(_local, "mesh", None)
+    if ctx is not None and ctx.model_parallelism == n_shards:
+        return ctx
+    with _model_mesh_lock:
+        ctx = _model_meshes.get(n_shards)
+        if ctx is None:
+            ctx = make_mesh(model_parallelism=n_shards)
+            _model_meshes[n_shards] = ctx
+        return ctx
 
 
 @contextlib.contextmanager

@@ -290,6 +290,25 @@ class EngineServer:
             "canary": self.canary.stats(),
         }
 
+    def _model_sharding(self) -> list:
+        """Per-algorithm factor-table layout for /stats.json (ISSUE
+        12): operators reading the over-budget runbook confirm from
+        here that a deployment actually serves sharded tables — and
+        what one shard costs a device."""
+        from predictionio_tpu.parallel.sharded_table import is_sharded
+        out = []
+        for m in list(self.models):
+            als = getattr(m, "als", None) or m
+            t = getattr(als, "item_factors", None)
+            if is_sharded(t):
+                out.append({"layout": "model", "shards": t.n_shards,
+                            "rows": t.n_rows,
+                            "perShardBytes": t.per_shard_nbytes,
+                            "resident": t._dev is not None})
+            else:
+                out.append({"layout": "replicated"})
+        return out
+
     def _quantile_samples(self):
         with self._lock:
             pct = self._ring_percentiles()
@@ -961,6 +980,9 @@ class EngineServer:
                 # deploy-time warm summary
                 "swapToFirstQueryMs": self.last_swap_to_first_query_ms,
                 "aotWarm": self.last_aot_warm,
+                # sharded online plane (ISSUE 12): per-algorithm factor
+                # table layout (+ per-shard HBM cost when sharded)
+                "modelSharding": self._model_sharding(),
             }
             pct = self._ring_percentiles()
             if pct is not None:

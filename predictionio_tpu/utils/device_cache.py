@@ -10,12 +10,81 @@ is garbage-collected.
 
 from __future__ import annotations
 
+import os
 import threading
 import weakref
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 _lock = threading.Lock()
 _cache: Dict[Tuple[int, Any], Tuple[Any, Any]] = {}
+
+
+def _sharding_key(sharding) -> Any:
+    """Canonical cache-key component for a sharding: structurally
+    distinct between no-sharding, replicated, and each sharded layout,
+    and stable across equal-but-distinct NamedSharding objects. Keying
+    on the raw object worked only as long as every caller passed the
+    same layout for a given array — once replicated and model-sharded
+    payloads of the SAME host array coexist (the sharded online
+    plane), a layout must never be able to alias another's entry."""
+    if sharding is None:
+        return None
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None:
+        return ("opaque", sharding)
+    return ("named", id(mesh), tuple(spec))
+
+
+class TableBudgetExceeded(RuntimeError):
+    """A factor-table upload would exceed the enforced per-device
+    table-byte budget (``PIO_TABLE_BUDGET_BYTES``)."""
+
+
+def table_budget_bytes() -> Optional[int]:
+    """The enforced per-device factor-table budget, or None (no
+    enforcement — the default). The over-budget acceptance scenario
+    sets this to prove a vocabulary genuinely does not fit one
+    device: the replicated upload path refuses while the model-sharded
+    path, paying only table/N per device, proceeds."""
+    raw = os.environ.get("PIO_TABLE_BUDGET_BYTES", "").strip()
+    if not raw:
+        return None
+    try:
+        b = int(float(raw))
+    except ValueError:
+        return None
+    return b if b > 0 else None
+
+
+def _row_shards(sharding) -> int:
+    """How many ways a sharding splits dim 0 (1 for None/replicated):
+    the divisor turning table bytes into per-device bytes."""
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if not spec or mesh is None or not len(spec) or not spec[0]:
+        return 1
+    axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+    try:
+        n = 1
+        for ax in axes:
+            n *= int(mesh.shape[ax])
+        return max(n, 1)
+    except Exception:
+        return 1
+
+
+def check_table_budget(per_device_bytes: int, table: str = "table"):
+    """Raise :class:`TableBudgetExceeded` when ``per_device_bytes``
+    breaks the enforced budget. No-op (zero cost beyond one getenv)
+    when no budget is set."""
+    budget = table_budget_bytes()
+    if budget is not None and int(per_device_bytes) > budget:
+        raise TableBudgetExceeded(
+            f"{table}: {int(per_device_bytes)} bytes per device "
+            f"exceeds the enforced table budget of {budget} bytes "
+            f"(PIO_TABLE_BUDGET_BYTES); shard the table over the mesh "
+            f"model axis (factor_sharding='model') or raise the budget")
 
 
 def _record_upload(arr):
@@ -31,7 +100,7 @@ def cached_put(arr, sharding=None):
     weakref-able host array (numpy ndarray)."""
     import jax
 
-    key = (id(arr), sharding)
+    key = (id(arr), _sharding_key(sharding))
     with _lock:
         entry = _cache.get(key)
         if entry is not None and entry[0]() is arr:
@@ -56,7 +125,7 @@ def cached_put_padded(arr, sharding, row_multiple: int):
     import jax
     import numpy as np
 
-    key = (id(arr), sharding, row_multiple)
+    key = (id(arr), _sharding_key(sharding), "pad", row_multiple)
     with _lock:
         entry = _cache.get(key)
         if entry is not None and entry[0]() is arr:
@@ -88,11 +157,20 @@ def cached_put_rows(arr, target_rows: int, sharding=None):
     import numpy as np
 
     target = max(int(target_rows), arr.shape[0])
-    key = (id(arr), target, sharding)
+    key = (id(arr), "rows", target, _sharding_key(sharding))
     with _lock:
         entry = _cache.get(key)
         if entry is not None and entry[0]() is arr:
             return entry[1]
+    # the enforced per-device budget (over-budget acceptance): an
+    # unsharded/replicated serving table costs its FULL padded bytes
+    # on every device — exactly what a too-large vocabulary must not
+    # be allowed to do silently
+    row_bytes = int(np.prod(arr.shape[1:], dtype=np.int64)
+                    * arr.dtype.itemsize) if arr.ndim > 1 \
+        else arr.dtype.itemsize
+    check_table_budget(target * row_bytes // _row_shards(sharding),
+                       table="cached_put_rows")
     padded = arr if target == arr.shape[0] else np.concatenate(
         [arr, np.zeros((target - arr.shape[0],) + arr.shape[1:],
                        arr.dtype)])
@@ -132,28 +210,38 @@ def clear():
 # (weakref callbacks), so an undeployed model never pins HBM.
 # ---------------------------------------------------------------------------
 
-_resident: Dict[str, Tuple[tuple, dict]] = {}   # name -> (key_refs, payload)
+_resident: Dict[str, Tuple[tuple, dict, Any]] = {}
+# name -> (key_refs, payload, sharding_token)
 
 
-def get_resident(name: str, key_arrays) -> "dict | None":
+def get_resident(name: str, key_arrays,
+                 sharding: Any = None) -> "dict | None":
     """The slot's payload iff it was stored against exactly these host
-    arrays (identity match via weakrefs); None on any mismatch."""
+    arrays (identity match via weakrefs) AND under the same sharding
+    token; None on any mismatch. The token is what keeps a replicated
+    payload from shadowing a sharded one (or vice versa) when both
+    layouts of the same logical table coexist in one process — the
+    latent aliasing the sharded online plane would otherwise hit on a
+    ``factor_sharding`` config change."""
     with _lock:
         entry = _resident.get(name)
     if entry is None:
         return None
-    refs, payload = entry
-    if len(refs) != len(key_arrays):
+    refs, payload, token = entry
+    if token != sharding or len(refs) != len(key_arrays):
         return None
     if all(r() is a for r, a in zip(refs, key_arrays)):
         return payload
     return None
 
 
-def put_resident(name: str, key_arrays, payload: dict):
+def put_resident(name: str, key_arrays, payload: dict,
+                 sharding: Any = None):
     """Store device arrays for ``name``, valid while every array in
     ``key_arrays`` (the published model version's host tables) is alive
-    and identical; replaces the slot's previous version."""
+    and identical; replaces the slot's previous version. ``sharding``
+    is the layout token (e.g. ``"replicated"`` / ``"model:4"``) the
+    matching :func:`get_resident` must present."""
     # NOTE: no lock in the callback — gc may run it while this thread
     # already holds _lock (dict pop is GIL-atomic; same discipline as
     # cached_put's eviction callback)
@@ -163,7 +251,7 @@ def put_resident(name: str, key_arrays, payload: dict):
     except TypeError:
         return  # not weakref-able: skip residency rather than leak HBM
     with _lock:
-        _resident[name] = (refs, payload)
+        _resident[name] = (refs, payload, sharding)
 
 
 def drop_resident(name: str):
@@ -176,22 +264,43 @@ def resident_count() -> int:
         return len(_resident)
 
 
+def _device_nbytes(arr) -> int:
+    """Bytes ONE device holds for ``arr``: a host/replicated array
+    costs its full ``nbytes`` per device, while a dim-0-sharded device
+    array costs only its largest per-device shard total — so the HBM
+    gauge reads ~1/N per shard for model-sharded tables (the ALX
+    scale-out claim, directly observable)."""
+    shards = getattr(arr, "addressable_shards", None)
+    if shards is None:
+        return int(getattr(arr, "nbytes", 0) or 0)
+    per: Dict[Any, int] = {}
+    try:
+        for sh in shards:
+            d = sh.device
+            per[d] = per.get(d, 0) + int(
+                getattr(sh.data, "nbytes", 0) or 0)
+    except Exception:
+        return int(getattr(arr, "nbytes", 0) or 0)
+    return max(per.values(), default=0)
+
+
 def _payload_nbytes(obj) -> int:
-    """Device bytes held by a residency payload: dicts/sequences are
-    walked one level deep (fold payloads are flat dicts of device
+    """Per-device bytes held by a residency payload: dicts/sequences
+    are walked one level deep (fold payloads are flat dicts of device
     arrays / (array, gram) pairs); anything without ``nbytes`` counts
     zero."""
     if isinstance(obj, dict):
         return sum(_payload_nbytes(v) for v in obj.values())
     if isinstance(obj, (list, tuple)):
         return sum(_payload_nbytes(v) for v in obj)
-    return int(getattr(obj, "nbytes", 0) or 0)
+    return _device_nbytes(obj)
 
 
 def resident_sizes() -> "Dict[str, int]":
-    """name -> device bytes for every live residency slot — the sample
-    source behind ``pio_hbm_table_bytes{table}`` (obs/costmon.py)."""
+    """name -> per-device bytes for every live residency slot — the
+    sample source behind ``pio_hbm_table_bytes{table}``
+    (obs/costmon.py)."""
     with _lock:
         items = list(_resident.items())
     return {name: _payload_nbytes(payload)
-            for name, (_refs, payload) in items}
+            for name, (_refs, payload, _tok) in items}

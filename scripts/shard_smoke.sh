@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Sharded online plane smoke (ISSUE 12): the over-budget acceptance
+# scenario on a FORCED 4-device CPU mesh.
+#
+# tests/test_sharded_scale.py trains, folds >= 3 consecutive ticks and
+# serves a vocabulary whose factor-table bytes exceed the enforced
+# per-device table budget (PIO_TABLE_BUDGET_BYTES, set inside the
+# test) — possible only because the tables are model-sharded:
+#   - replicated upload/fold paths REFUSE the budget violation;
+#   - the sharded layout pays table/N per device and proceeds;
+#   - steady-state ticks move O(touched-row plans) over the host link
+#     (no full-table h2d/d2h), asserted via the thread-h2d counter
+#     behind pio_fold_upload_bytes_total;
+#   - pio_hbm_table_bytes reads exactly 1/N of the tables per shard;
+#   - serve answers come from per-shard top-k + cross-shard merge
+#     with exact parity vs a host-numpy reference ranking;
+#   - the tail of the tick chain compiles nothing (PR 9 acceptance
+#     extended to the sharded executables).
+#
+# The test is slow-marked (never tier-1); this script is its CI /
+# operator entry point. The 4-device count is forced through
+# XLA_FLAGS BEFORE the suite conftest runs (conftest only appends its
+# own 8-device default when the flag is absent), so the same scenario
+# the 8-device dev box runs is rehearsed at the smallest mesh the
+# acceptance allows.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PYTHONHASHSEED=0
+export XLA_FLAGS="--xla_force_host_platform_device_count=4"
+# hermetic: no ambient chaos, guard kill switch, or stale budget
+unset PIO_FAULTS 2>/dev/null || true
+unset PIO_GUARD 2>/dev/null || true
+unset PIO_TABLE_BUDGET_BYTES 2>/dev/null || true
+
+exec python -m pytest tests/test_sharded_scale.py -q -m slow \
+    -p no:cacheprovider -p no:randomly "$@"

@@ -1,0 +1,165 @@
+"""Over-budget sharded online plane (ISSUE 12 acceptance, slow lane;
+scripts/shard_smoke.sh runs this on a forced 4-device CPU mesh).
+
+The scenario the ROADMAP's "millions of users" unlock demands: a
+vocabulary whose factor-table bytes EXCEED the enforced per-device
+table budget (``PIO_TABLE_BUDGET_BYTES``) is trained, folded across
+>= 3 consecutive ticks and served — possible only because the tables
+are model-sharded:
+
+- the replicated paths (serve upload, replicated fold) REFUSE the
+  budget violation loudly (TableBudgetExceeded);
+- the sharded path pays table/N per device and proceeds;
+- steady-state sharded ticks move O(touched-row plans) over the host
+  link — no full-table h2d (asserted via the same thread-h2d counter
+  that feeds ``pio_fold_upload_bytes_total``) and only touched-row
+  d2h;
+- ``pio_hbm_table_bytes{table}`` (device_cache.resident_sizes) reads
+  ~1/N of the table per shard;
+- serve answers come from per-shard top-k + cross-shard merge with
+  exact parity against a host-numpy reference ranking;
+- zero recompiles across the steady-state sharded ticks (the PR 9
+  acceptance holds for the sharded executables).
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import costmon, jaxmon
+from predictionio_tpu.online.fold_in import FoldInConfig, fold_in_coo
+from predictionio_tpu.ops.als import (ALSConfig, als_train,
+                                      users_topk_serve)
+from predictionio_tpu.ops.ratings import RatingsCOO
+from predictionio_tpu.parallel.mesh import model_mesh
+from predictionio_tpu.utils import device_cache
+from predictionio_tpu.utils.device_cache import TableBudgetExceeded
+
+pytestmark = pytest.mark.slow
+
+N_USERS = 2000
+N_ITEMS = 40_000
+RANK = 16
+NNZ = 60_000
+# item table: 40k x 16 x 4B = 2.56 MB logical, 4 MB at its 64k-row
+# bucket. Budget 2 MB: one device cannot hold the item table in ANY
+# form (logical 2.56 MB > budget; the bucketed replicated upload is
+# 4 MB/device); a >= 4-way sharded layout (<= 1 MB/device) fits.
+BUDGET = 2 * 1024 * 1024
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    n = len(jax.devices())
+    if n < 4:
+        pytest.skip("needs a >= 4 device mesh")
+    return model_mesh(n)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(1234)
+    return RatingsCOO(rng.integers(0, N_USERS, NNZ),
+                      rng.integers(0, N_ITEMS, NNZ),
+                      rng.uniform(1, 5, NNZ).astype(np.float32),
+                      N_USERS, N_ITEMS)
+
+
+class TestOverBudgetScenario:
+    def test_train_fold_serve_past_one_devices_budget(
+            self, mesh, corpus, monkeypatch):
+        import jax
+        mp = mesh.model_parallelism
+        monkeypatch.setenv("PIO_TABLE_BUDGET_BYTES", str(BUDGET))
+
+        # -- the premise: this vocabulary does NOT fit one device ----------
+        big_table = np.zeros((N_ITEMS, RANK), dtype=np.float32)
+        with pytest.raises(TableBudgetExceeded):
+            device_cache.cached_put_rows(
+                big_table,
+                __import__("predictionio_tpu.compile.buckets",
+                           fromlist=["bucket_rows"]).bucket_rows(N_ITEMS))
+        del big_table
+
+        # -- train sharded (keep_sharded: no full-table gather) ------------
+        model = als_train(
+            corpus,
+            ALSConfig(rank=RANK, iterations=2, seed=9,
+                      factor_sharding="model", keep_sharded=True),
+            mesh=mesh)
+        V = model.item_factors
+        assert V.n_shards == mp
+        assert V.per_shard_nbytes <= BUDGET
+        assert V.nbytes > BUDGET   # genuinely over one device's budget
+
+        # -- a REPLICATED fold of the same model must refuse ----------------
+        import dataclasses as _dc
+        from predictionio_tpu.ops.als import ALSModel
+        replicated = ALSModel(model.user_factors.to_numpy(),
+                              model.item_factors.to_numpy(), RANK)
+        with pytest.raises(TableBudgetExceeded):
+            fold_in_coo(replicated, corpus, [0], [0], FoldInConfig())
+
+        # -- >= 3 consecutive sharded fold ticks ---------------------------
+        cfg = FoldInConfig(sweeps=1, factor_sharding="model")
+        rng = np.random.default_rng(77)
+        table_bytes = (model.user_factors.padded_rows
+                       + V.padded_rows) * RANK * 4
+        cur = model
+        plan_h2d = []
+        compile_s = []
+        n_ticks = 7
+        for tick in range(n_ticks):
+            tu = rng.integers(0, N_USERS, 24)
+            ti = rng.integers(0, N_ITEMS, 32)
+            h0 = jaxmon.thread_h2d_total()
+            c0 = sum(costmon.compile_seconds_by_executable().values())
+            cur, st = fold_in_coo(cur, corpus, tu, ti, cfg,
+                                  resident_key="overbudget")
+            plan_h2d.append(jaxmon.h2d_delta(h0))
+            compile_s.append(
+                sum(costmon.compile_seconds_by_executable().values())
+                - c0)
+            assert st.sharded
+            if tick > 0:
+                assert st.resident_hit
+        # steady-state ticks: h2d bounded by touched-row plans — far
+        # under one table, let alone the full-table gather the
+        # replicated publish used to pay every tick
+        for h in plan_h2d[1:]:
+            assert h < table_bytes / 4, (plan_h2d, table_bytes)
+            assert h < BUDGET
+        # zero-recompile-across-ticks (PR 9 acceptance) holds for the
+        # sharded executables: once the touched-count K classes
+        # saturate (a few ticks at this catalog's count distribution),
+        # >= 3 consecutive ticks compile nothing
+        assert sum(compile_s[-3:]) == 0, compile_s
+
+        # -- per-shard HBM accounting: ~1/N per shard ----------------------
+        sizes = device_cache.resident_sizes()
+        assert "overbudget" in sizes
+        # the slot holds U+V at their resident sharded buckets; the
+        # gauge reads exactly 1/mp of the padded tables per device
+        bucket_bytes = (cur.user_factors.padded_rows
+                        + cur.item_factors.padded_rows) * RANK * 4
+        assert sizes["overbudget"] == bucket_bytes // mp
+
+        # -- serve: per-shard top-k + merge, exact vs host reference -------
+        users = [3, 500, 1999]
+        scores, idx = users_topk_serve(cur, users, 20)
+        U_host = cur.user_factors
+        V_host = cur.item_factors.to_numpy()
+        for row, u in enumerate(users):
+            ref = U_host.rows([u])[0] @ V_host.T
+            order = np.argsort(-ref)[:20]
+            keep = np.isfinite(scores[row])
+            got_i = idx[row][keep][:20]
+            np.testing.assert_array_equal(got_i, order)
+            np.testing.assert_allclose(scores[row][keep][:20],
+                                       ref[order], rtol=1e-5)
+
+        # -- and the serve stayed under budget: no replicated upload -------
+        # (users_topk_serve on the sharded model never touched
+        # cached_put_rows with the full table — a budget breach above
+        # would have raised)
+        assert cur.item_factors._dev is not None
