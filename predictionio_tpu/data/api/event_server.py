@@ -33,10 +33,10 @@ from predictionio_tpu.data.event import (Event, EventValidation,
 from predictionio_tpu.data.storage.base import ABSENT
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.obs import (FLIGHT, MetricsRegistry, SLOEngine,
-                                  TRACER, default_event_specs,
+                                  TRACER, default_event_specs, fleet,
                                   flight_response, get_incidents,
                                   get_registry, health_response,
-                                  traces_response)
+                                  ingress_trace_kwargs, traces_response)
 from predictionio_tpu.utils.http import HttpServer, Request, Response, Router
 
 logger = logging.getLogger(__name__)
@@ -270,6 +270,8 @@ class EventServer:
         # ISSUE 7: admission micro-batcher for concurrent single-event
         # ingest (inline when traffic is serial)
         self._batcher = _IngestBatcher(self)
+        # fleet member record id (ISSUE 13), set by start()'s on_bound
+        self._fleet_id: Optional[str] = None
         self._register_metrics()
         self.router = self._build_router()
         self.server: Optional[HttpServer] = None
@@ -386,12 +388,17 @@ class EventServer:
             self._batcher.exit()
 
     def _create_event_inner(self, req: Request) -> Response:
-        # ingress mints the trace: the storage write lands here, and
-        # the scheduler's tail read later links the fold tick that
-        # absorbs this event back to this trace (end-to-end causality
-        # on /traces.json). The response carries the trace id for log
-        # correlation.
-        with TRACER.trace("event_ingest") as tr:
+        # ingress mints the trace — unless the caller already carries
+        # one (ISSUE 13): an inbound X-PIO-Trace-Id (the engine
+        # server's feedback loop, a spill replay re-POST, any traced
+        # upstream) is ADOPTED, so the event's ingest spans land under
+        # the cross-process trace id instead of a fresh disconnected
+        # one. The storage write lands here, and the scheduler's tail
+        # read later links the fold tick that absorbs this event back
+        # to this trace (end-to-end causality on /traces.json). The
+        # response carries the trace id for log correlation.
+        with TRACER.trace("event_ingest",
+                          **ingress_trace_kwargs(req.headers)) as tr:
             access_key, channel_id = self._authenticate(req)
             d = req.json()
             if not isinstance(d, dict):
@@ -599,7 +606,8 @@ class EventServer:
                         f"{self.config.max_columnar_rows} rows per "
                         "request as parallel arrays"})
         results = []
-        with TRACER.trace("event_batch", events=len(items)):
+        with TRACER.trace("event_batch", events=len(items),
+                          **ingress_trace_kwargs(req.headers)):
             for d in items:
                 try:
                     event = Event.from_dict(d)
@@ -717,10 +725,12 @@ class EventServer:
         if not isinstance(d, dict):
             raise ValueError("request body must be a JSON object")
         if "entityId" in d:
-            return self._columnar_create(access_key, channel_id, d)
+            return self._columnar_create(access_key, channel_id, d,
+                                         req)
         return self._columnar_by_entities(access_key, channel_id, d)
 
-    def _columnar_create(self, access_key, channel_id, d) -> Response:
+    def _columnar_create(self, access_key, channel_id, d,
+                         req: Request) -> Response:
         """Columnar bulk write (ISSUE 7 tentpole b): parallel arrays in
         one body -> one normalize pass, one whole-column validation
         pass, one ``insert_columnar`` DAO call. Deterministic per-ROW
@@ -731,7 +741,8 @@ class EventServer:
         otherwise O(1) — 100k-row acks should not cost a 3 MB body)."""
         from predictionio_tpu.data.columnar import (normalize_columnar,
                                                     validate_rows)
-        with TRACER.trace("event_ingest_columnar") as tr:
+        with TRACER.trace("event_ingest_columnar",
+                          **ingress_trace_kwargs(req.headers)) as tr:
             try:
                 batch = normalize_columnar(d)
             except ValueError as e:
@@ -962,6 +973,58 @@ class EventServer:
         status, body = profiler.profile_response_from_request(req)
         return Response(status, body)
 
+    # -- fleet federation (ISSUE 13) ----------------------------------------
+    def _fleet_status(self, req: Request) -> Response:
+        """GET /fleet/status.json — member registry with liveness.
+        Ungated: aggregate process liveness only, like /health.json."""
+        return Response(200, fleet.fleet_status_response(req.params))
+
+    def _fleet_health(self, req: Request) -> Response:
+        """GET /fleet/health.json — worst-of SLO rollup across live
+        members. Ungated, like /health.json."""
+        return Response(200, fleet.fleet_health_response(req.params))
+
+    def _fleet_metrics(self, req: Request) -> Response:
+        """GET /fleet/metrics — every live member's scrape merged with
+        {role,pid} labels. Gated like /metrics (the merge contains this
+        server's own families)."""
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To federate metrics, launch Event Server "
+                           "with --stats argument."})
+        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+        return Response(200, fleet.fleet_metrics_response(req.params),
+                        content_type=CONTENT_TYPE)
+
+    def _fleet_traces(self, req: Request) -> Response:
+        """GET /fleet/traces.json?trace_id= — the trace stitched
+        fleet-wide. Gated like /traces.json."""
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To expose traces, launch Event Server with "
+                           "--stats argument."})
+        return Response(200, fleet.fleet_traces_response(req.params))
+
+    def _incidents_list(self, req: Request) -> Response:
+        """GET /incidents.json — bundle index (ISSUE 13 satellite: `pio
+        incidents list --url` against a member that does not share the
+        operator's filesystem). Gated like /flight.json."""
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To expose incidents, launch Event Server "
+                           "with --stats argument."})
+        from predictionio_tpu.obs.incidents import incidents_response
+        return Response(200, incidents_response(req.params))
+
+    def _incident_show(self, req: Request) -> Response:
+        if not self.config.stats:
+            return Response(404, {
+                "message": "To expose incidents, launch Event Server "
+                           "with --stats argument."})
+        from predictionio_tpu.obs.incidents import incident_response
+        status, body = incident_response(req.path_args[0])
+        return Response(status, body)
+
     def _webhook_json(self, req: Request) -> Response:
         access_key, channel_id = self._authenticate(req)
         name = req.path_args[0]
@@ -1028,6 +1091,12 @@ class EventServer:
         r.add("GET", "/traces.json", self._traces)
         r.add("GET", "/flight.json", self._flight)
         r.add("GET", "/health.json", self._health)
+        r.add("GET", "/fleet/status.json", self._fleet_status)
+        r.add("GET", "/fleet/health.json", self._fleet_health)
+        r.add("GET", "/fleet/metrics", self._fleet_metrics)
+        r.add("GET", "/fleet/traces.json", self._fleet_traces)
+        r.add("GET", "/incidents.json", self._incidents_list)
+        r.add("GET", "/incidents/<id>.json", self._incident_show)
         r.add("POST", "/profile.json", self._profile)
         r.add("GET", "/profile.json", self._profile)
         r.add("POST", "/webhooks/<name>.json", guarded(self._webhook_json))
@@ -1048,15 +1117,26 @@ class EventServer:
         profiler.ensure_started()
         srv = HttpServer(self.router, self.config.ip, self.config.port)
         self.server = srv
+
+        def _bound(s):
+            # runs post-bind / pre-serve: the only window where a
+            # FOREGROUND server can publish its resolved port. Fleet
+            # member record (ISSUE 13): real liveness for federation,
+            # flight GC and incident capture.
+            self.config.port = s.port
+            self._fleet_id = fleet.register_member(
+                "event_server", port=s.port, host=self.config.ip,
+                stats=self.config.stats)
+            logger.info("Event Server started on %s:%d",
+                        self.config.ip, s.port)
+
+        srv.on_bound = _bound
         srv.start(background=background)
-        # read the port from the local: a concurrent stop() (signal
-        # handler) may null self.server the instant serve_forever returns
-        self.config.port = srv.port
-        logger.info("Event Server started on %s:%d",
-                    self.config.ip, self.config.port)
         return self
 
     def stop(self):
+        fleet.deregister_member(getattr(self, "_fleet_id", None))
+        self._fleet_id = None
         if self.server:
             self.server.stop()
             self.server = None

@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Sequence
 from predictionio_tpu.data.event import Event, from_millis, to_millis
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import ABSENT
+from predictionio_tpu.obs.trace import trace_context_headers
 
 MAX_BATCH = 50  # the server's batch cap (EventServer MAX_BATCH_SIZE)
 
@@ -139,6 +140,13 @@ class RemoteEvents(base.Events):
         # bulk responses (columnar training reads) gzip ~10x; the server
         # only compresses when asked and past a size floor
         headers["Accept-Encoding"] = "gzip"
+        # cross-process trace propagation (ISSUE 13): every hop through
+        # this client — single insert, batch, columnar write, the
+        # scheduler's tail/entity-filtered reads, the spill replayer's
+        # re-inserts — carries the caller's active trace context, so
+        # the server adopts the id instead of minting a fresh one (one
+        # contextvar read when no trace is active)
+        headers.update(trace_context_headers())
         # Retries are safe for writes too: every insert carries a
         # client-assigned event id (see _with_id), so a re-send
         # overwrites by key instead of duplicating.

@@ -31,6 +31,16 @@ The runtime-attribution plane (ISSUE 11) completes the picture:
   plus the shared jax.profiler trace toggle (``/profile.json``).
 - ``obs.slowlog`` — slow-query stage waterfalls (``GET /slow.json``)
   with exemplar trace ids.
+
+The fleet plane (ISSUE 13) makes all of it cross-process:
+
+- ``obs.trace`` gains the ``X-PIO-Trace-Id``/``X-PIO-Parent-Span``
+  propagation contract — every ingress honors inbound ids, every
+  in-repo client hop injects the active context.
+- ``obs.fleet`` — crash-tolerant member registry under
+  ``base_dir()/fleet/``, ``/fleet/{status.json,metrics,traces.json,
+  health.json}`` federation, and fleet-wide incident capture
+  (``pio fleet``).
 """
 
 from predictionio_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
@@ -38,8 +48,13 @@ from predictionio_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
                                           Histogram, MetricsRegistry,
                                           REGISTRY, get_registry)
 from predictionio_tpu.obs.trace import (Span, Trace, Tracer, TRACER,
+                                        ingress_trace_kwargs,
+                                        trace_context_headers,
                                         traces_response)
-from predictionio_tpu.obs import jaxmon
+from predictionio_tpu.obs import fleet, jaxmon
+from predictionio_tpu.obs.fleet import (FLEET, FleetRegistry, get_fleet,
+                                        register_member,
+                                        deregister_member)
 from predictionio_tpu.obs.flight import (FLIGHT, FlightRecorder,
                                          flight_response, get_flight)
 from predictionio_tpu.obs.incidents import (INCIDENTS, IncidentManager,
@@ -57,6 +72,9 @@ __all__ = [
     "DEFAULT_BUCKETS", "Counter", "FuncCollector", "Gauge", "Histogram",
     "MetricsRegistry", "REGISTRY", "get_registry",
     "Span", "Trace", "Tracer", "TRACER", "traces_response",
+    "ingress_trace_kwargs", "trace_context_headers",
+    "fleet", "FLEET", "FleetRegistry", "get_fleet",
+    "register_member", "deregister_member",
     "jaxmon",
     "FLIGHT", "FlightRecorder", "flight_response", "get_flight",
     "INCIDENTS", "IncidentManager", "get_incidents",

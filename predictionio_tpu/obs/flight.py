@@ -72,6 +72,26 @@ def _pid_alive(pid: Optional[int]) -> bool:
     return True
 
 
+def _pid_is_live(pid: Optional[int]) -> bool:
+    """Liveness for the foreign-series GC: the fleet registry
+    (ISSUE 13) is authoritative when it knows the pid — a live
+    member's open series can NEVER be retired (even where os.kill is
+    blind, e.g. a sibling container sharing the volume), and a dead
+    member's series is reclaimable even when an unrelated process
+    reused its pid. Pids the registry never saw fall back to the
+    os.kill probe."""
+    try:
+        from predictionio_tpu.obs import fleet
+        status = fleet.get_fleet().pid_status(pid)
+    except Exception:
+        status = "unknown"
+    if status == "live":
+        return True
+    if status == "dead":
+        return False
+    return _pid_alive(pid)
+
+
 def _sum_samples(family) -> Optional[float]:
     """Scalar value of a family: sum of its (labeled) samples. None for
     histograms/summaries (deltas of those mean nothing as one number)."""
@@ -425,9 +445,11 @@ class FlightRecorder:
         rank by pid string, and a just-crashed process's series (the
         history worth keeping) can carry a lexicographically smaller
         pid than last week's. A live process's series is never
-        touched — it retains its own."""
+        touched — it retains its own. Liveness consults the fleet
+        registry first (ISSUE 13), falling back to the pid probe for
+        unregistered processes."""
         dead = [f for f in others
-                if not _pid_alive(self._file_pid(f))]
+                if not _pid_is_live(self._file_pid(f))]
         if len(dead) <= self.max_files:
             return
 

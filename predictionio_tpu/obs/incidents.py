@@ -277,6 +277,15 @@ class IncidentManager:
             with open(os.path.join(d, "traces.json"), "w") as f:
                 json.dump({"traces": traces}, f, default=str)
             self._write_metrics(d)
+            try:
+                # fleet capture (ISSUE 13): the flight tail, trace
+                # neighborhood and metrics scrape of every OTHER live
+                # member — a gate rejection in the scheduler process
+                # bundles the event-server ingress records that fed it
+                self._write_fleet(d, trace_ids)
+            except Exception:
+                logger.debug("fleet incident capture failed",
+                             exc_info=True)
             with self._lock:   # captures run on concurrent threads
                 self.captured += 1
             self._retire_old()
@@ -302,6 +311,69 @@ class IncidentManager:
                 pass
         with open(os.path.join(d, "metrics.prom"), "w") as f:
             f.write("\n".join(chunks))
+
+    def _write_fleet(self, d: str, trace_ids: Sequence[str]):
+        """Freeze every OTHER live member's view into the bundle:
+        ``fleet.json`` (the registry with liveness — which members
+        were alive/dead at capture is itself forensics) plus per-peer
+        ``fleet/<memberId>/{flight.jsonl,traces.json,metrics.prom}``.
+        Same-pid members are skipped (their state IS the local bundle);
+        per-peer failures are recorded, never raised. Runs on the
+        capture thread — the hot path never pays these HTTP fetches."""
+        from predictionio_tpu.obs import fleet
+        from predictionio_tpu.utils.http import fetch_json, fetch_text
+        members = fleet.get_fleet().members()
+        if not members:
+            return
+        summary = []
+        for m in members:
+            entry = {k: m.get(k) for k in
+                     ("memberId", "role", "pid", "host", "port",
+                      "alive", "ageS", "startedAt")}
+            summary.append(entry)
+            if (not m.get("alive") or not m.get("port")
+                    or m.get("pid") == os.getpid()):
+                continue
+            base = fleet.member_url(m)
+            sub = os.path.join(d, "fleet", str(m["memberId"]))
+            try:
+                os.makedirs(sub, exist_ok=True)
+                flight = fetch_json(
+                    f"{base}/flight.json?n={self.flight_tail}",
+                    timeout=3.0)
+                if isinstance(flight, dict) and "records" in flight:
+                    with open(os.path.join(sub, "flight.jsonl"),
+                              "w") as f:
+                        for rec in reversed(flight["records"]):
+                            f.write(json.dumps(
+                                rec, default=str,
+                                separators=(",", ":")) + "\n")
+                else:
+                    entry["flightError"] = (flight or {}).get("error") \
+                        or (flight or {}).get("message")
+                tid = next(iter(trace_ids), None)
+                turl = (f"{base}/traces.json?trace_id={tid}" if tid
+                        else f"{base}/traces.json"
+                             f"?n={self.traces_limit}")
+                traces = fetch_json(turl, timeout=3.0)
+                if isinstance(traces, dict) and "traces" in traces:
+                    with open(os.path.join(sub, "traces.json"),
+                              "w") as f:
+                        json.dump(traces, f, default=str)
+                else:
+                    entry["tracesError"] = (traces or {}).get("error") \
+                        or (traces or {}).get("message")
+                prom = fetch_text(f"{base}/metrics", timeout=3.0)
+                if prom is not None:
+                    with open(os.path.join(sub, "metrics.prom"),
+                              "w") as f:
+                        f.write(prom)
+                else:
+                    entry["metricsError"] = "unreachable or gated"
+            except Exception as e:
+                entry["error"] = str(e)
+        with open(os.path.join(d, "fleet.json"), "w") as f:
+            json.dump({"members": summary}, f, indent=2, default=str)
 
     def _retire_old(self):
         root = self.incidents_dir()
@@ -359,6 +431,13 @@ class IncidentManager:
         if os.path.isfile(tpath):
             with open(tpath) as f:
                 out["traceDetail"] = json.load(f).get("traces", [])
+        fpath = os.path.join(d, "fleet.json")
+        if os.path.isfile(fpath):
+            try:
+                with open(fpath) as f:
+                    out["fleet"] = json.load(f).get("members", [])
+            except (OSError, ValueError):
+                pass
         return out
 
     def export(self, incident_id: str,
@@ -379,3 +458,23 @@ INCIDENTS = IncidentManager()
 
 def get_incidents() -> IncidentManager:
     return INCIDENTS
+
+
+def incidents_response(params: dict) -> dict:
+    """Shared ``GET /incidents.json`` body (ISSUE 13 satellite): the
+    bundle index, so ``pio incidents list --url`` works against a
+    member that does not share the operator's filesystem."""
+    limit = int(params.get("n", params.get("limit", 50)))
+    return {"incidents": INCIDENTS.list_incidents()[:max(0, limit)],
+            "incidentsDir": INCIDENTS.incidents_dir()}
+
+
+def incident_response(incident_id: str):
+    """``GET /incidents/<id>.json`` -> (status, body). Path components
+    are rejected — the id names a directory under incidents_dir."""
+    if not incident_id or "/" in incident_id or ".." in incident_id:
+        return 400, {"message": "bad incident id"}
+    try:
+        return 200, INCIDENTS.load(incident_id)
+    except (OSError, ValueError):
+        return 404, {"message": f"no incident {incident_id}"}

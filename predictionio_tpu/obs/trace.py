@@ -29,12 +29,77 @@ import collections
 import contextvars
 import itertools
 import os
+import re
 import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 _span_seq = itertools.count(1)
+
+# -- cross-process propagation (ISSUE 13) ------------------------------
+# The header contract every HTTP hop in the stack speaks: an ingress
+# that finds X-PIO-Trace-Id adopts that id instead of minting a fresh
+# one, and every in-repo client (eventserver_client, the scheduler's
+# reload POST, the engine server's feedback loop, the spill replayer)
+# injects the ACTIVE trace context — so one trace id survives event
+# POST -> fold tick -> hot swap -> served query across OS processes,
+# and `pio fleet traces <id>` stitches the per-process span trees back
+# into one waterfall.
+TRACE_HEADER = "X-PIO-Trace-Id"
+PARENT_SPAN_HEADER = "X-PIO-Parent-Span"
+
+#: inbound ids are VALIDATED, not trusted: a trace id is hex (ours are
+#: 16 hex chars; foreign tracers up to 128-bit/32 chars ride too), and
+#: a garbage header must mint a fresh id rather than poison the rings
+_TID_RE = re.compile(r"^[0-9a-fA-F]{8,64}$")
+_PARENT_RE = re.compile(r"^[0-9A-Za-z_.:-]{1,128}$")
+
+
+def inbound_trace_id(headers) -> Optional[str]:
+    """The validated inbound trace id, or None (absent/garbage)."""
+    try:
+        raw = headers.get(TRACE_HEADER)
+    except Exception:
+        return None
+    if not raw:
+        return None
+    raw = str(raw).strip()
+    return raw if _TID_RE.match(raw) else None
+
+
+def ingress_trace_kwargs(headers) -> dict:
+    """Kwargs for a server-side ``TRACER.trace(kind, **kw)``: adopts
+    the caller's trace id when the propagation headers are present and
+    valid, recording the remote parent span (``<pid>:<span_id>``) as a
+    root attr so a stitched waterfall can anchor this process's tree
+    under the hop that caused it. Empty dict = mint as before."""
+    tid = inbound_trace_id(headers)
+    if not tid:
+        return {}
+    kw: dict = {"trace_id": tid}
+    try:
+        parent = headers.get(PARENT_SPAN_HEADER)
+    except Exception:
+        parent = None
+    if parent:
+        parent = str(parent).strip()
+        if _PARENT_RE.match(parent):
+            kw["remoteParent"] = parent
+    return kw
+
+
+def trace_context_headers() -> Dict[str, str]:
+    """The outbound propagation headers for the ACTIVE trace context
+    ({} when none): the trace id plus this process's current span as
+    ``<pid>:<span_id>`` — the value a downstream ingress records as
+    its remote parent. One contextvar read on the no-trace path."""
+    ctx = TRACER._ctx.get()
+    if ctx is None:
+        return {}
+    trace, span = ctx
+    return {TRACE_HEADER: trace.trace_id,
+            PARENT_SPAN_HEADER: f"{os.getpid()}:{span.span_id}"}
 
 
 class Span:
@@ -130,6 +195,9 @@ class Trace:
             return d
 
         d = {"traceId": self.trace_id, "kind": self.kind,
+             # the owning process: fleet-stitched waterfalls group the
+             # per-process trees by this (ISSUE 13)
+             "pid": os.getpid(),
              "start": self.root.t_wall,
              "durationMs": (round(self.root.duration_s * 1000.0, 3)
                             if self.root.duration_s is not None
@@ -228,7 +296,14 @@ class Tracer:
                 ring = collections.deque(maxlen=self.per_kind_capacity)
                 self._done[t.kind] = ring
             if len(ring) == ring.maxlen:   # evicting: drop its index
-                self._by_id.pop(ring[0].trace_id, None)
+                # ... only if the index still points at the evicted
+                # object: since ISSUE 13 an ADOPTED inbound id can
+                # put two traces under one id in this process (a
+                # co-located hop), and the older ring entry must not
+                # unhook the newer trace from ?trace_id= lookup
+                old = ring[0]
+                if self._by_id.get(old.trace_id) is old:
+                    self._by_id.pop(old.trace_id, None)
             ring.append(t)
             self._by_id[t.trace_id] = t
 
@@ -283,7 +358,11 @@ class Tracer:
         committed trace it links, and every committed trace linking
         it — so one ``?trace_id=`` query walks an ingest event to the
         fold tick that absorbed it (or back) without client-side grep
-        over whole rings."""
+        over whole rings. Every committed trace CARRYING the id is
+        returned, not just the newest (an adopted inbound id can put
+        a query trace and a feedback-ingest trace under one id in one
+        process — ISSUE 13 — and the stitched waterfall needs both
+        legs)."""
         with self._lock:
             target = self._by_id.get(trace_id)
             linked = set(target.links) if target is not None else set()
@@ -292,7 +371,9 @@ class Tracer:
                 for t in ring:
                     if t is target:
                         continue
-                    if t.trace_id in linked or trace_id in t.links:
+                    if (t.trace_id == trace_id
+                            or t.trace_id in linked
+                            or trace_id in t.links):
                         out.append(t)
         out.sort(key=lambda t: t.root.t_wall, reverse=True)
         return [t.to_dict() for t in out[:max(0, int(limit))]]
@@ -314,7 +395,21 @@ def traces_response(params: dict):
     ``?sort=slowest``, and ``?trace_id=`` — which returns the named
     trace plus its linked neighborhood (ISSUE 6 satellite: correlating
     one incident no longer means dumping whole rings and grepping
-    client-side)."""
+    client-side). ``?event_ids=a,b,c`` (ISSUE 13) instead answers the
+    event-id -> ingest-trace-id map from this process's bounded event
+    registry — the hop a cross-process scheduler uses to link the fold
+    tick back to ingest traces minted in the event server's process."""
+    event_ids = params.get("event_ids") or params.get("eventIds")
+    if event_ids:
+        out = {}
+        for eid in str(event_ids).split(",")[:1024]:
+            eid = eid.strip()
+            if not eid:
+                continue
+            tid = TRACER.trace_id_for_event(eid)
+            if tid:
+                out[eid] = tid
+        return {"eventTraces": out}
     limit = int(params.get("n", params.get("limit", 50)))
     trace_id = params.get("trace_id") or params.get("traceId")
     if trace_id:

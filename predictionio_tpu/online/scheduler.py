@@ -317,6 +317,7 @@ class DeltaTrainingScheduler:
         new_users: Dict[str, EntityDelta] = {}
         new_items: Dict[str, EntityDelta] = {}
         new_trace_ids: Set[str] = set()
+        miss_ids: List[str] = []
         max_t = self._cursor
         boundary: Set[str] = set()
         # only STORE work (find + iterator pulls) is attributed to the
@@ -349,6 +350,10 @@ class DeltaTrainingScheduler:
                     tid = TRACER.trace_id_for_event(e.event_id)
                     if tid:
                         new_trace_ids.add(tid)
+                    elif len(miss_ids) < 256:
+                        # minted in another process (ISSUE 13): batch-
+                        # resolved against fleet peers after the read
+                        miss_ids.append(e.event_id)
                 d = EntityDelta.from_event(e)
                 # route by entity TYPE: a rate/buy/view event's subject
                 # is a user and its target an item; a $set on an item
@@ -380,6 +385,19 @@ class DeltaTrainingScheduler:
                 self._tail_breaker.record_success()
                 raise
         self._tail_breaker.record_success()
+        if miss_ids:
+            # cross-process ingest traces (ISSUE 13): resolve the local
+            # misses against fleet peers' event maps. Fail-soft and
+            # peers-only — co-located servers share this process's
+            # tracer, so a local miss means another pid or no trace at
+            # all (directly-inserted training rows).
+            try:
+                from predictionio_tpu.obs import fleet
+                new_trace_ids.update(
+                    fleet.resolve_event_traces(miss_ids).values())
+            except Exception:
+                logger.debug("fleet event-trace resolution failed",
+                             exc_info=True)
         with self._lock:
             # partition merge through the aggregator's monoid machinery
             self._user_deltas = merge_aggregations(
@@ -712,8 +730,15 @@ class DeltaTrainingScheduler:
         if self.reload_url is not None:
             with TRACER.span("reload", url=self.reload_url):
                 try:
+                    # cross-process publish hop (ISSUE 13): the engine
+                    # server adopts this fold tick's trace id, so its
+                    # hot_swap flight record and load spans join the
+                    # fleet-stitched story
+                    from predictionio_tpu.obs.trace import \
+                        trace_context_headers
                     req = urllib.request.Request(
-                        self.reload_url, method="POST", data=b"")
+                        self.reload_url, method="POST", data=b"",
+                        headers=trace_context_headers())
                     urllib.request.urlopen(req, timeout=30).read()
                     report["reloaded"] = True
                 except Exception as e:
@@ -753,6 +778,11 @@ class DeltaTrainingScheduler:
         if self._thread is not None:
             return self
         self._stop.clear()
+        # fleet member record (ISSUE 13): a following scheduler is a
+        # fleet citizen — no HTTP port, but its liveness governs flight
+        # GC and shows up in `pio fleet status` / incident bundles
+        from predictionio_tpu.obs import fleet
+        self._fleet_id = fleet.register_member("scheduler")
 
         def loop():
             # supervised ticks (ISSUE 3): consecutive failures back off
@@ -845,6 +875,9 @@ class DeltaTrainingScheduler:
         return self
 
     def stop(self):
+        from predictionio_tpu.obs import fleet
+        fleet.deregister_member(getattr(self, "_fleet_id", None))
+        self._fleet_id = None
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)

@@ -43,6 +43,7 @@ from typing import Iterator, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event, new_event_id
 from predictionio_tpu.obs.slo import lock_probe, timed_acquire
+from predictionio_tpu.obs.trace import TRACER
 from predictionio_tpu.resilience.policy import TRANSIENT_ERRORS
 
 logger = logging.getLogger(__name__)
@@ -150,10 +151,17 @@ class SpillWAL:
         none (the id the client is ACKed with, and the replay dedup
         key). Returns the id."""
         eid = event.event_id or new_event_id()
-        payload = json.dumps(
-            {"appId": app_id, "channelId": channel_id,
-             "event": event.with_id(eid).to_dict()},
-            separators=(",", ":")).encode("utf-8")
+        envelope = {"appId": app_id, "channelId": channel_id,
+                    "event": event.with_id(eid).to_dict()}
+        # the ORIGINAL ingest trace id rides the WAL frame (ISSUE 13):
+        # a replay — even by a restarted process whose in-memory event
+        # map is gone — re-enters the store under the trace the client
+        # was ACKed with, not as an untraced write
+        tid = TRACER.current_trace_id()
+        if tid:
+            envelope["traceId"] = tid
+        payload = json.dumps(envelope,
+                             separators=(",", ":")).encode("utf-8")
         record = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         with timed_acquire(self._lock, self._append_lock_wait):
             self._f.write(record)
@@ -173,13 +181,16 @@ class SpillWAL:
         order is the list order, as the replayer expects."""
         eids = []
         frames = []
+        tid = TRACER.current_trace_id()
         for event in events:
             eid = event.event_id or new_event_id()
             eids.append(eid)
-            payload = json.dumps(
-                {"appId": app_id, "channelId": channel_id,
-                 "event": event.with_id(eid).to_dict()},
-                separators=(",", ":")).encode("utf-8")
+            envelope = {"appId": app_id, "channelId": channel_id,
+                        "event": event.with_id(eid).to_dict()}
+            if tid:
+                envelope["traceId"] = tid
+            payload = json.dumps(envelope,
+                                 separators=(",", ":")).encode("utf-8")
             frames.append(
                 _HEADER.pack(len(payload), zlib.crc32(payload)) + payload)
         blob = b"".join(frames)
@@ -193,9 +204,12 @@ class SpillWAL:
         return eids
 
     # -- read side ----------------------------------------------------------
-    def pending(self) -> Iterator[Tuple[int, int, Optional[int], Event]]:
-        """Yield ``(offset_after_record, app_id, channel_id, event)`` for
-        every un-replayed record, in insertion order."""
+    def pending(self) -> Iterator[
+            Tuple[int, int, Optional[int], Event, Optional[str]]]:
+        """Yield ``(offset_after_record, app_id, channel_id, event,
+        trace_id)`` for every un-replayed record, in insertion order
+        (``trace_id`` is the original ingest trace, None for frames
+        written before ISSUE 13 or outside any trace)."""
         with self._lock:
             start, end = self._cursor, self._size
         if start >= end:
@@ -214,7 +228,7 @@ class SpillWAL:
                 pos += _HEADER.size + length
                 d = json.loads(payload.decode("utf-8"))
                 yield (pos, d["appId"], d.get("channelId"),
-                       Event.from_dict(d["event"]))
+                       Event.from_dict(d["event"]), d.get("traceId"))
 
     def pending_count(self) -> int:
         with self._lock:
@@ -478,9 +492,27 @@ class SpillReplayer:
     #: breaker success, and quarantine bait.
     TRANSIENT_ERRORS = TRANSIENT_ERRORS
 
-    def _insert_one(self, app_id, channel_id, event: Event) -> bool:
+    def _insert_one(self, app_id, channel_id, event: Event,
+                    trace_id: Optional[str] = None) -> bool:
         """One record into the primary store; True = inserted, False =
-        deduped. Raises on (breaker-gated, retried) failure."""
+        deduped. Raises on (breaker-gated, retried) failure.
+
+        ``trace_id`` (the frame's original ingest trace, ISSUE 13) is
+        re-activated around the insert — discarded from the ring (the
+        original trace already committed; a duplicate commit under the
+        same id would shadow it) but LIVE as context, so a remote
+        store hop carries X-PIO-Trace-Id and any flight record emitted
+        under the write stamps the original id — and re-registered in
+        the event map so a later fold tick links the original trace,
+        not nothing."""
+        if trace_id:
+            with TRACER.trace("spill_replay_write",
+                              trace_id=trace_id) as t:
+                t.discard = True
+                ok = self._insert_one(app_id, channel_id, event)
+            TRACER.register_event(event.event_id, trace_id)
+            return ok
+
         def attempt():
             if self.breaker is not None:
                 self.breaker.allow()
@@ -507,7 +539,8 @@ class SpillReplayer:
         return self.policy.call(attempt)
 
     def _note_head_failure(self, offset: int, app_id, channel_id,
-                           event: Event, error: Exception) -> bool:
+                           event: Event, error: Exception,
+                           trace_id: Optional[str] = None) -> bool:
         """Track repeated failures of the record at the drain head.
         Returns True when the record was quarantined (drain may step
         past it). Only DETERMINISTIC rejections count — transient
@@ -528,10 +561,15 @@ class SpillReplayer:
         if self._head_fail_count < self.quarantine_after:
             return False
         qpath = self.wal.path + ".quarantine"
+        rec = {"appId": app_id, "channelId": channel_id,
+               "event": event.to_dict(), "error": str(error)}
+        if trace_id:
+            # the original ingest trace rides into quarantine (ISSUE
+            # 13): `pio spill peek --quarantine` keeps the pivot into
+            # the outage narrative
+            rec["traceId"] = trace_id
         with open(qpath, "a") as f:
-            f.write(json.dumps({
-                "appId": app_id, "channelId": channel_id,
-                "event": event.to_dict(), "error": str(error)}) + "\n")
+            f.write(json.dumps(rec) + "\n")
         self.quarantined += 1
         self._c_quarantined.inc()
         self._head_fail_offset = None
@@ -551,13 +589,39 @@ class SpillReplayer:
     #: consecutive same-namespace records per bulk replay flush
     REPLAY_BATCH = 256
 
-    def _insert_batch(self, app_id, channel_id, events) -> int:
+    def _insert_batch(self, app_id, channel_id, events,
+                      trace_ids=()) -> int:
         """A same-namespace run into the primary via ONE
         ``insert_batch`` (ISSUE 7 satellite: recovery drains at bulk
         speed — exactly when throughput matters), id-deduped by
         get-probes first. Returns the inserted count. Transient
         failures raise after breaker gating + retry; a partial commit
-        re-replays as dedups (ids were pre-assigned at spill time)."""
+        re-replays as dedups (ids were pre-assigned at spill time).
+
+        ``trace_ids`` parallels ``events``: the original ingest trace
+        ids are re-registered on success (ISSUE 13 — the fold tick's
+        link source), and when the whole run shares ONE id (a spilled
+        batch/columnar write) the insert runs under it as live
+        context, so a remote-store hop propagates the header."""
+        tids = {t for t in trace_ids if t}
+        if len(tids) == 1:
+            with TRACER.trace("spill_replay_write",
+                              trace_id=next(iter(tids))) as t:
+                t.discard = True
+                n = self._insert_batch_inner(app_id, channel_id,
+                                             events)
+        else:
+            n = self._insert_batch_inner(app_id, channel_id, events)
+        self._register_replayed(events, trace_ids)
+        return n
+
+    @staticmethod
+    def _register_replayed(events, trace_ids):
+        for e, tid in zip(events, trace_ids):
+            if tid:
+                TRACER.register_event(e.event_id, tid)
+
+    def _insert_batch_inner(self, app_id, channel_id, events) -> int:
         def attempt():
             if self.breaker is not None:
                 self.breaker.allow()
@@ -595,7 +659,7 @@ class SpillReplayer:
         A transient failure stops the drain AT the failing run
         (nothing is skipped). Returns records replayed+deduped."""
         done = 0
-        buf: list = []           # [(offset, event)] — one namespace run
+        buf: list = []   # [(offset, event, trace_id)] — one namespace run
         key: Optional[tuple] = None
 
         def flush_per_record() -> bool:
@@ -607,14 +671,16 @@ class SpillReplayer:
             keep = True
             app_id, channel_id = key
             try:
-                for offset, event in buf:
+                for offset, event, tid in buf:
                     try:
                         inserted = self._insert_one(app_id, channel_id,
-                                                    event)
+                                                    event,
+                                                    trace_id=tid)
                     except Exception as e:
                         self.last_error = str(e)
                         if self._note_head_failure(offset, app_id,
-                                                   channel_id, event, e):
+                                                   channel_id, event, e,
+                                                   trace_id=tid):
                             # quarantined: step past, keep draining
                             self.wal.checkpoint(offset,
                                                 records=ok_since + 1)
@@ -647,8 +713,9 @@ class SpillReplayer:
             if not buf:
                 return True
             try:
-                inserted = self._insert_batch(key[0], key[1],
-                                              [e for _, e in buf])
+                inserted = self._insert_batch(
+                    key[0], key[1], [e for _, e, _t in buf],
+                    trace_ids=[t for _, _e, t in buf])
             except self.TRANSIENT_ERRORS as e:
                 # outage-class: stop AT the run head; nothing skipped
                 self.last_error = str(e)
@@ -668,14 +735,15 @@ class SpillReplayer:
             return True
 
         exhausted = True
-        for offset, app_id, channel_id, event in self.wal.pending():
+        for offset, app_id, channel_id, event, tid \
+                in self.wal.pending():
             k = (app_id, channel_id)
             if key != k or len(buf) >= self.REPLAY_BATCH:
                 if buf and not flush():
                     exhausted = False
                     break
                 key = k
-            buf.append((offset, event))
+            buf.append((offset, event, tid))
             if max_records is not None \
                     and done + len(buf) >= max_records:
                 exhausted = False

@@ -31,10 +31,12 @@ from predictionio_tpu.data.event import format_event_time, utcnow
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.models import get_engine_factory
 from predictionio_tpu.obs import (FLIGHT, MetricsRegistry, SLOEngine,
-                                  TRACER, default_engine_specs,
+                                  TRACER, default_engine_specs, fleet,
                                   flight_response, get_incidents,
-                                  get_registry, health_response, jaxmon,
-                                  slow_response, traces_response)
+                                  get_registry, health_response,
+                                  ingress_trace_kwargs, jaxmon,
+                                  slow_response, trace_context_headers,
+                                  traces_response)
 from predictionio_tpu.obs.slowlog import (capture_slow_query,
                                           slow_threshold_s)
 from predictionio_tpu.serving.plugins import EngineServerPluginContext
@@ -204,6 +206,9 @@ class EngineServer:
         self._swap_marker = None
         self.last_swap_to_first_query_ms: Optional[float] = None
         self.last_aot_warm: Optional[dict] = None
+        # fleet member record id (ISSUE 13), set by start()'s on_bound
+        # hook under _lock (stop() may run on a /stop route thread)
+        self._fleet_id: Optional[str] = None
         self._register_metrics()
         self.batcher = None
         if config.micro_batch > 1:
@@ -772,13 +777,18 @@ class EngineServer:
         url = (f"http://{self.config.event_server_ip}:"
                f"{self.config.event_server_port}/events.json"
                f"?accessKey={self.config.accesskey}")
+        # capture the query's trace context NOW (ISSUE 13): the POST
+        # runs on a fresh thread whose contextvars are empty, and the
+        # event server adopting this id is what ties the feedback
+        # event's ingest to the query that produced it across processes
+        headers = {"Content-Type": "application/json",
+                   **trace_context_headers()}
 
         def _post():
             try:
                 req = urllib.request.Request(
                     url, data=json.dumps(event).encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST")
+                    headers=headers, method="POST")
                 urllib.request.urlopen(req, timeout=5).read()
             except Exception as e:
                 logger.error("feedback event POST failed: %s", e)
@@ -857,11 +867,15 @@ class EngineServer:
         if not isinstance(d, dict):
             raise ValueError("query must be a JSON object")
         deadline_s = self._request_deadline_s(req)
-        # ingress trace: minted per query. In batched mode the device
-        # work happens under the batcher thread's own batch_predict
-        # trace; submit() records the two-way link so /traces.json ties
-        # a query to the coalesced window that answered it.
-        with TRACER.trace("query") as qt:
+        # ingress trace: minted per query — or ADOPTED from an inbound
+        # X-PIO-Trace-Id (ISSUE 13), so a traced upstream caller's id
+        # spans this process's serve waterfall too. In batched mode the
+        # device work happens under the batcher thread's own
+        # batch_predict trace; submit() records the two-way link so
+        # /traces.json ties a query to the coalesced window that
+        # answered it.
+        with TRACER.trace("query",
+                          **ingress_trace_kwargs(req.headers)) as qt:
             t_q0 = time.perf_counter()
             if self.batcher is not None:
                 out = self.batcher.submit(d, deadline_s=deadline_s)
@@ -918,7 +932,18 @@ class EngineServer:
         return Response(200, slow_response(req.params))
 
     def _reload(self, req: Request) -> Response:
-        """Hot-swap to the latest COMPLETED instance (:337-358)."""
+        """Hot-swap to the latest COMPLETED instance (:337-358). When
+        the POST carries an inbound trace id (a cross-process
+        scheduler's publish hop, ISSUE 13) the reload runs under it, so
+        this process's hot_swap flight record and load spans join the
+        fold tick's fleet-stitched story."""
+        kw = ingress_trace_kwargs(req.headers)
+        if kw:
+            with TRACER.trace("reload", **kw):
+                return self._reload_inner(req)
+        return self._reload_inner(req)
+
+    def _reload_inner(self, req: Request) -> Response:
         if self.coordinator is not None and self.coordinator.multi_process:
             # reload is per-process: swapping models on the primary only
             # would serve mismatched shards (wrong scores or a collective
@@ -1103,6 +1128,39 @@ class EngineServer:
                     f"{s.get('burnSlow')})",
                     context={"slo": s})
 
+    # -- fleet federation (ISSUE 13) ----------------------------------------
+    def _fleet_status(self, req: Request) -> Response:
+        """GET /fleet/status.json — member registry with liveness."""
+        return Response(200, fleet.fleet_status_response(req.params))
+
+    def _fleet_health(self, req: Request) -> Response:
+        """GET /fleet/health.json — worst-of SLO rollup across live
+        members."""
+        return Response(200, fleet.fleet_health_response(req.params))
+
+    def _fleet_metrics(self, req: Request) -> Response:
+        """GET /fleet/metrics — every live member's scrape merged with
+        {role,pid} labels (obs/fleet.py)."""
+        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+        return Response(200, fleet.fleet_metrics_response(req.params),
+                        content_type=CONTENT_TYPE)
+
+    def _fleet_traces(self, req: Request) -> Response:
+        """GET /fleet/traces.json?trace_id= — one trace stitched
+        fleet-wide into a cross-process waterfall."""
+        return Response(200, fleet.fleet_traces_response(req.params))
+
+    def _incidents_list(self, req: Request) -> Response:
+        """GET /incidents.json — bundle index (`pio incidents list
+        --url`)."""
+        from predictionio_tpu.obs.incidents import incidents_response
+        return Response(200, incidents_response(req.params))
+
+    def _incident_show(self, req: Request) -> Response:
+        from predictionio_tpu.obs.incidents import incident_response
+        status, body = incident_response(req.path_args[0])
+        return Response(status, body)
+
     def _build_router(self) -> Router:
         r = Router()
         r.add("GET", "/", self._status_page)
@@ -1117,6 +1175,12 @@ class EngineServer:
         r.add("GET", "/traces.json", self._traces)
         r.add("GET", "/flight.json", self._flight)
         r.add("GET", "/health.json", self._health)
+        r.add("GET", "/fleet/status.json", self._fleet_status)
+        r.add("GET", "/fleet/health.json", self._fleet_health)
+        r.add("GET", "/fleet/metrics", self._fleet_metrics)
+        r.add("GET", "/fleet/traces.json", self._fleet_traces)
+        r.add("GET", "/incidents.json", self._incidents_list)
+        r.add("GET", "/incidents/<id>.json", self._incident_show)
         r.add("GET", "/slow.json", self._slow)
         r.add("POST", "/profile.json", self._profile)
         r.add("GET", "/profile.json", self._profile)
@@ -1131,12 +1195,21 @@ class EngineServer:
         profiler.ensure_started()
         srv = HttpServer(self.router, self.config.ip, self.config.port)
         self.server = srv
+
+        def _bound(s):
+            # post-bind / pre-serve: publish the resolved port (fleet
+            # member record, ISSUE 13) before a foreground
+            # serve_forever blocks
+            self.config.port = s.port
+            fid = fleet.register_member(
+                "engine_server", port=s.port, host=self.config.ip)
+            with self._lock:
+                self._fleet_id = fid
+            logger.info("Engine server started on %s:%d",
+                        self.config.ip, s.port)
+
+        srv.on_bound = _bound
         srv.start(background=background)
-        # read the port from the local: a concurrent stop() (signal
-        # handler) may null self.server the instant serve_forever returns
-        self.config.port = srv.port
-        logger.info("Engine server started on %s:%d", self.config.ip,
-                    self.config.port)
         return self
 
     def stop(self):
@@ -1149,6 +1222,13 @@ class EngineServer:
         # the primary's interpreter exit mid-collective and strand the
         # workers (observed as a poisoned release bcast in the 2-proc
         # test)
+        # /stop runs this on a spawned thread while start()'s on_bound
+        # hook writes _fleet_id from the serving thread: swap it out
+        # under the serving lock, deregister (file IO) outside it
+        with self._lock:
+            fleet_id = self._fleet_id
+            self._fleet_id = None
+        fleet.deregister_member(fleet_id)
         if self.server:
             self.server.stop()
         if self.batcher is not None:

@@ -71,16 +71,27 @@ def cmd_status(args) -> int:
     return 1
 
 
-def _print_slo(args) -> None:
-    """`pio status --slo` (ISSUE 6): each server's /health.json as a
-    compact burn-rate table."""
-    from predictionio_tpu.utils.http import fetch_json as _fetch_json
+def _status_targets(args):
+    """(name, base_url) pairs `pio status --telemetry/--slo` poll:
+    ``--url`` points the probes at ONE explicit fleet member (ISSUE 13
+    satellite — any process on any host, not just the local default
+    ports); the default stays the local engine + event server pair."""
+    url = getattr(args, "url", None)
+    if url:
+        return [("member", url.rstrip("/"))]
     ip = getattr(args, "ip", None) or "127.0.0.1"
-    targets = [
+    return [
         ("engine", f"http://{ip}:{getattr(args, 'engine_port', 8000)}"),
         ("events", f"http://{ip}:"
                    f"{getattr(args, 'event_server_port', 7070)}"),
     ]
+
+
+def _print_slo(args) -> None:
+    """`pio status --slo` (ISSUE 6): each server's /health.json as a
+    compact burn-rate table."""
+    from predictionio_tpu.utils.http import fetch_json as _fetch_json
+    targets = _status_targets(args)
     for name, base in targets:
         _print(f"{name.capitalize()} server SLOs...")
         h = _fetch_json(f"{base}/health.json")
@@ -117,9 +128,9 @@ def _print_hist(name: str, h) -> None:
 
 def _print_telemetry(args) -> None:
     from predictionio_tpu.utils.http import fetch_json as _fetch_json
-    ip = getattr(args, "ip", None) or "127.0.0.1"
-    engine = f"http://{ip}:{getattr(args, 'engine_port', 8000)}"
-    events = f"http://{ip}:{getattr(args, 'event_server_port', 7070)}"
+    targets = dict(_status_targets(args))
+    engine = targets.get("engine") or targets.get("member")
+    events = targets.get("events") or targets.get("member")
 
     _print("Engine server telemetry...")
     st = _fetch_json(f"{engine}/stats.json")
@@ -404,6 +415,11 @@ def cmd_update(args) -> int:
     _print(f"Following app {app_name!r} (fold at {config.max_deltas} "
            f"deltas or {config.max_staleness_s:g}s staleness; ^C stops).")
     import logging as _logging
+    # a following scheduler is a fleet member (ISSUE 13): its liveness
+    # shows in `pio fleet status`, guards its flight series from GC,
+    # and puts it on incident bundles' member roster
+    from predictionio_tpu.obs import fleet as _fleet
+    fleet_id = _fleet.register_member("scheduler")
     try:
         while True:
             try:
@@ -426,6 +442,8 @@ def cmd_update(args) -> int:
         _print("Stopped.")
         _print(_json.dumps(sched.stats()))
         return 0
+    finally:
+        _fleet.deregister_member(fleet_id)
 
 
 def cmd_undeploy(args) -> int:
@@ -812,22 +830,45 @@ def cmd_incidents(args) -> int:
 
     from predictionio_tpu.obs.incidents import IncidentManager
     mgr = IncidentManager(incidents_dir=getattr(args, "dir", None))
+    # --url (ISSUE 13 satellite): browse a FLEET MEMBER's bundles over
+    # HTTP instead of the local base_dir — the operator box need not
+    # share the member's filesystem
+    url = (getattr(args, "url", None) or "").rstrip("/")
     sub = args.incidents_command
     if sub == "list":
-        rows = mgr.list_incidents()
+        if url:
+            from predictionio_tpu.utils.http import fetch_json
+            body = fetch_json(f"{url}/incidents.json")
+            if not isinstance(body, dict) or "incidents" not in body:
+                _print(f"Cannot list incidents at {url}: "
+                       f"{(body or {}).get('error') or (body or {}).get('message')}")
+                return 1
+            rows = body["incidents"]
+            where = f"{url} ({body.get('incidentsDir')})"
+        else:
+            rows = mgr.list_incidents()
+            where = mgr.incidents_dir()
         if not rows:
-            _print(f"No incidents under {mgr.incidents_dir()}.")
+            _print(f"No incidents under {where}.")
             return 0
         for r in rows:
             _print(f"{r['id']:40s} {r.get('kind', '?'):18s} "
                    f"{r.get('capturedAt', '')}  {r.get('reason', '')}")
         return 0
     if sub == "show":
-        try:
-            bundle = mgr.load(args.id)
-        except (OSError, ValueError) as e:
-            _print(f"Cannot load incident {args.id}: {e}")
-            return 1
+        if url:
+            from predictionio_tpu.utils.http import fetch_json
+            bundle = fetch_json(f"{url}/incidents/{args.id}.json")
+            if not isinstance(bundle, dict) or "id" not in bundle:
+                _print(f"Cannot load incident {args.id} from {url}: "
+                       f"{(bundle or {}).get('error') or (bundle or {}).get('message')}")
+                return 1
+        else:
+            try:
+                bundle = mgr.load(args.id)
+            except (OSError, ValueError) as e:
+                _print(f"Cannot load incident {args.id}: {e}")
+                return 1
         _print(f"Incident {bundle['id']}: {bundle['kind']} — "
                f"{bundle['reason']}")
         _print(f"  captured: {bundle.get('capturedAt')}")
@@ -849,8 +890,22 @@ def cmd_incidents(args) -> int:
             for t in traces:
                 _print(f"    {t.get('kind', '?'):14s} "
                        f"{t.get('traceId')} links={t.get('links')}")
+        members = bundle.get("fleet") or []
+        if members:
+            _print(f"  fleet at capture ({len(members)} member(s)):")
+            for m in members:
+                _print(f"    {m.get('memberId', '?'):28s} "
+                       f"{'ALIVE' if m.get('alive') else 'DEAD':6s}"
+                       f" port={m.get('port') or '-'}"
+                       + (f" [{m.get('error') or m.get('metricsError')}]"
+                          if m.get("error") or m.get("metricsError")
+                          else ""))
         return 0
     if sub == "export":
+        if url:
+            _print("export needs the member's filesystem; run it on "
+                   "that host (list/show work over --url).")
+            return 1
         try:
             out = mgr.export(args.id, getattr(args, "out", None))
         except (OSError, FileNotFoundError) as e:
@@ -859,6 +914,69 @@ def cmd_incidents(args) -> int:
         _print(f"Exported incident {args.id} to {out}.")
         return 0
     _print("incidents subcommand must be list|show|export")
+    return 1
+
+
+def cmd_fleet(args) -> int:
+    """`pio fleet {status,metrics,traces}` (ISSUE 13): the whole-fleet
+    operator surface over the member registry under
+    <PIO_FS_BASEDIR>/fleet/ — liveness, one federated {role,pid}-labeled
+    metrics scrape, and a trace id stitched across every member's
+    process into one waterfall."""
+    from predictionio_tpu.obs import fleet as F
+    reg = F.FleetRegistry(fleet_dir=getattr(args, "dir", None)) \
+        if getattr(args, "dir", None) else F.get_fleet()
+    sub = args.fleet_command
+    if sub == "status":
+        st = F.fleet_status(reg.members(), registry=reg)
+        _print(f"Fleet under {st['fleetDir']} "
+               f"(heartbeat {st['heartbeatS']:g}s, liveness window "
+               f"{st['livenessWindowS']:g}s):")
+        if not st["members"]:
+            _print("  no members registered (are the servers running "
+                   "with this PIO_FS_BASEDIR?)")
+            return 1
+        for m in st["members"]:
+            _print(f"  {m.get('memberId', '?'):28s} "
+                   f"{'UP' if m.get('alive') else 'DEAD':5s} "
+                   f"pid={m.get('pid')} "
+                   f"port={m.get('port') or '-':<6} "
+                   f"beat {m.get('ageS', 0):.1f}s ago")
+        _print(f"  {st['alive']} alive, {st['dead']} dead")
+        return 0 if st["dead"] == 0 else 1
+    if sub == "metrics":
+        _print(F.federate_metrics(reg.live_members()).rstrip("\n"))
+        return 0
+    if sub == "traces":
+        out = F.fleet_traces(args.id, members=reg.live_members(),
+                             limit=args.n)
+        for q in out["members"]:
+            if not q.get("ok"):
+                _print(f"# {q.get('memberId')}: {q.get('error')}")
+        if not out["traces"]:
+            _print(f"No member holds trace {args.id} (rings rotate; "
+                   "capture an incident to freeze one).")
+            return 1
+        _print(f"Trace {args.id}: {len(out['traces'])} process-local "
+               f"trace(s) across pids {out['pids']}")
+
+        def walk(span, depth):
+            _print(f"    {'  ' * depth}{span.get('name', '?'):24s} "
+                   f"{span.get('durationMs', '?')}ms"
+                   + (f" {span['attrs']}" if span.get("attrs") else ""))
+            for c in span.get("children", ()):
+                walk(c, depth + 1)
+
+        for t in out["traces"]:
+            m = t.get("member") or {}
+            tag = " <- THE trace" if t.get("traceId") == args.id \
+                else f" (links {t.get('links')})"
+            _print(f"  [{m.get('role', '?')}:{t.get('pid', '?')}] "
+                   f"{t.get('kind'):16s} {t.get('durationMs')}ms "
+                   f"{t.get('traceId')}{tag}")
+            walk(t.get("root") or {}, 1)
+        return 0
+    _print("fleet subcommand must be status|metrics|traces")
     return 1
 
 
@@ -1065,6 +1183,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also poll the running servers' /health.json "
                          "and print each SLO's status and fast/slow "
                          "burn rates (ISSUE 6)")
+    st.add_argument("--url",
+                    help="point --telemetry/--slo at ONE explicit "
+                         "fleet member (http://host:port) instead of "
+                         "the local engine+event defaults (ISSUE 13)")
     st.set_defaults(func=cmd_status)
 
     b = sub.add_parser("build")
@@ -1379,14 +1501,39 @@ def build_parser() -> argparse.ArgumentParser:
     inl = incsub.add_parser("list")
     inl.add_argument("--dir", help="incidents dir (default: "
                      "<PIO_FS_BASEDIR>/incidents)")
+    inl.add_argument("--url", help="browse a fleet member's bundles "
+                     "over HTTP (http://host:port) instead of the "
+                     "local base_dir (ISSUE 13)")
     ins = incsub.add_parser("show")
     ins.add_argument("id")
     ins.add_argument("--dir")
+    ins.add_argument("--url", help="load the bundle from a fleet "
+                     "member over HTTP instead of the local base_dir")
     ine = incsub.add_parser("export")
     ine.add_argument("id")
     ine.add_argument("--out", help="output path (default ./<id>.tar.gz)")
     ine.add_argument("--dir")
+    ine.add_argument("--url", help="rejected with a pointer (export "
+                     "needs the member's filesystem)")
     inc.set_defaults(func=cmd_incidents)
+
+    fl = sub.add_parser(
+        "fleet", help="fleet observability (ISSUE 13): member registry "
+        "liveness, the federated {role,pid}-labeled metrics scrape, "
+        "and cross-process trace stitching")
+    flsub = fl.add_subparsers(dest="fleet_command", required=True)
+    fls = flsub.add_parser("status")
+    fls.add_argument("--dir", help="fleet registry dir (default: "
+                     "<PIO_FS_BASEDIR>/fleet)")
+    flm = flsub.add_parser("metrics")
+    flm.add_argument("--dir")
+    flt = flsub.add_parser("traces")
+    flt.add_argument("id", help="the trace id to stitch fleet-wide "
+                     "(e.g. the traceId an event POST returned)")
+    flt.add_argument("-n", type=int, default=50,
+                     help="per-member neighborhood cap")
+    flt.add_argument("--dir")
+    fl.set_defaults(func=cmd_fleet)
 
     pf = sub.add_parser(
         "profile", help="runtime attribution (ISSUE 11): read the "

@@ -39,6 +39,7 @@ class Dashboard:
         jaxmon.install()   # /metrics carries the JAX runtime families
         self.router = self._build_router()
         self.server = None
+        self._fleet_id = None   # set by start()'s on_bound (ISSUE 13)
 
     def _index(self, req: Request) -> Response:
         instances = Storage.get_meta_data_evaluation_instances() \
@@ -191,6 +192,28 @@ class Dashboard:
         from predictionio_tpu.obs import flight_response
         return Response(200, flight_response(req.params))
 
+    # -- fleet federation (ISSUE 13): the dashboard is a full fleet
+    # citizen — it registers a member record and serves the same
+    # /fleet/* federation surface as both servers, so an operator can
+    # point Prometheus or `pio fleet` at whichever process is exposed.
+    def _fleet_status(self, req: Request) -> Response:
+        from predictionio_tpu.obs import fleet
+        return Response(200, fleet.fleet_status_response(req.params))
+
+    def _fleet_health(self, req: Request) -> Response:
+        from predictionio_tpu.obs import fleet
+        return Response(200, fleet.fleet_health_response(req.params))
+
+    def _fleet_metrics(self, req: Request) -> Response:
+        from predictionio_tpu.obs import fleet
+        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+        return Response(200, fleet.fleet_metrics_response(req.params),
+                        content_type=CONTENT_TYPE)
+
+    def _fleet_traces(self, req: Request) -> Response:
+        from predictionio_tpu.obs import fleet
+        return Response(200, fleet.fleet_traces_response(req.params))
+
     def _build_router(self) -> Router:
         r = Router()
         r.add("GET", "/", self._index)
@@ -198,20 +221,33 @@ class Dashboard:
         r.add("GET", "/metrics", self._metrics)
         r.add("GET", "/traces.json", self._traces)
         r.add("GET", "/flight.json", self._flight)
+        r.add("GET", "/fleet/status.json", self._fleet_status)
+        r.add("GET", "/fleet/health.json", self._fleet_health)
+        r.add("GET", "/fleet/metrics", self._fleet_metrics)
+        r.add("GET", "/fleet/traces.json", self._fleet_traces)
         r.add("GET", "/engine_instances/<id>/evaluator_results.<fmt>",
               self._result)
         return r
 
     def start(self, background: bool = True) -> "Dashboard":
+        from predictionio_tpu.obs import fleet
         srv = HttpServer(self.router, self.config.ip, self.config.port)
         self.server = srv
+
+        def _bound(s):
+            # post-bind / pre-serve (the foreground path never returns)
+            self.config.port = s.port
+            self._fleet_id = fleet.register_member(
+                "dashboard", port=s.port, host=self.config.ip)
+
+        srv.on_bound = _bound
         srv.start(background=background)
-        # read the port from the local: a concurrent stop() (signal
-        # handler) may null self.server the instant serve_forever returns
-        self.config.port = srv.port
         return self
 
     def stop(self):
+        from predictionio_tpu.obs import fleet
+        fleet.deregister_member(getattr(self, "_fleet_id", None))
+        self._fleet_id = None
         if self.server:
             self.server.stop()
             self.server = None

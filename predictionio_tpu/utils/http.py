@@ -123,6 +123,20 @@ def fetch_json(url: str, timeout: float = 3.0) -> Any:
         return {"error": str(e)}
 
 
+def fetch_text(url: str, timeout: float = 3.0) -> Optional[str]:
+    """GET a text endpoint (a /metrics scrape); None on any failure or
+    non-200 — the fleet federation treats that as a down member row,
+    not an exception (obs/fleet.py)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if resp.status != 200:
+                return None
+            return resp.read().decode("utf-8", "replace")
+    except Exception:
+        return None
+
+
 def _accepts_gzip(value: str) -> bool:
     """True when an Accept-Encoding value allows gzip — token match, not
     substring (``gzip;q=0`` is an explicit refusal)."""
@@ -176,6 +190,11 @@ class HttpServer:
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # invoked with this server once the socket is bound (port
+        # resolved) but BEFORE serve_forever — the only window where a
+        # foreground server can publish its resolved port (the fleet
+        # member registration, ISSUE 13). Must not raise.
+        self.on_bound: Optional[Callable[["HttpServer"], None]] = None
         # latched by stop(): a stop that lands BEFORE the socket exists
         # (e.g. SIGTERM during the bind-retry window) must still win —
         # start() checks it after binding and tears down immediately
@@ -291,6 +310,11 @@ class HttpServer:
             self._stop_requested = False  # consumed; start() works again
             return
         self._has_served = True
+        if self.on_bound is not None:
+            try:
+                self.on_bound(self)
+            except Exception:
+                logger.exception("on_bound hook failed")
         if background:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)

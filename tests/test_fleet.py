@@ -1,0 +1,733 @@
+"""Fleet observability (ISSUE 13): cross-process trace propagation,
+member registry liveness, metrics/health federation, fleet incident
+capture, and the registry-backed flight GC. Everything here runs
+in-process (co-located servers sharing one tracer); the two-OS-process
+acceptance walk lives in tests/test_fleet_e2e.py (slow lane)."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.api.event_server import (EventServer,
+                                                    EventServerConfig)
+from predictionio_tpu.data.storage import AccessKey, App, Storage
+from predictionio_tpu.data.storage.memory import MemEvents
+from predictionio_tpu.obs import fleet, TRACER
+from predictionio_tpu.obs.trace import (PARENT_SPAN_HEADER, TRACE_HEADER,
+                                        inbound_trace_id,
+                                        ingress_trace_kwargs,
+                                        trace_context_headers)
+from predictionio_tpu.serving import EngineServer, ServerConfig
+
+
+def call(port, path, body=None, headers=None, method=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        headers=headers or {},
+        method=method or ("POST" if body is not None else "GET"))
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            ct = resp.headers.get("Content-Type", "")
+            data = resp.read()
+            return resp.status, (json.loads(data) if "json" in ct
+                                 else data.decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def rate_event(u="u1", i="i1"):
+    return {"event": "rate", "entityType": "user", "entityId": u,
+            "targetEntityType": "item", "targetEntityId": i,
+            "properties": {"rating": 3.0}}
+
+
+@pytest.fixture
+def event_server(tmp_env):
+    app_id = Storage.get_meta_data_apps().insert(App(0, "flapp"))
+    Storage.get_events().init(app_id)
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("flkey", app_id, []))
+    es = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                       stats=True))
+    es.start()
+    yield es
+    es.stop()
+
+
+class _EchoAlgo:
+    query_class = None
+
+    def predict(self, model, q):
+        return {"echo": q}
+
+    def batch_predict(self, model, indexed):
+        return [(i, {"echo": q}) for i, q in indexed]
+
+
+class _EchoServing:
+    def supplement(self, q):
+        return q
+
+    def serve(self, q, preds):
+        return preds[0]
+
+
+@pytest.fixture
+def echo_server(tmp_env):
+    """An engine server with a trivial in-memory pipeline — query-path
+    plumbing without a trained model."""
+    s = EngineServer(ServerConfig(ip="127.0.0.1", port=0,
+                                  micro_batch=0))
+    s.algorithms = [_EchoAlgo()]
+    s.models = [None]
+    s.serving = _EchoServing()
+    s.start()
+    yield s
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# header contract
+# ---------------------------------------------------------------------------
+
+class TestTraceHeaders:
+    def test_headers_inside_trace(self):
+        with TRACER.trace("hdr_test") as t:
+            t.discard = True
+            h = trace_context_headers()
+            assert h[TRACE_HEADER] == t.trace_id
+            pid, span = h[PARENT_SPAN_HEADER].split(":")
+            assert int(pid) == os.getpid()
+            with TRACER.span("child") as sp:
+                h2 = trace_context_headers()
+                assert h2[TRACE_HEADER] == t.trace_id
+                assert h2[PARENT_SPAN_HEADER] == \
+                    f"{os.getpid()}:{sp.span_id}"
+        assert trace_context_headers() == {}
+
+    @pytest.mark.parametrize("raw,ok", [
+        ("deadbeefdeadbeef", True),
+        ("ABCDEF0123456789" * 2, True),
+        ("0f" * 32, True),           # 128-bit foreign tracer
+        ("short", False),            # not hex / too short
+        ("xyzz" * 4, False),
+        ("deadbeef; rm -rf", False),
+        ("a" * 7, False),
+        ("b" * 65, False),
+        ("", False),
+    ])
+    def test_inbound_validation(self, raw, ok):
+        headers = {TRACE_HEADER: raw}
+        got = inbound_trace_id(headers)
+        assert (got == raw) if ok else (got is None)
+
+    def test_ingress_kwargs_carry_remote_parent(self):
+        kw = ingress_trace_kwargs({TRACE_HEADER: "ab" * 8,
+                                   PARENT_SPAN_HEADER: "123:45"})
+        assert kw == {"trace_id": "ab" * 8, "remoteParent": "123:45"}
+        # garbage parent: id still adopted, parent dropped
+        kw = ingress_trace_kwargs({TRACE_HEADER: "ab" * 8,
+                                   PARENT_SPAN_HEADER: "x\n" * 9})
+        assert kw == {"trace_id": "ab" * 8}
+        assert ingress_trace_kwargs({}) == {}
+
+    def test_propagation_cost_is_hot_path_grade(self):
+        """The per-request additions — one header probe on every
+        ingress, one contextvar read on every client hop — must stay
+        far inside the existing <=1% obs-overhead bar (a serve p50 is
+        hundreds of µs at minimum)."""
+        import time as _t
+        empty = {}
+        n = 20_000
+        t0 = _t.perf_counter()
+        for _ in range(n):
+            ingress_trace_kwargs(empty)
+        per_ingress = (_t.perf_counter() - t0) / n
+        t0 = _t.perf_counter()
+        for _ in range(n):
+            trace_context_headers()
+        per_hop = (_t.perf_counter() - t0) / n
+        assert per_ingress < 20e-6, f"{per_ingress * 1e6:.1f}µs"
+        assert per_hop < 20e-6, f"{per_hop * 1e6:.1f}µs"
+
+
+# ---------------------------------------------------------------------------
+# ingress adoption + client injection
+# ---------------------------------------------------------------------------
+
+class TestIngressAdoption:
+    def test_event_post_adopts_inbound_id(self, event_server):
+        tid = "deadbeefdeadbeef"
+        st, resp = call(event_server.config.port,
+                        "/events.json?accessKey=flkey", rate_event(),
+                        headers={TRACE_HEADER: tid,
+                                 PARENT_SPAN_HEADER: "77:3"})
+        assert st == 201
+        assert resp["traceId"] == tid
+        st, body = call(event_server.config.port,
+                        f"/traces.json?trace_id={tid}")
+        assert st == 200 and body["traces"]
+        t = body["traces"][0]
+        assert t["traceId"] == tid
+        assert t["pid"] == os.getpid()
+        assert t["root"]["attrs"]["remoteParent"] == "77:3"
+
+    def test_event_post_garbage_header_mints_fresh(self, event_server):
+        st, resp = call(event_server.config.port,
+                        "/events.json?accessKey=flkey", rate_event(),
+                        headers={TRACE_HEADER: "not-a-trace-id!"})
+        assert st == 201
+        assert resp["traceId"] != "not-a-trace-id!"
+
+    def test_batch_and_columnar_adopt_inbound_id(self, event_server):
+        port = event_server.config.port
+        st, _ = call(port, "/batch/events.json?accessKey=flkey",
+                     [rate_event("u7", "i7")],
+                     headers={TRACE_HEADER: "cafe" * 4})
+        assert st == 200
+        st, body = call(port, "/traces.json?trace_id=" + "cafe" * 4)
+        assert any(t["kind"] == "event_batch" for t in body["traces"])
+        st, resp = call(port, "/events/columnar.json?accessKey=flkey",
+                        {"event": "rate", "entityType": "user",
+                         "entityId": ["u8"], "targetEntityType": "item",
+                         "targetEntityId": ["i8"],
+                         "properties": [{"rating": 4.0}]},
+                        headers={TRACE_HEADER: "beef" * 4})
+        assert st == 201, resp
+        assert resp["traceId"] == "beef" * 4
+
+    def test_query_adopts_inbound_id(self, echo_server):
+        tid = "feed" * 4
+        st, out = call(echo_server.config.port, "/queries.json",
+                       {"user": "u1"}, headers={TRACE_HEADER: tid})
+        assert st == 200 and out == {"echo": {"user": "u1"}}
+        st, body = call(echo_server.config.port,
+                        f"/traces.json?trace_id={tid}")
+        assert body["traces"] and body["traces"][0]["kind"] == "query"
+
+    def test_same_adopted_id_returns_both_legs(self, event_server,
+                                               echo_server):
+        """Co-located servers share one tracer: a query and the
+        feedback-shaped ingest it causes can commit TWO traces under
+        one adopted id — ?trace_id= must return both legs (review
+        finding: the _by_id overwrite used to hide one and ring
+        eviction could unhook the survivor)."""
+        tid = "abad1dea" * 2
+        call(echo_server.config.port, "/queries.json", {"user": "u1"},
+             headers={TRACE_HEADER: tid})
+        call(event_server.config.port, "/events.json?accessKey=flkey",
+             rate_event("u1", "i1"), headers={TRACE_HEADER: tid})
+        st, body = call(event_server.config.port,
+                        f"/traces.json?trace_id={tid}")
+        kinds = {t["kind"] for t in body["traces"]
+                 if t["traceId"] == tid}
+        assert {"query", "event_ingest"} <= kinds
+
+    def test_eventserver_client_injects_context(self, event_server):
+        """A RemoteEvents write made under an active trace reaches the
+        server carrying the id — the server's ingest trace IS the
+        caller's trace (one id, two hops)."""
+        from predictionio_tpu.data.storage.eventserver_client import \
+            RemoteEvents
+        client = RemoteEvents(
+            f"http://127.0.0.1:{event_server.config.port}", "flkey")
+        app_id = Storage.get_meta_data_apps().get_by_name("flapp").id
+        with TRACER.trace("client_hop") as t:
+            t.discard = True
+            eid = client.insert(
+                Event(event="rate", entity_type="user", entity_id="cx",
+                      target_entity_type="item", target_entity_id="i1",
+                      properties=DataMap({"rating": 2.0})), app_id)
+            hop_tid = t.trace_id
+        assert TRACER.trace_id_for_event(eid) == hop_tid
+        client.close()
+
+    def test_event_ids_resolution_route(self, event_server):
+        st, resp = call(event_server.config.port,
+                        "/events.json?accessKey=flkey",
+                        rate_event("u9", "i9"))
+        assert st == 201
+        st, body = call(
+            event_server.config.port,
+            f"/traces.json?event_ids={resp['eventId']},unknown-id")
+        assert st == 200
+        assert body["eventTraces"] == {resp["eventId"]: resp["traceId"]}
+
+
+# ---------------------------------------------------------------------------
+# spill replay preserves the original ingest trace (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestSpillReplayTracePreservation:
+    def test_wal_frames_carry_trace_id(self, tmp_path):
+        from predictionio_tpu.resilience import SpillWAL
+        from predictionio_tpu.resilience.spill import iter_pending
+        wal = SpillWAL(str(tmp_path / "w.wal"))
+        with TRACER.trace("outage_ingest") as t:
+            t.discard = True
+            wal.append(Event(event="rate", entity_type="user",
+                             entity_id="s1"), 1)
+            tid = t.trace_id
+        wal.append(Event(event="rate", entity_type="user",
+                         entity_id="s2"), 1)   # untraced write
+        wal.close()
+        envs = list(iter_pending(str(tmp_path / "w.wal")))
+        assert envs[0]["traceId"] == tid
+        assert "traceId" not in envs[1]
+
+    def test_replay_reregisters_original_trace(self, tmp_path):
+        """A restarted process adopting the WAL (its in-memory event
+        map gone) still replays each event under its ORIGINAL ingest
+        trace id — the outage post-mortem narrative survives."""
+        from predictionio_tpu.obs import MetricsRegistry
+        from predictionio_tpu.resilience import (RetryPolicy,
+                                                 SpillReplayer, SpillWAL)
+        path = str(tmp_path / "w.wal")
+        wal = SpillWAL(path)
+        ids, tids = [], []
+        for i in range(3):
+            with TRACER.trace("outage_ingest") as t:
+                t.discard = True
+                ids.append(wal.append(
+                    Event(event="rate", entity_type="user",
+                          entity_id=f"u{i}", target_entity_type="item",
+                          target_entity_id=f"i{i}",
+                          properties=DataMap({"rating": 1.0})), 1))
+                tids.append(t.trace_id)
+        wal.close()
+        TRACER.clear()          # "restart": the event map is gone
+        wal2 = SpillWAL(path)   # adoption
+        store = MemEvents()
+        r = SpillReplayer(wal2, store,
+                          policy=RetryPolicy(max_attempts=1,
+                                             sleep=lambda s: None),
+                          registry=MetricsRegistry())
+        assert r.drain() == 3
+        for eid, tid in zip(ids, tids):
+            assert TRACER.trace_id_for_event(eid) == tid
+        wal2.close()
+
+
+# ---------------------------------------------------------------------------
+# member registry
+# ---------------------------------------------------------------------------
+
+class TestFleetRegistry:
+    def test_register_heartbeat_deregister(self, tmp_path):
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "fleet"))
+        mid = reg.register("event_server", port=7070, stats=True)
+        assert mid == f"event_server-{os.getpid()}"
+        (m,) = reg.members()
+        assert m["alive"] and m["port"] == 7070 and m["stats"]
+        assert reg.pid_status(os.getpid()) == "live"
+        reg.deregister(mid)
+        assert reg.members() == []
+
+    def test_stale_heartbeat_reads_dead(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_FLEET_LIVENESS_S", "0.5")
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "fleet"))
+        # a crashed member: fabricate its record (pid exists — ours —
+        # but the heartbeat is stale; cross-host shape, so no pid probe)
+        rec = {"memberId": "engine_server-999999", "role":
+               "engine_server", "pid": 999999, "host": "10.0.0.9",
+               "port": 8000, "startedAt": time.time() - 100,
+               "heartbeatAt": time.time() - 10}
+        os.makedirs(reg.fleet_dir(), exist_ok=True)
+        with open(os.path.join(reg.fleet_dir(),
+                               rec["memberId"] + ".json"), "w") as f:
+            json.dump(rec, f)
+        (m,) = reg.members()
+        assert not m["alive"]
+        assert reg.pid_status(999999) == "dead"
+        assert reg.live_members() == []
+
+    def test_sigkill_detected_before_window(self, tmp_path):
+        """A fresh heartbeat with a dead SAME-NODE pid is a corpse the
+        pid probe catches immediately — fleet status must not wait out
+        the liveness window (the smoke script's one-heartbeat bound).
+        The probe is scoped by the record's node identity: a foreign
+        node's pid is never probed (sibling pid namespaces)."""
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "fleet"))
+        rec = {"memberId": "scheduler-999999", "role": "scheduler",
+               "pid": 999999, "host": "127.0.0.1", "port": None,
+               "node": os.uname().nodename,
+               "startedAt": time.time(), "heartbeatAt": time.time()}
+        os.makedirs(reg.fleet_dir(), exist_ok=True)
+        with open(os.path.join(reg.fleet_dir(),
+                               rec["memberId"] + ".json"), "w") as f:
+            json.dump(rec, f)
+        (m,) = reg.members()
+        assert not m["alive"]
+        assert reg.pid_status(999999) == "dead"
+
+    def test_foreign_node_pid_never_probed(self, tmp_path):
+        """The same dead-local-pid record attributed to ANOTHER node
+        stays alive on its fresh heartbeat — a sibling container's pid
+        namespace is not ours to probe (review finding)."""
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "fleet"))
+        rec = {"memberId": "scheduler-999999", "role": "scheduler",
+               "pid": 999999, "host": "127.0.0.1", "port": None,
+               "node": "some-other-container",
+               "startedAt": time.time(), "heartbeatAt": time.time()}
+        os.makedirs(reg.fleet_dir(), exist_ok=True)
+        with open(os.path.join(reg.fleet_dir(),
+                               rec["memberId"] + ".json"), "w") as f:
+            json.dump(rec, f)
+        (m,) = reg.members()
+        assert m["alive"]
+
+    def test_kill_switch(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_FLEET", "off")
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "fleet"))
+        assert reg.register("event_server", port=1) is None
+        assert reg.members() == []
+
+    def test_servers_register_and_deregister(self, event_server):
+        members = fleet.get_fleet().members()
+        es_members = [m for m in members
+                      if m["role"] == "event_server"]
+        assert es_members and es_members[0]["alive"]
+        assert es_members[0]["port"] == event_server.config.port
+
+    def test_scheduler_registers_on_start(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path / "pio"))
+        from predictionio_tpu.online.scheduler import (
+            DeltaTrainingScheduler, SchedulerConfig)
+        sched = DeltaTrainingScheduler.__new__(DeltaTrainingScheduler)
+        # only what start()/stop() touch — a full engine is not needed
+        # to prove registration
+        sched.config = SchedulerConfig(app_name="x",
+                                       poll_interval_s=3600)
+        import threading
+        sched._stop = threading.Event()
+        sched._thread = None
+        sched.consecutive_failures = 0
+        sched.last_error = None
+        sched.retrain_requested = False
+        sched.on_retrain = None
+        sched.start()
+        try:
+            roles = [m["role"] for m in fleet.get_fleet().members()]
+            assert "scheduler" in roles
+        finally:
+            sched.stop()
+        roles = [m["role"] for m in fleet.get_fleet().members()]
+        assert "scheduler" not in roles
+
+
+# ---------------------------------------------------------------------------
+# federation
+# ---------------------------------------------------------------------------
+
+class TestFederation:
+    def test_metrics_federation_relabels(self, event_server,
+                                         echo_server):
+        call(event_server.config.port, "/events.json?accessKey=flkey",
+             rate_event())
+        call(echo_server.config.port, "/queries.json", {"q": 1})
+        fed = fleet.federate_metrics()
+        pid = str(os.getpid())
+        assert (f'pio_event_write_seconds_count'
+                f'{{role="event_server",pid="{pid}"}}') in fed
+        assert (f'pio_engine_query_seconds_count'
+                f'{{role="engine_server",pid="{pid}"}}') in fed
+        # pre-labeled families keep their labels AFTER role/pid
+        assert f'{{role="event_server",pid="{pid}",le="' in fed
+        assert 'pio_fleet_member_up{role="event_server"' in fed
+
+    def test_federation_marks_unreachable_member(self, tmp_path):
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "fleet"))
+        rec = {"memberId": f"engine_server-{os.getpid()}",
+               "role": "engine_server", "pid": os.getpid(),
+               "host": "127.0.0.1", "port": 1,   # nothing listens
+               "startedAt": time.time(), "heartbeatAt": time.time()}
+        os.makedirs(reg.fleet_dir(), exist_ok=True)
+        with open(os.path.join(reg.fleet_dir(),
+                               rec["memberId"] + ".json"), "w") as f:
+            json.dump(rec, f)
+        fed = fleet.federate_metrics(reg.live_members(), timeout_s=0.5)
+        assert 'pio_fleet_member_up{role="engine_server"' in fed
+        assert "} 0" in fed.split("\n")[2]
+
+    def test_fleet_metrics_endpoint(self, event_server, echo_server):
+        st, text = call(echo_server.config.port, "/fleet/metrics")
+        assert st == 200
+        assert 'role="event_server"' in text
+        assert 'role="engine_server"' in text
+
+    def test_fleet_health_rollup_worst_of(self, event_server,
+                                          echo_server):
+        st, body = call(echo_server.config.port, "/fleet/health.json")
+        assert st == 200
+        assert body["status"] in ("ok", "no_data", "burning",
+                                  "breached")
+        names = {s["name"] for s in body["slo"]}
+        # engine + event server SLO sets both present
+        assert "serve_p99" in names and "ingest_write_p99" in names
+        for s in body["slo"]:
+            member_statuses = [v["status"]
+                               for v in s["members"].values()]
+            sev = fleet._SEVERITY
+            assert sev[s["status"]] == max(
+                sev.get(st_, 0) for st_ in member_statuses)
+
+    def test_fleet_status_endpoint_and_traces(self, event_server):
+        st, body = call(event_server.config.port, "/fleet/status.json")
+        assert st == 200 and body["alive"] >= 1
+        # a trace id resolvable fleet-wide through the endpoint
+        st, resp = call(event_server.config.port,
+                        "/events.json?accessKey=flkey",
+                        rate_event("u2", "i2"))
+        st, stitched = call(
+            event_server.config.port,
+            f"/fleet/traces.json?trace_id={resp['traceId']}")
+        assert st == 200
+        assert stitched["pids"] == [os.getpid()]
+        assert any(t["traceId"] == resp["traceId"]
+                   for t in stitched["traces"])
+        assert stitched["traces"][0]["member"]["role"] == "event_server"
+        # trace_id is mandatory
+        st, _ = call(event_server.config.port, "/fleet/traces.json")
+        assert st == 400
+
+    def test_resolve_event_traces_peers(self):
+        """A peer in another process answers the event-id resolution
+        the local tracer cannot: stubbed with a one-route HTTP server
+        (an in-process event server would share this process's tracer
+        and defeat the miss)."""
+        from predictionio_tpu.utils.http import (HttpServer, Response,
+                                                 Router)
+        served = {}
+
+        def traces(req):
+            ids = req.params.get("event_ids", "").split(",")
+            served["ids"] = ids
+            return Response(200, {"eventTraces": {
+                e: "ab" * 8 for e in ids if e == "evt-1"}})
+
+        r = Router()
+        r.add("GET", "/traces.json", traces)
+        srv = HttpServer(r, "127.0.0.1", 0)
+        srv.start()
+        try:
+            peer = {"memberId": "event_server-1", "role":
+                    "event_server", "pid": 1, "host": "127.0.0.1",
+                    "port": srv.port, "heartbeatAt": time.time(),
+                    "startedAt": time.time()}
+            out = fleet.resolve_event_traces(["evt-1", "evt-2"],
+                                             members=[peer])
+            assert out == {"evt-1": "ab" * 8}
+            assert set(served["ids"]) == {"evt-1", "evt-2"}
+            # same-pid members are never queried (they share the
+            # tracer a local miss already consulted)
+            self_peer = dict(peer, pid=os.getpid())
+            served.clear()
+            out = fleet.resolve_event_traces(["evt-1"],
+                                             members=[self_peer])
+            assert out == {} and "ids" not in served
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# incidents: --url surface + fleet capture
+# ---------------------------------------------------------------------------
+
+class TestFleetIncidents:
+    def test_incident_endpoints(self, echo_server, tmp_path,
+                                monkeypatch):
+        from predictionio_tpu.obs.incidents import INCIDENTS
+        monkeypatch.setattr(INCIDENTS, "_dir_override",
+                            str(tmp_path / "inc"))
+        monkeypatch.setattr(INCIDENTS, "_last_by_kind", {})
+        iid = INCIDENTS.capture("unit_test", "endpoint check",
+                                sync=True)
+        assert iid
+        st, body = call(echo_server.config.port, "/incidents.json")
+        assert st == 200
+        assert any(r["id"] == iid for r in body["incidents"])
+        st, bundle = call(echo_server.config.port,
+                          f"/incidents/{iid}.json")
+        assert st == 200 and bundle["kind"] == "unit_test"
+        assert "flight" in bundle
+        st, _ = call(echo_server.config.port,
+                     "/incidents/no-such-incident.json")
+        assert st == 404
+
+    def test_capture_collects_live_peers(self, event_server, tmp_path,
+                                         monkeypatch):
+        """A bundle captured while a (faked-pid) peer is live contains
+        that peer's flight tail, traces and metrics under fleet/<id>/,
+        plus the member roster with liveness."""
+        from predictionio_tpu.obs.incidents import INCIDENTS
+        monkeypatch.setattr(INCIDENTS, "_dir_override",
+                            str(tmp_path / "inc"))
+        monkeypatch.setattr(INCIDENTS, "_last_by_kind", {})
+        # pid 1 exists (the container's init), so the same-host pid
+        # probe agrees the fabricated peer is alive
+        peer_id = "event_server-1"
+        rec = {"memberId": peer_id, "role": "event_server",
+               "pid": 1, "host": "127.0.0.1",
+               "port": event_server.config.port,
+               "heartbeatAt": time.time(), "startedAt": time.time()}
+        os.makedirs(fleet.get_fleet().fleet_dir(), exist_ok=True)
+        path = os.path.join(fleet.get_fleet().fleet_dir(),
+                            peer_id + ".json")
+        with open(path, "w") as f:
+            json.dump(rec, f)
+        try:
+            iid = INCIDENTS.capture("unit_test", "fleet capture",
+                                    sync=True)
+            d = os.path.join(str(tmp_path / "inc"), iid)
+            with open(os.path.join(d, "fleet.json")) as f:
+                roster = json.load(f)["members"]
+            assert any(m["memberId"] == peer_id and m["alive"]
+                       for m in roster)
+            sub = os.path.join(d, "fleet", peer_id)
+            assert os.path.isfile(os.path.join(sub, "flight.jsonl"))
+            assert os.path.isfile(os.path.join(sub, "traces.json"))
+            assert os.path.isfile(os.path.join(sub, "metrics.prom"))
+            with open(os.path.join(sub, "metrics.prom")) as f:
+                assert "pio_event_write_seconds" in f.read()
+        finally:
+            os.remove(path)
+
+
+# ---------------------------------------------------------------------------
+# flight GC liveness via the registry (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestFlightGCUsesRegistry:
+    def _write_series(self, d, pid, n=1):
+        os.makedirs(d, exist_ok=True)
+        names = []
+        for i in range(1, n + 1):
+            name = f"flight-{pid}-{i:06d}.jsonl"
+            with open(os.path.join(d, name), "w") as f:
+                f.write('{"kind":"x"}\n')
+            names.append(name)
+        return names
+
+    def test_live_member_series_never_gcd(self, tmp_path, monkeypatch):
+        """A pid the registry says is LIVE keeps its series even when
+        os.kill cannot see the process (cross-container shape) — and a
+        registry-DEAD pid's series is reclaimable even when an
+        unrelated process reused the pid."""
+        from predictionio_tpu.obs import flight as flight_mod
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path / "pio"))
+        fdir = str(tmp_path / "flight")
+        reg_dir = fleet.get_fleet().fleet_dir()
+        os.makedirs(reg_dir, exist_ok=True)
+        # live member with a pid os.kill says is dead
+        ghost_pid = 999999
+        with open(os.path.join(reg_dir,
+                               f"event_server-{ghost_pid}.json"),
+                  "w") as f:
+            json.dump({"memberId": f"event_server-{ghost_pid}",
+                       "role": "event_server", "pid": ghost_pid,
+                       "host": "10.0.0.9",   # not local: no pid probe
+                       "port": 7070, "heartbeatAt": time.time(),
+                       "startedAt": time.time()}, f)
+        # dead member whose pid an unrelated live process reuses (ours)
+        reused_pid = os.getpid() + 1  # not us; likely alive on a busy
+        #                               box is irrelevant — the record
+        #                               says DEAD, which wins
+        with open(os.path.join(reg_dir,
+                               f"scheduler-{reused_pid}.json"),
+                  "w") as f:
+            json.dump({"memberId": f"scheduler-{reused_pid}",
+                       "role": "scheduler", "pid": reused_pid,
+                       "host": "10.0.0.9", "port": None,
+                       "heartbeatAt": time.time() - 3600,
+                       "startedAt": time.time() - 7200}, f)
+        live_series = self._write_series(fdir, ghost_pid, n=3)
+        dead_series = self._write_series(fdir, reused_pid, n=3)
+        rec = flight_mod.FlightRecorder(flight_dir=fdir, max_files=1)
+        fh, _ = rec._rotate(None)
+        fh.close()
+        left = set(os.listdir(fdir))
+        assert set(live_series) <= left, "live member's series GC'd"
+        assert len([f for f in left if f in dead_series]) <= 1
+
+    def test_unknown_pid_falls_back_to_probe(self, tmp_path):
+        from predictionio_tpu.obs.flight import _pid_is_live
+        assert _pid_is_live(os.getpid())
+        assert not _pid_is_live(2 ** 22 + 7)   # beyond pid_max default
+        assert not _pid_is_live(None)
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+class TestFleetCLI:
+    def test_fleet_status_and_traces(self, event_server, capsys):
+        from predictionio_tpu.tools.cli import main
+        rc = main(["fleet", "status"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "event_server" in out and "UP" in out
+        st, resp = call(event_server.config.port,
+                        "/events.json?accessKey=flkey",
+                        rate_event("u5", "i5"))
+        rc = main(["fleet", "traces", resp["traceId"]])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert resp["traceId"] in out and "event_ingest" in out
+
+    def test_fleet_metrics_cli(self, event_server, capsys):
+        from predictionio_tpu.tools.cli import main
+        rc = main(["fleet", "metrics"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert 'role="event_server"' in out
+
+    def test_fleet_status_reports_dead_member(self, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main
+        d = str(tmp_path / "fleetd")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "engine_server-999999.json"),
+                  "w") as f:
+            json.dump({"memberId": "engine_server-999999",
+                       "role": "engine_server", "pid": 999999,
+                       "host": "127.0.0.1", "port": 8000,
+                       "node": os.uname().nodename,
+                       "heartbeatAt": time.time(),
+                       "startedAt": time.time()}, f)
+        rc = main(["fleet", "status", "--dir", d])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "DEAD" in out
+
+    def test_incidents_list_show_url(self, echo_server, tmp_path,
+                                     monkeypatch, capsys):
+        from predictionio_tpu.obs.incidents import INCIDENTS
+        from predictionio_tpu.tools.cli import main
+        monkeypatch.setattr(INCIDENTS, "_dir_override",
+                            str(tmp_path / "inc"))
+        monkeypatch.setattr(INCIDENTS, "_last_by_kind", {})
+        iid = INCIDENTS.capture("unit_test", "cli url check",
+                                sync=True)
+        url = f"http://127.0.0.1:{echo_server.config.port}"
+        rc = main(["incidents", "list", "--url", url])
+        out = capsys.readouterr().out
+        assert rc == 0 and iid in out
+        rc = main(["incidents", "show", iid, "--url", url])
+        out = capsys.readouterr().out
+        assert rc == 0 and "cli url check" in out
+        rc = main(["incidents", "export", iid, "--url", url])
+        assert rc == 1
+
+    def test_status_telemetry_url(self, echo_server, capsys):
+        from predictionio_tpu.tools.cli import main
+        url = f"http://127.0.0.1:{echo_server.config.port}"
+        main(["status", "--telemetry", "--slo", "--url", url])
+        out = capsys.readouterr().out
+        assert "requests=" in out or "requestCount" in out
+        assert "serve_p99" in out

@@ -1,0 +1,171 @@
+"""Fleet chaos smoke (ISSUE 13 satellite; scripts/fleet_smoke.sh):
+event server + engine server + scheduler booted as THREE OS processes
+on one base_dir, one SIGKILLed — `pio fleet status` must report the
+death within one heartbeat (the same-host pid probe closes the
+fresh-heartbeat window a SIGKILL leaves) while federation of the
+survivors keeps answering."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+EVENT_CHILD = textwrap.dedent("""
+    import json, os, signal
+    from predictionio_tpu.data.storage import registry
+    registry.clear_cache()
+    from predictionio_tpu.data.api.event_server import (EventServer,
+                                                        EventServerConfig)
+    es = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                       stats=True))
+    es.start()
+    print(json.dumps({"port": es.config.port, "pid": os.getpid()}),
+          flush=True)
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    es.stop()
+""")
+
+ENGINE_CHILD = textwrap.dedent("""
+    import json, os, signal
+    from predictionio_tpu.data.storage import registry
+    registry.clear_cache()
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    srv = EngineServer(ServerConfig(
+        ip="127.0.0.1", port=0, engine_id="smoke", engine_version="1",
+        engine_variant="v1", micro_batch=4))
+    srv.load()
+    srv.start()
+    print(json.dumps({"port": srv.config.port, "pid": os.getpid()}),
+          flush=True)
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    srv.stop()
+""")
+
+
+def _spawn(code, env):
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line:
+        raise RuntimeError("child died: " + proc.stderr.read()[-2000:])
+    return proc, json.loads(line)
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_survives_member_death(tmp_path, mesh8, monkeypatch):
+    base = str(tmp_path / "pio")
+    env = dict(
+        os.environ, PIO_FS_BASEDIR=base, JAX_PLATFORMS="cpu",
+        PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="SQLITE",
+        PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="SQLITE",
+        PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="LOCALFS",
+        PIO_STORAGE_SOURCES_SQLITE_TYPE="sqlite",
+        PIO_STORAGE_SOURCES_SQLITE_URL=str(tmp_path / "shared.db"),
+        PIO_STORAGE_SOURCES_LOCALFS_TYPE="localfs",
+        PIO_STORAGE_SOURCES_LOCALFS_HOSTS=str(tmp_path / "models"))
+    for k, v in env.items():
+        if k.startswith("PIO_"):
+            monkeypatch.setenv(k, v)
+    from predictionio_tpu.data.storage import registry as sreg
+    sreg.clear_cache()
+
+    from predictionio_tpu.core import EngineParams
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.storage import AccessKey, App, Storage
+    from predictionio_tpu.models import recommendation as R
+    from predictionio_tpu.obs import fleet
+    from predictionio_tpu.workflow import run_train
+
+    app_id = Storage.get_meta_data_apps().insert(App(0, "smokeapp"))
+    Storage.get_events().init(app_id)
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("smokekey", app_id, []))
+    ev = Storage.get_events()
+    for u in range(6):
+        for i in range(6):
+            ev.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(1 + (u + i) % 5)})),
+                app_id)
+    ep = EngineParams(
+        data_source_params=("", R.DataSourceParams(
+            app_name="smokeapp")),
+        preparator_params=("", R.PreparatorParams()),
+        algorithm_params_list=[("als", R.ALSAlgorithmParams(
+            rank=4, num_iterations=2, lam=0.1, seed=1))],
+        serving_params=("", None))
+    run_train(R.RecommendationEngineFactory.apply(), ep,
+              engine_id="smoke", engine_version="1",
+              engine_variant="v1", engine_factory="recommendation")
+
+    procs = []
+    try:
+        es_proc, es_info = _spawn(EVENT_CHILD, env)
+        procs.append(es_proc)
+        srv_proc, srv_info = _spawn(ENGINE_CHILD, env)
+        procs.append(srv_proc)
+        sched_proc = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.tools.cli",
+             "update", "--follow", "--engine-id", "smoke",
+             "--engine-version", "1", "--engine-json", "v1",
+             "--interval", "1",
+             "--engine-port", str(srv_info["port"])],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        procs.append(sched_proc)
+
+        reg = fleet.FleetRegistry(fleet_dir=os.path.join(base,
+                                                         "fleet"))
+        deadline = time.monotonic() + 120
+        roles = set()
+        while time.monotonic() < deadline:
+            roles = {m["role"] for m in reg.live_members()}
+            if {"event_server", "engine_server",
+                    "scheduler"} <= roles:
+                break
+            for p in procs:
+                assert p.poll() is None, (
+                    "a member died during boot: "
+                    + p.stderr.read()[-2000:])
+            time.sleep(0.5)
+        assert {"event_server", "engine_server", "scheduler"} <= roles
+
+        # SIGKILL the event server: no deregistration, no goodbye
+        os.kill(es_info["pid"], signal.SIGKILL)
+        es_proc.wait(timeout=10)
+        t_kill = time.monotonic()
+        # the death must surface within ONE heartbeat interval
+        time.sleep(fleet.heartbeat_s())
+        members = {m["role"]: m for m in reg.members()}
+        detect_s = time.monotonic() - t_kill
+        assert not members["event_server"]["alive"], (
+            f"death not detected after {detect_s:.1f}s")
+        assert members["engine_server"]["alive"]
+        assert members["scheduler"]["alive"]
+
+        # survivor federation keeps working
+        fed = fleet.federate_metrics(reg.live_members())
+        assert f'role="engine_server",pid="{srv_info["pid"]}"' in fed
+        assert 'role="event_server"' not in fed
+        h = fleet.fleet_health(reg.live_members())
+        assert any(r["memberId"] ==
+                   f"engine_server-{srv_info['pid']}"
+                   for r in h["members"])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        sreg.clear_cache()

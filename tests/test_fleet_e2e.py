@@ -1,0 +1,244 @@
+"""ISSUE 13 acceptance: one trace id emitted by an event POST in one
+OS process is resolvable — via the fleet registry + peer federation —
+into a stitched waterfall containing spans from >= 2 distinct pids.
+
+Topology (the production shape the tentpole exists for):
+
+    child process  — the EVENT SERVER (its own sqlite event store,
+                     --stats; registers `event_server-<childpid>`)
+    this process   — trainer + ENGINE SERVER + attached scheduler,
+                     whose EVENTDATA storage is the `eventserver`
+                     client pointing at the child (every tail read is
+                     a real HTTP hop carrying X-PIO-Trace-Id)
+
+The walk: POST event -> child mints trace T -> scheduler tick in THIS
+process reads the event over the wire, resolves T against the child's
+event map (``/traces.json?event_ids=``, a fleet-peer hop), folds and
+hot-swaps -> ``fleet_traces(T)`` stitches child's event_ingest tree
+and this process's fold_tick tree into one waterfall."""
+
+import datetime as dt
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+UTC = dt.timezone.utc
+
+CHILD = textwrap.dedent("""
+    import json, os, signal, sys, time
+    from predictionio_tpu.data.storage import registry
+    registry.clear_cache()
+    from predictionio_tpu.data.storage import AccessKey, App, Storage
+    from predictionio_tpu.data.api.event_server import (EventServer,
+                                                        EventServerConfig)
+    app_id = Storage.get_meta_data_apps().insert(App(0, "fleete2e"))
+    Storage.get_events().init(app_id)
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("e2ekey", app_id, []))
+    es = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                       stats=True))
+    es.start()
+    print(json.dumps({"port": es.config.port, "pid": os.getpid()}),
+          flush=True)
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    es.stop()
+""")
+
+
+def post(url, body=None, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode() if body is not None else None,
+        headers=headers or {},
+        method="POST" if body is not None else "GET")
+    with urllib.request.urlopen(req, timeout=20) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def two_process_stack(tmp_path, mesh8, monkeypatch):
+    """Child event server process + this-process engine/scheduler whose
+    event store is the wire client. Yields (child pid, child port,
+    engine server, scheduler)."""
+    base = str(tmp_path / "pio")
+    # child: own sqlite metadata/eventdata under the SHARED base_dir
+    # (the fleet registry lives there — that is the point)
+    child_env = dict(
+        os.environ, PIO_FS_BASEDIR=base, JAX_PLATFORMS="cpu",
+        PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="SQLITE",
+        PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="SQLITE",
+        PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="LOCALFS",
+        PIO_STORAGE_SOURCES_SQLITE_TYPE="sqlite",
+        PIO_STORAGE_SOURCES_SQLITE_URL=str(tmp_path / "child.db"),
+        PIO_STORAGE_SOURCES_LOCALFS_TYPE="localfs",
+        PIO_STORAGE_SOURCES_LOCALFS_HOSTS=str(tmp_path / "child-models"))
+    proc = subprocess.Popen([sys.executable, "-c", CHILD],
+                            env=child_env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line:
+        raise RuntimeError("child event server died: "
+                           + proc.stderr.read()[-2000:])
+    info = json.loads(line)
+    port, child_pid = info["port"], info["pid"]
+
+    # this process: metadata/models local, EVENTDATA over the wire
+    monkeypatch.setenv("PIO_FS_BASEDIR", base)
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_NAME",
+                       "pio_meta")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE",
+                       "SQLITE")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME",
+                       "pio_event")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE",
+                       "EVENTSERVER")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_NAME",
+                       "pio_model")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE",
+                       "LOCALFS")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_SQLITE_TYPE", "sqlite")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_SQLITE_URL",
+                       str(tmp_path / "parent.db"))
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_LOCALFS_TYPE", "localfs")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_LOCALFS_HOSTS",
+                       str(tmp_path / "parent-models"))
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_EVENTSERVER_TYPE",
+                       "eventserver")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_EVENTSERVER_URL",
+                       f"http://127.0.0.1:{port}")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_EVENTSERVER_ACCESS_KEY",
+                       "e2ekey")
+    from predictionio_tpu.data.storage import registry as sreg
+    sreg.clear_cache()
+
+    from predictionio_tpu.core import EngineParams
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.models import recommendation as R
+    from predictionio_tpu.online import SchedulerConfig
+    from predictionio_tpu.online.scheduler import attach_scheduler
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    from predictionio_tpu.workflow import run_train
+
+    Storage.get_meta_data_apps().insert(App(0, "fleete2e"))
+    # training corpus written THROUGH the child (the wire client)
+    ev = Storage.get_events()
+    app_id = Storage.get_meta_data_apps().get_by_name("fleete2e").id
+    from predictionio_tpu.data import DataMap, Event
+    for u in range(8):
+        for i in range(8):
+            if (u + i) % 2 == 0:
+                ev.insert(Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{u}", target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"rating": float(1 + (u * i) % 5)})), app_id)
+    ep = EngineParams(
+        data_source_params=("", R.DataSourceParams(
+            app_name="fleete2e")),
+        preparator_params=("", R.PreparatorParams()),
+        algorithm_params_list=[("als", R.ALSAlgorithmParams(
+            rank=4, num_iterations=2, lam=0.1, seed=1))],
+        serving_params=("", None))
+    engine = R.RecommendationEngineFactory.apply()
+    run_train(engine, ep, engine_id="fe2e", engine_version="1",
+              engine_variant="v1", engine_factory="recommendation")
+    srv = EngineServer(ServerConfig(
+        ip="127.0.0.1", port=0, engine_id="fe2e", engine_version="1",
+        engine_variant="v1", micro_batch=4))
+    srv.load()
+    srv.start()
+    sched = attach_scheduler(
+        srv, SchedulerConfig(app_name="fleete2e", max_deltas=1))
+    yield child_pid, port, srv, sched
+    srv.stop()
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    sreg.clear_cache()
+
+
+@pytest.mark.timeout(300)
+class TestTwoProcessTraceStitching:
+    def test_one_trace_id_spans_two_pids(self, two_process_stack):
+        from predictionio_tpu.obs import fleet
+        child_pid, port, srv, sched = two_process_stack
+        assert child_pid != os.getpid()
+
+        # both processes on the shared member registry
+        members = fleet.get_fleet().live_members()
+        by_role = {m["role"]: m for m in members}
+        assert by_role["event_server"]["pid"] == child_pid
+        assert by_role["engine_server"]["pid"] == os.getpid()
+
+        # 1. POST through the CHILD: the 201 carries the ingest trace
+        #    id minted in the child's pid
+        resp = post(f"http://127.0.0.1:{port}/events.json"
+                    f"?accessKey=e2ekey",
+                    {"event": "rate", "entityType": "user",
+                     "entityId": "newbie", "targetEntityType": "item",
+                     "targetEntityId": "i0",
+                     "properties": {"rating": 5.0}})
+        tid = resp["traceId"]
+        assert tid
+
+        # 2. fold in THIS process: the tail read is a wire hop; the
+        #    cross-process resolution links the child's ingest trace
+        swaps_before = srv.swap_count
+        report = sched.tick(force=True)
+        assert report is not None and report["events"] >= 1
+        assert srv.swap_count > swaps_before
+
+        # 3. stitch the trace fleet-wide
+        out = fleet.fleet_traces(tid)
+        assert len(out["pids"]) >= 2, out
+        kinds_by_pid = {}
+        for t in out["traces"]:
+            kinds_by_pid.setdefault(t["pid"], set()).add(t["kind"])
+        assert "event_ingest" in kinds_by_pid[child_pid]
+        assert "fold_tick" in kinds_by_pid[os.getpid()]
+        fold = next(t for t in out["traces"]
+                    if t["kind"] == "fold_tick")
+        assert tid in fold["links"]
+        span_names = {c["name"]
+                      for c in fold["root"].get("children", ())}
+        assert "hot_swap" in span_names
+
+        # 4. the same stitch through a member's HTTP federation surface
+        stitched = post(f"http://127.0.0.1:{port}"
+                        f"/fleet/traces.json?trace_id={tid}")
+        assert len(stitched["pids"]) >= 2
+
+        # 5. ... and through the operator CLI
+        from predictionio_tpu.tools.cli import main
+        assert main(["fleet", "traces", tid]) == 0
+
+    def test_federated_metrics_span_both_pids(self, two_process_stack):
+        from predictionio_tpu.obs import fleet
+        child_pid, port, srv, sched = two_process_stack
+        fed = fleet.federate_metrics()
+        assert f'pid="{child_pid}"' in fed
+        assert f'pid="{os.getpid()}"' in fed
+        # the same body serves at /fleet/metrics on the child
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/fleet/metrics")
+        with urllib.request.urlopen(req, timeout=20) as resp:
+            body = resp.read().decode()
+        assert f'role="engine_server",pid="{os.getpid()}"' in body
+
+    def test_fleet_health_rolls_up_both(self, two_process_stack):
+        from predictionio_tpu.obs import fleet
+        child_pid, port, srv, sched = two_process_stack
+        h = fleet.fleet_health()
+        mids = {r["memberId"] for r in h["members"]}
+        assert f"event_server-{child_pid}" in mids
+        assert f"engine_server-{os.getpid()}" in mids
+        names = {s["name"] for s in h["slo"]}
+        assert "serve_p99" in names and "ingest_write_p99" in names

@@ -203,6 +203,107 @@ class TestExemplarConformance:
             assert set(ex) >= {"traceId", "value"}
 
 
+class TestFleetFederationLint:
+    """ISSUE 13 satellite: the federated ``/fleet/metrics`` merge must
+    itself pass the metric lint — {role,pid} relabeling yields no
+    duplicate or type-clashing series, HELP/TYPE once per family, and
+    the body stays classic-0.0.4-parser safe (exemplar suffixes never
+    survive federation: members are scraped through the default
+    render)."""
+
+    SAMPLE_RE = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? -?[0-9.eE+naif-]+$")
+
+    @pytest.fixture(scope="class")
+    def federated(self, registries):
+        import os
+
+        from predictionio_tpu.obs import fleet
+        from predictionio_tpu.utils.http import (HttpServer, Response,
+                                                 Router)
+        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+
+        def serve(reg):
+            r = Router()
+            r.add("GET", "/metrics",
+                  lambda req: Response(200, reg.render(),
+                                       content_type=CONTENT_TYPE))
+            srv = HttpServer(r, "127.0.0.1", 0)
+            srv.start()
+            return srv
+
+        servers = [serve(registries["engine_server"]),
+                   serve(registries["event_server"]),
+                   serve(registries["engine_server"])]
+        # co-located pair (same pid, distinct roles) + a second
+        # engine_server in "another process" (pid 1): the two collision
+        # shapes federation must keep apart
+        members = [
+            {"memberId": f"engine_server-{os.getpid()}",
+             "role": "engine_server", "pid": os.getpid(),
+             "host": "127.0.0.1", "port": servers[0].port},
+            {"memberId": f"event_server-{os.getpid()}",
+             "role": "event_server", "pid": os.getpid(),
+             "host": "127.0.0.1", "port": servers[1].port},
+            {"memberId": "engine_server-1", "role": "engine_server",
+             "pid": 1, "host": "127.0.0.1", "port": servers[2].port},
+        ]
+        text = fleet.federate_metrics(members)
+        for s in servers:
+            s.stop()
+        return text
+
+    def test_no_duplicate_series(self, federated):
+        seen = {}
+        for line in federated.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            m = self.SAMPLE_RE.match(line)
+            assert m, f"unparseable federated line: {line!r}"
+            key = (m.group(1), m.group(2))
+            assert key not in seen, f"duplicate series: {line!r}"
+            seen[key] = line
+
+    def test_one_type_per_name_no_clashes(self, federated):
+        typed = re.findall(r"^# TYPE (\S+) (\S+)$", federated,
+                           flags=re.M)
+        names = [n for n, _t in typed]
+        assert len(names) == len(set(names)), "TYPE declared twice"
+        # the shared codebase means no member can clash types, so the
+        # drop-on-clash path must never have fired
+        assert "type clashes" not in federated
+
+    def test_every_member_sample_carries_role_and_pid(self, federated):
+        for line in federated.splitlines():
+            if (not line or line.startswith("#")
+                    or line.startswith("pio_fleet_member_up")):
+                continue
+            assert re.match(r'^\S+?\{role="[a-z_]+",pid="\d+"', line), \
+                f"sample without role/pid relabel: {line!r}"
+
+    def test_classic_parser_safe(self, federated):
+        assert " # {" not in federated      # no exemplar suffixes
+        assert "# EOF" not in federated
+        for line in federated.splitlines():
+            if not line:
+                continue
+            assert line.startswith("#") or self.SAMPLE_RE.match(line), \
+                f"{line!r}"
+
+    def test_counter_convention_survives_federation(self, federated):
+        for name, mtype in re.findall(r"^# TYPE (\S+) (\S+)$",
+                                      federated, flags=re.M):
+            if mtype == "counter":
+                assert name.endswith("_total"), name
+
+    def test_member_up_gauge_present(self, federated):
+        assert "# TYPE pio_fleet_member_up gauge" in federated
+        ups = [l for l in federated.splitlines()
+               if l.startswith("pio_fleet_member_up{")]
+        assert len(ups) == 3
+        assert all(l.endswith(" 1") for l in ups)
+
+
 class TestIssue6FamiliesPresent:
     """The diagnostics plane's own families ride both scrapes."""
 

@@ -233,8 +233,8 @@ class TestSpillWAL:
         wal = SpillWAL(str(tmp_path / "w.wal"))
         ids = [wal.append(ev(i), app_id=1) for i in range(5)]
         got = list(wal.pending())
-        assert [e.event_id for _, _, _, e in got] == ids
-        assert [a for _, a, _, _ in got] == [1] * 5
+        assert [e.event_id for _, _, _, e, *_ in got] == ids
+        assert [a for _, a, _, _, _t in got] == [1] * 5
         wal.close()
 
     def test_checkpoint_advances_and_compacts(self, tmp_path):
@@ -244,7 +244,7 @@ class TestSpillWAL:
         records = list(wal.pending())
         wal.checkpoint(records[0][0])
         assert wal.pending_count() == 1
-        assert [e.entity_id for _, _, _, e in wal.pending()] == ["u1"]
+        assert [e.entity_id for _, _, _, e, *_ in wal.pending()] == ["u1"]
         wal.checkpoint(records[1][0])
         assert wal.pending_count() == 0
         # fully drained WAL compacts to zero bytes
@@ -264,7 +264,7 @@ class TestSpillWAL:
             f.write(b"\x40\x00\x00\x00\xde\xad")   # torn mid-append
         wal2 = SpillWAL(path)
         assert wal2.pending_count() == 2            # tail repaired
-        assert [e.entity_id for _, _, _, e in wal2.pending()] \
+        assert [e.entity_id for _, _, _, e, *_ in wal2.pending()] \
             == ["u0", "u1"]
         wal2.close()
 
@@ -277,13 +277,13 @@ class TestSpillWAL:
         wal.checkpoint(first[0])
         wal.close()
         wal2 = SpillWAL(path)
-        assert [e.entity_id for _, _, _, e in wal2.pending()] == ["u1"]
+        assert [e.entity_id for _, _, _, e, *_ in wal2.pending()] == ["u1"]
         wal2.close()
 
     def test_channel_id_round_trips(self, tmp_path):
         wal = SpillWAL(str(tmp_path / "w.wal"))
         wal.append(ev(0), 7, channel_id=3)
-        (_, app_id, channel_id, e), = wal.pending()
+        (_, app_id, channel_id, e, _t), = wal.pending()
         assert (app_id, channel_id) == (7, 3)
         wal.close()
 

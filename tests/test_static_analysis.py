@@ -256,7 +256,7 @@ class TestTriageRegressions:
         assert append_s < 2.0, (
             f"append blocked {append_s:.1f}s behind cursor IO")
         assert wal.pending_count() == 2
-        ids = [e.entity_id for _, _, _, e in wal.pending()]
+        ids = [e.entity_id for _, _, _, e, *_ in wal.pending()]
         assert ids == ["u1", "u2"]
         wal.close()
 
@@ -276,7 +276,7 @@ class TestTriageRegressions:
         wal.close()
         wal2 = SpillWAL(path, fsync=False)
         assert wal2.pending_count() == 2
-        assert [e.entity_id for _, _, _, e in wal2.pending()] \
+        assert [e.entity_id for _, _, _, e, *_ in wal2.pending()] \
             == ["u1", "u2"]
         wal2.close()
 
