@@ -1264,7 +1264,8 @@ def bench_cold_start(full_scale: bool):
     return out
 
 
-def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
+def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3,
+                       openloop=True, result_cache=True):
     """p50 of POST /queries.json against the trained model via the real
     engine server (loopback HTTP). `wait_ms` sets the micro-batcher's
     coalescing window — swept by main() to pick the default from data;
@@ -1300,7 +1301,8 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
     engine = R.RecommendationEngineFactory.apply()
     server = EngineServer(ServerConfig(ip="127.0.0.1", port=0,
                                        micro_batch=32,
-                                       micro_batch_wait_ms=wait_ms),
+                                       micro_batch_wait_ms=wait_ms,
+                                       result_cache=result_cache),
                           engine=engine)
     now = dt.datetime.now(dt.timezone.utc)
     server.engine_instance = EngineInstance(
@@ -1343,10 +1345,15 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
         # path pipelines, so concurrency recovers throughput)
         from concurrent.futures import ThreadPoolExecutor
         n_workers, n_total = 16, 320
-        pool = _PerThreadClients(server.config.port)
+        # pre-framed request bytes + raw-socket round trips: the load
+        # phases measure the SERVER; a fat client on a shared-core
+        # container steals the core from it (PR 7 methodology lesson)
+        pool = _PerThreadClients(server.config.port, fast=True)
+        frames = {int(u): _FastClient.frame(
+            {"user": str(int(u)), "num": 10}) for u in set(users)}
 
         def worker(uid):
-            pool.get().post({"user": str(int(uid)), "num": 10})
+            pool.get().roundtrip(frames[int(uid)])
         jobs = [users[i % len(users)] for i in range(n_total)]
         with ThreadPoolExecutor(n_workers) as ex:
             # untimed warm burst: compiles every power-of-two batch shape
@@ -1383,6 +1390,16 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
                "serve_avg_batch_size": (d_q / d_b if d_b else 0.0),
                "serve_max_batch_size": float(
                    stats.get("maxBatchSize", 0))}
+        # pipelined executor + result cache attribution (ISSUE 14,
+        # schema-additive): what fraction of the headline throughput
+        # the cache answered, and whether windows actually overlapped
+        rc = stats.get("resultCache") or {}
+        if rc.get("hitRate") is not None:
+            out["serve_cache_hit_rate"] = round(float(rc["hitRate"]), 4)
+        if stats.get("pipelined") is not None:
+            out["serve_pipelined"] = bool(stats.get("pipelined"))
+            out["serve_pipeline_stalls"] = float(
+                stats.get("pipelineStalls", 0))
         # registry-derived per-phase percentiles (ISSUE 2): the same
         # bucketed histograms /metrics scrapes, in place of further
         # ad-hoc min/mean keys. Additive — the schema above is stable.
@@ -1420,10 +1437,76 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3):
             out["serve_device_p99_ms"] = dev_pct["p99_ms"]
         out["profiler_overhead_ms"] = round(
             (_PROF.spent_s - prof_pre) * 1000.0, 3)
+        # open-loop phase (ISSUE 14 satellite — the bench-honesty fix):
+        # the closed-loop 16-client loop above hides coordinated
+        # omission — a slow response delays that client's NEXT request,
+        # so queue delay never accumulates into the measured p99. Here
+        # requests fire on a FIXED arrival schedule regardless of
+        # completions, and each latency is measured from the request's
+        # SCHEDULED instant — a response that kept the schedule waiting
+        # is charged its full queue time. Keys are schema-additive
+        # (serve_*_openloop) next to the closed-loop ones; banked
+        # artifacts are never rewritten.
+        if openloop:
+            try:
+                out.update(_serve_openloop(
+                    server.config.port, users,
+                    target_qps=0.7 * out["qps_concurrent16"]))
+            except Exception as e:
+                _beat(f"openloop phase failed: {e}")
         return out
     finally:
         client.close()
         server.stop()
+
+
+def _serve_openloop(port, users, target_qps: float,
+                    duration_s: float = 4.0, workers: int = 32) -> dict:
+    """Fixed-arrival-rate load against a running engine server: one
+    scheduler thread submits on the tick, a worker pool executes, and
+    latency runs scheduled-send -> completion (coordinated-omission-
+    free). The target defaults to 0.7x the measured closed-loop
+    throughput — below saturation, so the p99 reflects service + queue
+    jitter rather than an intentionally overloaded queue."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    target_qps = max(target_qps, 5.0)
+    n = int(min(max(target_qps * duration_s, 50), 4000))
+    interval = 1.0 / target_qps
+    pool = _PerThreadClients(port, fast=True)
+    frames = {int(u): _FastClient.frame(
+        {"user": str(int(u)), "num": 10}) for u in set(users)}
+    lat = [None] * n
+
+    def fire(i, t_sched):
+        # the schedule, not the send, anchors the measurement
+        pool.get().roundtrip(frames[int(users[i % len(users)])])
+        lat[i] = time.perf_counter() - t_sched
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(workers) as ex:
+        futures = []
+        for i in range(n):
+            t_sched = t0 + i * interval
+            delay = t_sched - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futures.append(ex.submit(fire, i, t_sched))
+        errors = sum(1 for f in futures if f.exception() is not None)
+    wall = time.perf_counter() - t0
+    pool.close_all()
+    done = np.array([v for v in lat if v is not None])
+    if not len(done):
+        return {}
+    out = {
+        "serve_openloop_target_qps": float(round(target_qps, 1)),
+        "serve_qps_openloop": float(len(done) / wall),
+        "serve_p50_ms_openloop": float(np.percentile(done, 50) * 1000),
+        "serve_p99_ms_openloop": float(np.percentile(done, 99) * 1000),
+    }
+    if errors:
+        out["serve_openloop_errors"] = int(errors)
+    return out
 
 
 class _Client:
@@ -1480,20 +1563,79 @@ class _Client:
             self.conn = None
 
 
-class _PerThreadClients:
-    """One keep-alive _Client per worker thread (a shared connection
-    would interleave concurrent requests on one socket)."""
+class _FastClient:
+    """wrk-style minimal HTTP/1.1 load client: pre-framed request
+    bytes, one sendall + recv-parse per round trip over a keep-alive
+    socket with TCP_NODELAY. http.client's per-request header
+    assembly and response machinery cost ~100 µs of CLIENT CPU per
+    call — on a shared-core bench container that under-reports the
+    SERVER's throughput (the PR 7 "client shares the generator's GIL"
+    methodology lesson, applied to the serve plane). Still strictly
+    closed-loop: one outstanding request per connection."""
 
     def __init__(self, port):
+        import socket
+        self.sock = socket.create_connection(("127.0.0.1", port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    @staticmethod
+    def frame(body_obj, path="/queries.json") -> bytes:
+        body = json.dumps(body_obj).encode()
+        return (f"POST {path} HTTP/1.1\r\nHost: bench\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: keep-alive\r\n\r\n").encode() + body
+
+    def roundtrip(self, framed: bytes) -> bytes:
+        self.sock.sendall(framed)
+        while b"\r\n\r\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed connection")
+            self._buf += chunk
+        head, _, rest = self._buf.partition(b"\r\n\r\n")
+        status = int(head.split(None, 2)[1])
+        clen = 0
+        for line in head.split(b"\r\n")[1:]:
+            if line[:15].lower() == b"content-length:":
+                clen = int(line[15:])
+                break
+        while len(rest) < clen:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed connection")
+            rest += chunk
+        body, self._buf = rest[:clen], rest[clen:]
+        if status >= 400:
+            raise RuntimeError(f"HTTP {status}: {body[:200]!r}")
+        return body
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _PerThreadClients:
+    """One keep-alive client per worker thread (a shared connection
+    would interleave concurrent requests on one socket).
+    ``fast=True`` hands out _FastClient sockets for the pre-framed
+    load phases."""
+
+    def __init__(self, port, fast: bool = False):
         self.port = port
+        self.fast = fast
         self._tls = threading.local()
         self._all = []
         self._lock = threading.Lock()
 
-    def get(self) -> _Client:
+    def get(self):
         c = getattr(self._tls, "client", None)
         if c is None:
-            c = _Client(self.port)
+            c = _FastClient(self.port) if self.fast \
+                else _Client(self.port)
             self._tls.client = c
             with self._lock:
                 self._all.append(c)
@@ -1792,7 +1934,14 @@ def main():
     if not os.environ.get("PIO_BENCH_SKIP_SERVE_SWEEP"):
         for w in (2.0, 5.0, 10.0):
             _beat(f"serve_sweep wait={w:g}")
-            s = bench_rest_latency(model, n_queries=100, wait_ms=w)
+            # the sweep compares closed-loop coalescing per window
+            # setting; the open-loop phase runs once, on the headline
+            # configuration
+            # cache off: the sweep characterizes the BATCHER per
+            # window setting — repeated hot-user queries answering
+            # from the result cache would never reach it
+            s = bench_rest_latency(model, n_queries=100, wait_ms=w,
+                                   openloop=False, result_cache=False)
             serve_sweep[f"{w:g}"] = {
                 "p50_ms": round(s["p50_ms"], 3),
                 "p99_ms": round(s["p99_ms"], 3),

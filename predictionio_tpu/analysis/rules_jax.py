@@ -63,6 +63,20 @@ JAX005 = register_rule(
     "moves; plane dispatch gets shape-bucketed, deploy-warmed AOT "
     "executables (ISSUE 9).")
 
+JAX006 = register_rule(
+    "JAX006", "host sync in the pipelined serve zone",
+    "A host-synchronizing call — jax.block_until_ready(), .item(), or "
+    "np.asarray()/np.array() on a device value — inside the pipelined "
+    "serving executor's modules (predictionio_tpu/serving/). ISSUE 14 "
+    "keeps the serve path's formation/dispatch/serialization stages "
+    "overlapped with device compute by deferring every readback to "
+    "the completion stage's finish() closures (ops-layer *_begin "
+    "kernels); one stray sync in serving/ code re-serializes the "
+    "pipeline and silently gives back the overlap. The costmon "
+    "1-in-N sampled sync lives in obs/costmon.py, outside this zone "
+    "by construction; result readbacks belong in the ops-layer "
+    "finish() callables, not in serving/ modules.")
+
 _HOT_SEGMENTS = {"serving", "ops", "guard"}
 
 
@@ -336,6 +350,54 @@ def check_jax005(repo: RepoModel) -> List[Finding]:
                 f"{fn.qualname} dispatches jitted {ev.chain[0]} "
                 f"directly on a serve-zone path — no compile-plane "
                 f"resolution (shape buckets / AOT warm) covers it"))
+    return findings
+
+
+#: the pipelined serve zone (ISSUE 14): the executor's own modules,
+#: where NO host sync may appear — readbacks live in the ops-layer
+#: finish() closures and the sampled sync in obs/costmon.py, both
+#: outside this zone. Narrower than the JAX001 hot zone on purpose:
+#: the ops kernels legitimately np.asarray inside their finish()
+#: callables (that IS the completion stage).
+def in_pipelined_zone(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return "serving" in parts[:-1]
+
+
+def check_jax006(repo: RepoModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for key, fn in repo.functions.items():
+        if not in_pipelined_zone(fn.module.relpath):
+            continue
+        tainted = _tainted_names(fn)
+        for ev in fn.events:
+            if ev.kind != "call" or not ev.chain:
+                continue
+            chain, node = ev.chain, ev.node
+            if chain[-1] == "block_until_ready":
+                findings.append(Finding(
+                    JAX006.id, fn.module.relpath, ev.line, fn.qualname,
+                    "block_until_ready",
+                    f"{'.'.join(chain)}() synchronizes on the device "
+                    f"inside the pipelined serve zone — the overlap "
+                    f"ISSUE 14 bought is re-serialized here"))
+                continue
+            if chain[-1] == "item" and len(chain) >= 2:
+                findings.append(Finding(
+                    JAX006.id, fn.module.relpath, ev.line, fn.qualname,
+                    f"item:{chain[-2]}",
+                    f"{'.'.join(chain)}() forces a device sync in the "
+                    f"pipelined serve zone"))
+                continue
+            arg0 = _first_arg_name(node)
+            if arg0 is not None and arg0 in tainted \
+                    and tuple(chain[-2:]) in _NP_CONVERTERS:
+                findings.append(Finding(
+                    JAX006.id, fn.module.relpath, ev.line, fn.qualname,
+                    f"asarray:{arg0}",
+                    f"{'.'.join(chain)}({arg0}) reads a device value "
+                    f"back in the pipelined serve zone — defer it to "
+                    f"the completion stage's finish()"))
     return findings
 
 

@@ -31,6 +31,7 @@ CHECKERS: Sequence[Callable[[RepoModel], List[Finding]]] = (
     rules_jax.check_jax003,
     rules_jax.check_jax004,
     rules_jax.check_jax005,
+    rules_jax.check_jax006,
     rules_cost.check_cost001,
     rules_cost.check_cost002,
     rules_cost.check_cost003,

@@ -573,10 +573,16 @@ class _GroupCommitter:
         # formation wait the moment a record joins the queue
         self._qcv = threading.Condition(self._qlock)
         self._commit_lock = threading.Lock()
-        # wakes followers the moment a group lands or leadership frees
-        # up — polling here (the first cut did 10 ms sleeps) re-created
-        # half the convoy the committer exists to remove
-        self._cv = threading.Condition(threading.Lock())
+        # leadership-handoff signal: set by a retiring leader that
+        # leaves work queued, cleared by the follower that takes over.
+        # Followers themselves wait on their OWN submission's done
+        # event (ISSUE 14 satellite): the previous shared condition's
+        # notify_all woke EVERY waiting follower on EVERY group
+        # completion — at concurrency >= 16 that is 16 GIL wakeups per
+        # group just to re-check state and sleep again, a thundering
+        # herd the per-submission events remove (a group completion now
+        # wakes exactly the completed group's members).
+        self._handoff = threading.Event()
         self.stopped = False
         # single-event writers routed to THIS sub-log and currently
         # between routing and ack: the leader's group-formation wait
@@ -618,15 +624,20 @@ class _GroupCommitter:
         """Drive group commits until ``sub`` completes. Every submitter
         calls this after submit(): it either becomes the leader (drains
         the queue, commits the group — which includes its own records)
-        or finds a leader already at work and sleeps on the condition
-        until a group lands. After its own submission lands, a leader
-        keeps draining up to MAX_EXTRA_DRAINS queued groups — staying
-        warm beats waking a follower — then retires; the followers it
-        wakes take over any still-queued work. The bounded wait is only
-        a backstop for the narrow race where a leader exits exactly as
-        we enqueue."""
+        or finds a leader already at work and sleeps on ITS OWN
+        submission's done event — a group completion wakes exactly that
+        group's members, never the other followers (the notify_all
+        thundering herd this replaces cost one GIL wakeup per follower
+        per group at concurrency >= 16). After its own submission
+        lands, a leader keeps draining up to MAX_EXTRA_DRAINS queued
+        groups — staying warm beats waking a follower — then retires,
+        raising the handoff flag when work remains queued so exactly
+        the followers whose submissions are still pending re-contend
+        for leadership. The bounded wait is only a backstop for the
+        narrow race where a leader exits exactly as we enqueue."""
         while not sub.done.is_set():
             if self._commit_lock.acquire(blocking=False):
+                self._handoff.clear()
                 extra = 0
                 try:
                     if not sub.done.is_set() and self.writers > 1:
@@ -663,19 +674,35 @@ class _GroupCommitter:
                                 break
                 finally:
                     self._commit_lock.release()
-                    with self._cv:
-                        self._cv.notify_all()
+                    # retiring with work still queued: flag the
+                    # handoff so a pending follower claims leadership
+                    # without waiting out its backstop timeout — ONE
+                    # flag read, not a broadcast to every waiter
+                    with self._qlock:
+                        pending = bool(self._queue)
+                    if pending:
+                        self._handoff.set()
                 if sub.done.is_set():
                     break
             else:
-                with self._cv:
-                    # re-check INSIDE the cv: if the leader finished
-                    # (lock free) or our group landed between our
-                    # failed acquire and here, looping beats sleeping —
-                    # the notify we'd wait for may already have fired
-                    if not sub.done.is_set() \
-                            and self._commit_lock.locked():
-                        self._cv.wait(timeout=0.005)
+                if self._handoff.is_set():
+                    # a leader retired leaving queued work (possibly
+                    # ours): CONSUME the flag and re-contend for the
+                    # commit lock. Clearing here is what keeps this a
+                    # wakeup, not a busy-spin — a stale flag (another
+                    # follower already took leadership, or the retiring
+                    # leader re-set it after the taker cleared) would
+                    # otherwise make every waiter loop hot through the
+                    # new leader's whole commit
+                    self._handoff.clear()
+                    continue
+                # wait on OUR OWN completion event: the leader landing
+                # our group sets exactly it (done.set() in
+                # _drain_once) — no herd. The timeout is the backstop
+                # for leader-exit races; MAX_EXTRA_DRAINS makes a
+                # retirement-with-backlog rare, so it is a bound, not
+                # the mechanism.
+                sub.done.wait(timeout=0.005)
         if sub.error is not None:
             raise sub.error
 
@@ -693,9 +720,7 @@ class _GroupCommitter:
             err = e
         for s in subs:
             s.error = err
-            s.done.set()
-        with self._cv:
-            self._cv.notify_all()           # wake this group's waiters
+            s.done.set()   # wakes exactly this group's waiters
         return True
 
     @staticmethod

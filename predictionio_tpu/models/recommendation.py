@@ -544,6 +544,16 @@ class ALSAlgorithm(P2LAlgorithm):
         with zero trace and zero compile. Queries carrying category/year
         filters take a second batched call with per-query candidate
         masks."""
+        return self.batch_predict_begin(model, queries)()
+
+    def batch_predict_begin(self, model, queries):
+        """Two-phase batch predict for the pipelined serving executor
+        (ISSUE 14): partition + enqueue the device top-k NOW (async
+        dispatch returns the moment the work is queued) and return
+        ``finish() -> [(ix, result)]`` performing the deferred
+        device->host readback and result building — the completion
+        stage, callable from another thread, so window N's readback /
+        serialization overlaps window N+1's formation and dispatch."""
         props_of = model.properties_of(self.params.return_properties)
         out = {ix: ItemScoreResult(()) for ix, _ in queries}
         plain, masked = [], []
@@ -554,9 +564,9 @@ class ALSAlgorithm(P2LAlgorithm):
                 continue
             mask = model.allowed_mask(q)
             (plain if mask is None else masked).append((ix, q, uix, mask))
+        plain_fetch = masked_fetch = None
         if plain:
-            from predictionio_tpu.ops.als import users_topk_serve
-            from predictionio_tpu.ops.similarity import unpack_top_k_rows
+            from predictionio_tpu.ops.als import users_topk_serve_begin
             k_max = min(max(q.num for _, q, _, _ in plain),
                         model.als.n_items)
             # compile attribution (obs/costmon): a gates golden-query
@@ -565,30 +575,40 @@ class ALSAlgorithm(P2LAlgorithm):
             from predictionio_tpu.obs import costmon
             with costmon.executable(costmon.BATCH_PREDICT,
                                     defer_to_outer=True):
-                scores, idx = users_topk_serve(
+                plain_fetch = users_topk_serve_begin(
                     model.als, [uix for _, _, uix, _ in plain], k_max)
-            for row, (ix, q, _, _) in enumerate(plain):
-                # bucketed k may exceed n_items: padding slots carry
-                # -inf and are dropped here
-                s, i = unpack_top_k_rows(scores[row], idx[row], q.num)
-                out[ix] = top_scores_to_result(
-                    model.item_ix, s, i, properties_of=props_of)
         if masked:
-            from predictionio_tpu.ops.similarity import (masked_top_k_batch,
-                                                         unpack_top_k_rows)
+            from predictionio_tpu.ops.similarity import \
+                masked_top_k_batch_begin
             from predictionio_tpu.parallel.sharded_table import table_rows
             k_max = max(q.num for _, q, _, _ in masked)
-            scores, idx = masked_top_k_batch(
+            masked_fetch = masked_top_k_batch_begin(
                 model.als.item_factors,
                 table_rows(model.als.user_factors,
                            [uix for _, _, uix, _ in masked]),
                 np.stack([mask for _, _, _, mask in masked]),
                 k_max, filter_positive=False)
-            for row, (ix, q, _, _) in enumerate(masked):
-                s, i = unpack_top_k_rows(scores[row], idx[row], q.num)
-                out[ix] = top_scores_to_result(model.item_ix, s, i,
-                                               properties_of=props_of)
-        return list(out.items())
+
+        def finish():
+            from predictionio_tpu.ops.similarity import unpack_top_k_rows
+            if plain_fetch is not None:
+                scores, idx = plain_fetch()
+                for row, (ix, q, _, _) in enumerate(plain):
+                    # bucketed k may exceed n_items: padding slots carry
+                    # -inf and are dropped here
+                    s, i = unpack_top_k_rows(scores[row], idx[row],
+                                             q.num)
+                    out[ix] = top_scores_to_result(
+                        model.item_ix, s, i, properties_of=props_of)
+            if masked_fetch is not None:
+                scores, idx = masked_fetch()
+                for row, (ix, q, _, _) in enumerate(masked):
+                    s, i = unpack_top_k_rows(scores[row], idx[row],
+                                             q.num)
+                    out[ix] = top_scores_to_result(
+                        model.item_ix, s, i, properties_of=props_of)
+            return list(out.items())
+        return finish
 
 
 class ShardedALSModelCheckpoint(PersistentModel, PersistentModelLoader):

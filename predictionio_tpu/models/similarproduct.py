@@ -516,8 +516,15 @@ class ALSAlgorithm(P2LAlgorithm):
         normalized vector and the whole batch is a single masked matmul +
         top-k device call (vs the reference's per-query driver scan),
         shape-bucketed and AOT-dispatched inside masked_top_k_batch."""
-        from predictionio_tpu.ops.similarity import (masked_top_k_batch,
-                                                     unpack_top_k_rows)
+        return self.batch_predict_begin(model, queries)()
+
+    def batch_predict_begin(self, model, queries):
+        """Two-phase batch predict (ISSUE 14 pipelined executor):
+        enqueue the masked cosine top-k now, defer the device->host
+        readback + result building to the returned ``finish()`` —
+        callable from the completion stage's thread."""
+        from predictionio_tpu.ops.similarity import (
+            masked_top_k_batch_begin, unpack_top_k_rows)
         out = {ix: ItemScoreResult(()) for ix, _ in queries}
         rows = []  # (ix, query, qsum [R], mask [I])
         for ix, q in queries:
@@ -528,18 +535,26 @@ class ALSAlgorithm(P2LAlgorithm):
                 continue
             qsum = model.item_factors_normalized[q_ix].sum(axis=0)
             rows.append((ix, q, qsum, self._build_mask(model, q, q_ix)))
+        fetch = None
         if rows:
             k_max = max(q.num for _, q, _, _ in rows)
-            scores, idx = masked_top_k_batch(
+            fetch = masked_top_k_batch_begin(
                 model.item_factors_normalized,
                 np.stack([r[2] for r in rows]),
                 np.stack([r[3] for r in rows]), k_max)
-            props_of = model.properties_of(self.params.return_properties)
-            for row, (ix, q, _, _) in enumerate(rows):
-                s, i = unpack_top_k_rows(scores[row], idx[row], q.num)
-                out[ix] = top_scores_to_result(model.item_ix, s, i,
-                                               properties_of=props_of)
-        return list(out.items())
+
+        def finish():
+            if fetch is not None:
+                scores, idx = fetch()
+                props_of = model.properties_of(
+                    self.params.return_properties)
+                for row, (ix, q, _, _) in enumerate(rows):
+                    s, i = unpack_top_k_rows(scores[row], idx[row],
+                                             q.num)
+                    out[ix] = top_scores_to_result(
+                        model.item_ix, s, i, properties_of=props_of)
+            return list(out.items())
+        return finish
 
 
 class LikeAlgorithm(ALSAlgorithm):

@@ -376,6 +376,13 @@ def device_timed(label: str, fn, *args):
     return out
 
 
+def occupancy() -> float:
+    """The current ``pio_device_occupancy`` EWMA (0..1) — the adaptive
+    micro-batch sizer's device-pressure signal (ISSUE 14): a lock-free
+    float read, cheap enough for every dispatch decision."""
+    return _occ_ewma
+
+
 def device_time_by_executable() -> Dict[str, float]:
     """{label: estimated device seconds} — the bench/stats view."""
     return {k: round(v, 4)

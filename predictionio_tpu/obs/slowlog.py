@@ -35,10 +35,14 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-#: span-name -> waterfall-stage mapping; order is the waterfall order
+#: span-name -> waterfall-stage mapping; order is the waterfall order.
+#: completion_wait/readback appear on the pipelined executor's windows
+#: (ISSUE 14): the sit in the completion queue and the deferred
+#: device->host fetch that the overlap deferred out of the dispatch.
 _STAGE_SPANS = (
     ("supplement", "supplement"),
     ("predict", "dispatch"),
+    ("readback", "readback"),
     ("post_process", "post_process"),
 )
 
@@ -99,6 +103,12 @@ def build_waterfall(query_trace, batch_trace=None,
         if fm is not None:
             add("batch_formation", float(fm) / 1000.0)
     for span_name, stage in _STAGE_SPANS:
+        if stage == "readback" and batch_trace is not None:
+            # pipelined executor (ISSUE 14): the window's time in the
+            # completion queue precedes its readback
+            cw = batch_trace.root.attrs.get("completionWaitMs")
+            if cw is not None:
+                add("completion_wait", float(cw) / 1000.0)
         s = _find_span(src, span_name)
         if s is None or s.duration_s is None:
             continue

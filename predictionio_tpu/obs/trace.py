@@ -259,6 +259,38 @@ class Tracer:
             if not t.discard:
                 self._commit(t)
 
+    def begin_trace(self, kind: str, **attrs) -> Trace:
+        """Mint a trace WITHOUT making it current or committing it —
+        the cross-thread half of :meth:`trace` for the pipelined
+        serving executor (ISSUE 14): the formation thread begins the
+        ``batch_predict`` trace, each stage re-enters it via
+        :meth:`resume`, and the completion stage's ``resume(...,
+        commit=True)`` ends + commits it."""
+        t = Trace(kind)
+        if attrs:
+            t.root.attrs.update(attrs)
+        return t
+
+    @contextmanager
+    def resume(self, t: Trace, commit: bool = False):
+        """Make an EXISTING (uncommitted) trace current for this
+        thread's scope — spans recorded inside land on it. With
+        ``commit`` the trace's root is ended and the trace committed
+        on exit: the resuming stage is its final owner. Exceptions
+        mark the root span and re-raise (matching :meth:`trace`)."""
+        token = self._ctx.set((t, t.root))
+        try:
+            yield t
+        except BaseException as e:
+            t.root.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._ctx.reset(token)
+            if commit:
+                t.root.end()
+                if not t.discard:
+                    self._commit(t)
+
     @contextmanager
     def span(self, name: str, **attrs):
         """A child span of the current trace; a cheap no-op when no

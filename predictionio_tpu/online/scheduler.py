@@ -664,7 +664,9 @@ class DeltaTrainingScheduler:
         if report.get("retrainRequested") and self.on_retrain is not None:
             self.on_retrain(report)
         try:
-            self._publish(new_models, report)
+            self._publish(new_models, report,
+                          touched_entities={"user": touched_users,
+                                            "item": touched_items})
         except Exception:
             # a publish failure (registry insert, in-process swap) means
             # the SERVED model never advanced: restore the deltas so the
@@ -698,7 +700,13 @@ class DeltaTrainingScheduler:
             if trace_ids:
                 self._pending_trace_ids |= trace_ids
 
-    def _publish(self, models: Sequence[Any], report: dict):
+    def _publish(self, models: Sequence[Any], report: dict,
+                 touched_entities: Optional[dict] = None):
+        """``touched_entities`` ({"user": ids, "item": ids}): the exact
+        rows this fold tick re-solved — forwarded to the attached
+        server's hot-swap so its result cache invalidates per entity
+        instead of clearing (ISSUE 14); a cross-process /reload has no
+        such lineage and clears the remote cache wholesale."""
         version = None
         if self.registry is not None:
             with self._lock:
@@ -725,8 +733,10 @@ class DeltaTrainingScheduler:
                       readPath=report.get("readPath"))
         if self.server is not None:
             with TRACER.span("hot_swap", version=version or ""):
-                self.server.swap_models(models, version=version,
-                                        fold_in_events=report["events"])
+                self.server.swap_models(
+                    models, version=version,
+                    fold_in_events=report["events"],
+                    touched_entities=touched_entities)
         if self.reload_url is not None:
             with TRACER.span("reload", url=self.reload_url):
                 try:

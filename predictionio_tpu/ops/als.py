@@ -887,6 +887,17 @@ def users_topk_serve(model: "ALSModel", user_ixs, k: int
     adopts in the background). Returns ([n, k_b], [n, k_b]) host
     arrays — rows may carry -inf/padding entries past ``model.n_items``
     valid items, which callers drop via their finite-filter."""
+    return users_topk_serve_begin(model, user_ixs, k)()
+
+
+def users_topk_serve_begin(model: "ALSModel", user_ixs, k: int):
+    """Two-phase serve top-k for the pipelined executor (ISSUE 14):
+    enqueue the device program NOW (JAX async dispatch — the call
+    returns as soon as the work is queued) and defer the device->host
+    readback to the returned ``finish() -> (scores, idx)`` callable,
+    so batch formation / supplement / serialization of neighboring
+    windows overlap this window's device compute. ``finish`` is safe
+    to call from another thread; calling it is the only sync."""
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.compile.aot import get_aot
     from predictionio_tpu.obs import costmon
@@ -897,7 +908,7 @@ def users_topk_serve(model: "ALSModel", user_ixs, k: int
     n = user_ixs.shape[0]
     dims = batch_predict_dims(model, n, k)
     if is_sharded(model.item_factors):
-        return _users_topk_serve_sharded(model, user_ixs, dims)
+        return _users_topk_serve_sharded_begin(model, user_ixs, dims)
     ixs = np.zeros(dims["b"], dtype=np.int32)
     ixs[:n] = user_ixs
     U = cached_put_rows(model.user_factors, dims["u"])
@@ -919,11 +930,14 @@ def users_topk_serve(model: "ALSModel", user_ixs, k: int
         aot.ensure(costmon.BATCH_PREDICT,
                    dict(dims, u=B.next_bucket(dims["u"])),
                    background=True)
-    return np.asarray(scores)[:n], np.asarray(idx)[:n]
+
+    def finish() -> Tuple[np.ndarray, np.ndarray]:
+        return np.asarray(scores)[:n], np.asarray(idx)[:n]
+    return finish
 
 
-def _users_topk_serve_sharded(model: "ALSModel", user_ixs: np.ndarray,
-                              dims: dict) -> Tuple[np.ndarray, np.ndarray]:
+def _users_topk_serve_sharded_begin(model: "ALSModel",
+                                    user_ixs: np.ndarray, dims: dict):
     """The sharded serve route of :func:`users_topk_serve`: query
     vectors gathered from the USER table's host shard mirrors (the
     user table needs no serving HBM at all), the item table resident
@@ -931,11 +945,12 @@ def _users_topk_serve_sharded(model: "ALSModel", user_ixs: np.ndarray,
     (ops/topk.batched_sharded_top_k) dispatched through the AOT
     registry under the same ``batch_predict`` label — warmed sharded
     buckets run zero trace / zero compile, exactly like replicated
-    ones."""
+    ones. Returns a ``finish() -> (scores, idx)`` readback callable
+    (the two-phase pipelined contract of users_topk_serve_begin)."""
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.compile.aot import get_aot
     from predictionio_tpu.obs import costmon
-    from predictionio_tpu.ops.topk import batched_sharded_top_k
+    from predictionio_tpu.ops.topk import batched_sharded_top_k_begin
     from predictionio_tpu.parallel.mesh import model_mesh
     from predictionio_tpu.parallel.sharded_table import table_rows
     V = model.item_factors
@@ -948,7 +963,7 @@ def _users_topk_serve_sharded(model: "ALSModel", user_ixs: np.ndarray,
     # handle stays resident — the published model object is never
     # mutated from the serve path (real promotions are the fold
     # tick's job, where the host mirrors must follow)
-    scores, idx = batched_sharded_top_k(
+    fetch = batched_sharded_top_k_begin(
         V.device(mesh, target_rows=dims["i"]), q, model.n_items,
         dims["k"], mesh, label=costmon.BATCH_PREDICT, dims=dims)
     if B.should_promote(model.n_items, dims["i"]):
@@ -957,7 +972,11 @@ def _users_topk_serve_sharded(model: "ALSModel", user_ixs: np.ndarray,
         get_aot().ensure(costmon.BATCH_PREDICT,
                          dict(dims, i=nxt, k=min(dims["k"], nxt)),
                          background=True)
-    return scores[:n], idx[:n]
+
+    def finish() -> Tuple[np.ndarray, np.ndarray]:
+        scores, idx = fetch()
+        return scores[:n], idx[:n]
+    return finish
 
 
 @functools.partial(__import__("jax").jit, static_argnames=("k",))

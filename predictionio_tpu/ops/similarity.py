@@ -127,6 +127,18 @@ def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
     [B, k']) numpy arrays with k' >= min(k, I); rows may contain -inf
     for excluded slots (caller filters non-finite and slices to its
     own num)."""
+    return masked_top_k_batch_begin(item_table, query_vecs, masks, k,
+                                    filter_positive=filter_positive)()
+
+
+def masked_top_k_batch_begin(item_table: np.ndarray,
+                             query_vecs: np.ndarray, masks: np.ndarray,
+                             k: int, filter_positive: bool = True):
+    """Two-phase sibling of :func:`masked_top_k_batch` (ISSUE 14
+    pipelined executor): enqueue the masked ranking and return
+    ``finish() -> (scores, idx)`` which performs the deferred
+    device->host readback, so the completion stage can overlap the
+    next window's formation."""
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.compile.aot import get_aot
     from predictionio_tpu.obs import costmon
@@ -134,8 +146,8 @@ def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
     from predictionio_tpu.utils.device_cache import cached_put_rows
     register_aot_specs()
     if is_sharded(item_table):
-        return _masked_top_k_batch_sharded(item_table, query_vecs,
-                                           masks, k, filter_positive)
+        return _masked_top_k_batch_sharded_begin(
+            item_table, query_vecs, masks, k, filter_positive)
     n_items = item_table.shape[0]
     n = query_vecs.shape[0]
     dims = masked_topk_dims(n_items, query_vecs.shape[1], n, k,
@@ -158,22 +170,26 @@ def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
             dict(dims, i=B.next_bucket(dims["i"]),
                  k=min(k_eff, B.next_bucket(dims["i"]))),
             background=True)
-    return np.asarray(scores)[:n], np.asarray(idx)[:n]
+
+    def finish() -> Tuple[np.ndarray, np.ndarray]:
+        return np.asarray(scores)[:n], np.asarray(idx)[:n]
+    return finish
 
 
-def _masked_top_k_batch_sharded(item_table, query_vecs: np.ndarray,
-                                masks: np.ndarray, k: int,
-                                filter_positive: bool
-                                ) -> Tuple[np.ndarray, np.ndarray]:
+def _masked_top_k_batch_sharded_begin(item_table,
+                                      query_vecs: np.ndarray,
+                                      masks: np.ndarray, k: int,
+                                      filter_positive: bool):
     """Sharded route of :func:`masked_top_k_batch`: the item table
     stays model-sharded in HBM (its resident handle), the padded
     [B, I] candidate mask uploads sharded over the item dim, and the
     ranking is the per-shard top-k + cross-shard merge. Same
     ``batch_predict_masked`` label; the ``s`` dim keeps sharded and
-    replicated buckets from ever aliasing in the AOT registry."""
+    replicated buckets from ever aliasing in the AOT registry.
+    Returns the pipelined ``finish()`` readback callable."""
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.obs import costmon
-    from predictionio_tpu.ops.topk import batched_sharded_top_k
+    from predictionio_tpu.ops.topk import batched_sharded_top_k_begin
     from predictionio_tpu.parallel.mesh import model_mesh
     mesh = model_mesh(item_table.n_shards)
     n_items = item_table.shape[0]
@@ -189,11 +205,15 @@ def _masked_top_k_batch_sharded(item_table, query_vecs: np.ndarray,
     qp[:n] = query_vecs
     mp_ = np.zeros((dims["b"], dims["i"]), dtype=bool)
     mp_[:n, :n_items] = masks
-    scores, idx = batched_sharded_top_k(
+    fetch = batched_sharded_top_k_begin(
         item_table.device(mesh, target_rows=i_b), qp, n_items,
         dims["k"], mesh, masks=mp_, filter_positive=filter_positive,
         label=costmon.BATCH_PREDICT_MASKED, dims=dims)
-    return scores[:n], idx[:n]
+
+    def finish() -> Tuple[np.ndarray, np.ndarray]:
+        scores, idx = fetch()
+        return scores[:n], idx[:n]
+    return finish
 
 
 def unpack_top_k_rows(scores_row: np.ndarray, idx_row: np.ndarray,

@@ -179,6 +179,24 @@ def batched_sharded_top_k(item_dev, query_vecs: np.ndarray,
     item dim. Dispatches through the AOT registry when ``label`` /
     ``dims`` are given (warmed buckets run zero trace / zero
     compile), else calls the shared jit directly."""
+    return batched_sharded_top_k_begin(
+        item_dev, query_vecs, n_items, k_bucket, mesh, masks=masks,
+        filter_positive=filter_positive, label=label, dims=dims)()
+
+
+def batched_sharded_top_k_begin(item_dev, query_vecs: np.ndarray,
+                                n_items: int, k_bucket: int,
+                                mesh: MeshContext,
+                                masks: Optional[np.ndarray] = None,
+                                filter_positive: bool = False,
+                                label: Optional[str] = None,
+                                dims: Optional[dict] = None):
+    """Two-phase sibling of :func:`batched_sharded_top_k` for the
+    pipelined serving executor (ISSUE 14): uploads + enqueues the
+    sharded ranking NOW and returns ``finish() -> (scores, idx)``
+    which performs the deferred device->host readback — so the
+    cross-shard merge of window N overlaps window N+1's host-side
+    batch formation."""
     import jax
     from predictionio_tpu.obs import jaxmon
 
@@ -200,4 +218,7 @@ def batched_sharded_top_k(item_dev, query_vecs: np.ndarray,
     else:
         from predictionio_tpu.obs.costmon import device_timed
         scores, idx = device_timed(label or "sharded_topk", fn, *args)
-    return np.asarray(scores), np.asarray(idx)
+
+    def finish() -> Tuple[np.ndarray, np.ndarray]:
+        return np.asarray(scores), np.asarray(idx)
+    return finish

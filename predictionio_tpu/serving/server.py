@@ -74,6 +74,21 @@ class ServerConfig:
     # optional cap on how long the oldest query may sit in the
     # coalescing stage (ms), for tail-latency-sensitive deployments
     micro_batch_latency_budget_ms: Optional[float] = None
+    # pipelined serving executor (ISSUE 14): device batches allowed in
+    # flight — batch N's device compute overlaps batch N+1's formation
+    # and batch N-1's readback/serialization. None reads
+    # PIO_SERVE_INFLIGHT (default 2); 1 restores the synchronous loop.
+    # Forced to 1 under a multi-process mesh (collective ordering).
+    serve_inflight: Optional[int] = None
+    # adaptive batch sizing (ISSUE 14): scale the coalescing hold with
+    # the pio_device_occupancy EWMA + queue depth instead of the fixed
+    # wait-window, snapping targets to the warmed pow2 AOT buckets
+    adaptive_batching: bool = True
+    # touched-row-invalidated top-k result cache (ISSUE 14;
+    # serving/result_cache.py). PIO_SERVE_CACHE=off also disables.
+    result_cache: bool = True
+    result_cache_max_entries: int = 8192
+    result_cache_max_bytes: int = 64 << 20
     # multi-process mesh serving: per-query broadcast buffer size; raise
     # it when large micro-batched windows of filter-heavy queries exceed
     # the default 64 KiB (every broadcast ships the full buffer, so keep
@@ -210,6 +225,24 @@ class EngineServer:
         # hook under _lock (stop() may run on a /stop route thread)
         self._fleet_id: Optional[str] = None
         self._register_metrics()
+        # pipelined executor + result cache (ISSUE 14): single-process
+        # servers only. Under a multi-process mesh every query is a
+        # collective whose enqueue/readback ordering must stay strictly
+        # serialized across processes — and a cache hit on the primary
+        # alone would (a) skip the collective the workers are waiting
+        # to mirror and (b) keep answering 200 for hot queries after a
+        # worker death, masking the coordinator's loud-503 poisoned
+        # contract (ISSUE 3).
+        single_process = (self.coordinator is None
+                          or not self.coordinator.multi_process)
+        from predictionio_tpu.serving import result_cache as RC
+        self.result_cache = None
+        if config.result_cache and single_process \
+                and RC.cache_enabled():
+            self.result_cache = RC.ResultCache(
+                max_entries=config.result_cache_max_entries,
+                max_bytes=config.result_cache_max_bytes,
+                metrics=self.metrics)
         self.batcher = None
         if config.micro_batch > 1:
             from predictionio_tpu.serving.batcher import MicroBatcher
@@ -217,7 +250,12 @@ class EngineServer:
                 self.handle_query_batch, max_batch=config.micro_batch,
                 max_wait_ms=config.micro_batch_wait_ms,
                 latency_budget_ms=config.micro_batch_latency_budget_ms,
-                metrics=self.metrics)
+                metrics=self.metrics,
+                process_batch_begin=(self.handle_query_batch_begin
+                                     if single_process else None),
+                inflight=(config.serve_inflight
+                          if single_process else 1),
+                adaptive=config.adaptive_batching)
         self.router = self._build_router()
 
     def _register_metrics(self):
@@ -386,6 +424,10 @@ class EngineServer:
                 self.swap_count += 1  # /reload hot-swap, not first load
             logger.info("Engine instance %s loaded (%d algorithm(s))",
                         instance.id, len(self.algorithms))
+        # a full (re)load rebuilds vocabularies/models wholesale — no
+        # touched-row lineage, so every cached ranking is suspect
+        if self.result_cache is not None:
+            self.result_cache.invalidate_all("reload")
         # compile plane (ISSUE 9): AOT-compile the serving executables
         # at deploy time — outside the serving lock (an in-flight query
         # during /reload keeps answering from the jit path meanwhile)
@@ -454,13 +496,20 @@ class EngineServer:
                       canary=candidate_only)
 
     def swap_models(self, models, version: Optional[str] = None,
-                    fold_in_events: int = 0):
+                    fold_in_events: int = 0,
+                    touched_entities: Optional[dict] = None):
         """Atomic in-process hot-swap (the fold-in publish path): replace
         the whole model list under the serving lock so no query ever sees
         a mixed-version set. The query paths snapshot (algorithms, models,
         serving) under the same lock, and fold-in produces NEW model
         objects rather than mutating deployed ones — both halves of the
         no-torn-read guarantee.
+
+        ``touched_entities`` ({"user": ids, "item": ids}, ISSUE 14): the
+        exact rows this publish re-solved — the result cache drops ONLY
+        their entries, so untouched hot users keep their cached rankings
+        across the swap. None (an unattributed model change) clears the
+        whole cache.
 
         Compile plane (ISSUE 9): the incoming models' serving
         executables are AOT-warmed HERE, on the publishing thread,
@@ -502,6 +551,15 @@ class EngineServer:
             # a landed swap ends any stale-model degradation window
             self._last_swap_wall = time.time()
             self.publish_degraded = False
+        if self.result_cache is not None:
+            from predictionio_tpu.serving.result_cache import entity_tags
+            if touched_entities is not None:
+                # fold-tick lineage: drop exactly the touched entities'
+                # entries; untouched cached rankings survive the swap
+                self.result_cache.invalidate_entities(
+                    entity_tags(touched_entities), reason="fold_swap")
+            else:
+                self.result_cache.invalidate_all("swap")
         self._arm_swap_marker(version, models_token=models)
         FLIGHT.record("hot_swap", model_version=version,
                       source="fold_publish",
@@ -564,6 +622,11 @@ class EngineServer:
                 self.last_good_version = self.model_version
                 self._last_swap_wall = time.time()
                 self.publish_degraded = False
+            if self.result_cache is not None:
+                # the staged candidate's touched-row lineage is gone by
+                # promote time; a full clear is the safe contract (a
+                # ROLLBACK keeps the incumbent — entries stay valid)
+                self.result_cache.invalidate_all("canary_promote")
             # the promoted candidate's executables are already resident
             # (warmed at stage): promote -> first query is compile-free
             self._arm_swap_marker(decision["candidateVersion"],
@@ -703,6 +766,24 @@ class EngineServer:
         all queries in the window (serving/batcher.py). Canary routing is
         per WINDOW — a coalesced batch runs against ONE model set, so the
         traffic fraction is realized across windows."""
+        return self.handle_query_batch_begin(query_dicts)()
+
+    def handle_query_batch_begin(self, query_dicts: List[dict]):
+        """Pipelined batch path, stage 1 (ISSUE 14): snapshot the model
+        set, decode + supplement, and ENQUEUE the device call (JAX async
+        dispatch — the call returns the moment the work is queued on the
+        device stream). Returns ``finish() -> List[dict]`` — stage 2:
+        the deferred device->host readback, post-process and per-query
+        result dicts, safe to run on the batcher's completion thread
+        while the next window forms and dispatches.
+
+        Version-mixing safety with K windows in flight: everything a
+        window touches — algorithms, models, serving — is snapshotted
+        here, once, under the serving lock; ``finish`` closes over the
+        snapshot, so a hot-swap/rollback landing mid-flight never mixes
+        versions inside a window (fold-in publishes new model OBJECTS,
+        the deployed ones are immutable)."""
+        import sys
         t0 = time.perf_counter()
         with self._lock:
             algorithms = self.algorithms
@@ -716,56 +797,107 @@ class EngineServer:
         qc = algorithms[0].query_class
         queries = [qc.from_dict(d) if qc is not None else d
                    for d in query_dicts]
+        # the SPMD guard is entered here and exited after the readback:
+        # with pipelining off (mesh / direct calls) finish() runs
+        # immediately, preserving the old guard extent; the pipelined
+        # single-process path gets a nullcontext anyway
+        guard_holder = [self._spmd_guard(query_dicts)]
+        guard_holder[0].__enter__()
+
+        def _exit_guard(exc_info=(None, None, None)):
+            g = guard_holder and guard_holder.pop()
+            if g:
+                g.__exit__(*exc_info)
+
         try:
-            with self._spmd_guard(query_dicts):
-                with TRACER.span("supplement"):
-                    indexed = [(i, serving.supplement(q))
-                               for i, q in enumerate(queries)]
-                tp = time.perf_counter()
-                with TRACER.span("predict", batch=len(queries),
-                                 algorithms=len(algorithms)):
-                    per_algo = [dict(algo.batch_predict(model, indexed))
-                                for algo, model in zip(algorithms, models)]
-                predict_dt = time.perf_counter() - tp
-            out = []
-            with TRACER.span("post_process"):
-                for i, (q, d) in enumerate(zip(queries, query_dicts)):
-                    prediction = serving.serve(
-                        q, [pa[i] for pa in per_algo])
-                    pred_dict = (prediction.to_dict()
-                                 if hasattr(prediction, "to_dict")
-                                 else prediction)
-                    if not isinstance(pred_dict, dict):
-                        pred_dict = {"result": pred_dict}
-                    if self.config.feedback:
-                        pr_id = d.get("prId") or self.engine_instance.id
-                        pred_dict = dict(pred_dict, prId=pr_id)
-                        self._send_feedback(d, pred_dict, pr_id)
-                    out.append(self.plugin_context.apply_output(
-                        self.engine_instance, d, pred_dict))
-        except Exception:
-            self._canary_observe(arm, error=True,
-                                 latency_s=time.perf_counter() - t0,
-                                 n=len(queries))
+            with TRACER.span("supplement"):
+                indexed = [(i, serving.supplement(q))
+                           for i, q in enumerate(queries)]
+            tp = time.perf_counter()
+            with TRACER.span("predict", batch=len(queries),
+                             algorithms=len(algorithms)):
+                fetchers = []
+                for algo, model in zip(algorithms, models):
+                    begin = getattr(algo, "batch_predict_begin", None)
+                    if begin is not None:
+                        fetchers.append(begin(model, indexed))
+                    else:
+                        # no async split for this algorithm: run the
+                        # full (sync) batch predict in this stage —
+                        # correct, just without overlap
+                        res = algo.batch_predict(model, indexed)
+                        fetchers.append(lambda res=res: res)
+            dispatch_dt = time.perf_counter() - tp
+        except BaseException as e:
+            _exit_guard(sys.exc_info())
+            if isinstance(e, Exception):
+                self._canary_observe(arm, error=True,
+                                     latency_s=time.perf_counter() - t0,
+                                     n=len(queries))
             raise
-        dt = time.perf_counter() - t0
-        with self._lock:
-            self.request_count += len(queries)
-            self.serving_seconds += dt
-            self.last_serving_sec = dt / max(len(queries), 1)
-            self.predict_seconds += predict_dt
-            # every query in the window experienced the window's wall
-            # time inside the server: one ring sample per query
-            self._lat_ring.extend([dt] * len(queries))
-        for _ in queries:
-            self._h_query.observe(dt)
-        self._close_swap_marker(arm, models_used=models)
-        self._canary_observe(arm, pred_dicts=out, latency_s=dt,
-                             n=len(queries))
-        if canary_models is not None:
-            out = [dict(d, _pioCanary=canary_version or "candidate")
-                   for d in out]
-        return out
+
+        def finish() -> List[dict]:
+            try:
+                tr = time.perf_counter()
+                with TRACER.span("readback"):
+                    # the deferred device->host fetch — the pipeline's
+                    # ONE inherent sync (results must reach the host to
+                    # serialize); costmon's 1-in-N sampled sync inside
+                    # the dispatch stays the only other deliberate one
+                    per_algo = [dict(f()) for f in fetchers]
+                readback_dt = time.perf_counter() - tr
+            except BaseException as e:
+                _exit_guard(sys.exc_info())
+                if isinstance(e, Exception):
+                    self._canary_observe(
+                        arm, error=True,
+                        latency_s=time.perf_counter() - t0,
+                        n=len(queries))
+                raise
+            _exit_guard()
+            try:
+                out = []
+                with TRACER.span("post_process"):
+                    for i, (q, d) in enumerate(zip(queries,
+                                                   query_dicts)):
+                        prediction = serving.serve(
+                            q, [pa[i] for pa in per_algo])
+                        pred_dict = (prediction.to_dict()
+                                     if hasattr(prediction, "to_dict")
+                                     else prediction)
+                        if not isinstance(pred_dict, dict):
+                            pred_dict = {"result": pred_dict}
+                        if self.config.feedback:
+                            pr_id = (d.get("prId")
+                                     or self.engine_instance.id)
+                            pred_dict = dict(pred_dict, prId=pr_id)
+                            self._send_feedback(d, pred_dict, pr_id)
+                        out.append(self.plugin_context.apply_output(
+                            self.engine_instance, d, pred_dict))
+            except Exception:
+                self._canary_observe(arm, error=True,
+                                     latency_s=time.perf_counter() - t0,
+                                     n=len(queries))
+                raise
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.request_count += len(queries)
+                self.serving_seconds += dt
+                self.last_serving_sec = dt / max(len(queries), 1)
+                self.predict_seconds += dispatch_dt + readback_dt
+                # every query in the window experienced the window's
+                # wall time inside the server: one ring sample each
+                self._lat_ring.extend([dt] * len(queries))
+            for _ in queries:
+                self._h_query.observe(dt)
+            self._close_swap_marker(arm, models_used=models)
+            self._canary_observe(arm, pred_dicts=out, latency_s=dt,
+                                 n=len(queries))
+            if canary_models is not None:
+                return [dict(d, _pioCanary=canary_version or "candidate")
+                        for d in out]
+            return out
+        return finish
 
     # -- feedback loop (:526-596) ------------------------------------------
     def _send_feedback(self, query: dict, prediction: dict, pr_id: str):
@@ -862,10 +994,73 @@ class EngineServer:
         return {"X-PIO-Model-Staleness-Ms":
                 str(int(self.model_staleness_s() * 1000))}
 
+    def _cache_usable(self) -> bool:
+        """The result cache serves/stores only when a response is a
+        pure function of (query, deployed models): no canary split in
+        progress (two model sets answer concurrently), no feedback
+        loop (each query must land its predict event), no output
+        plugins (sniffers must see every prediction)."""
+        if self.result_cache is None:
+            return False
+        if self.canary.active:
+            return False
+        if self.config.feedback:
+            return False
+        p = self.plugin_context.plugins
+        return not any(p.get(k) for k in p)
+
+    @staticmethod
+    def _result_item_ids(out) -> tuple:
+        """Item ids a response ranks (strict-mode invalidation join) —
+        ALL of them: a cap would silently exempt deep rankings from
+        the PIO_SERVE_CACHE_STRICT drop-if-contains-touched-item
+        contract (num is client-bounded, so this stays small)."""
+        try:
+            return tuple(str(s["item"])
+                         for s in out.get("itemScores", ()))
+        except Exception:
+            return ()
+
+    def _serve_cache_hit(self, body: bytes, t_q0: float) -> Response:
+        """Account + answer one result-cache hit (no trace is minted:
+        an empty span tree is not worth a double-digit-percent tax on
+        the measured hit path; hits stay fully counted in the request
+        metrics and latency histogram)."""
+        dt = time.perf_counter() - t_q0
+        with self._lock:
+            self.request_count += 1
+            self.serving_seconds += dt
+            self.last_serving_sec = dt
+            self._lat_ring.append(dt)
+        self._h_query.observe(dt)
+        return Response(200, body, headers=self._degraded_headers())
+
     def _queries(self, req: Request) -> Response:
+        t_q0 = time.perf_counter()
+        # result cache (ISSUE 14): a hit returns the stored serialized
+        # bytes — no queue, no batch, no device, no re-serialization
+        # (byte-identical across hot-swaps that did not touch this
+        # query's entities). The exact-bytes alias answers a repeat
+        # client BEFORE the JSON body is even parsed.
+        from predictionio_tpu.serving import result_cache as RC
+        key = generation = None
+        cacheable = self._cache_usable()
+        if cacheable:
+            body = self.result_cache.get_raw(req.body)
+            if body is not None:
+                return self._serve_cache_hit(body, t_q0)
         d = req.json()
         if not isinstance(d, dict):
             raise ValueError("query must be a JSON object")
+        if cacheable:
+            key = RC.query_key(d)
+            body = self.result_cache.get(key)
+            if body is not None:
+                return self._serve_cache_hit(body, t_q0)
+            # store-time freshness fence: any invalidation landing
+            # while this query computes refuses the store (the result
+            # may reflect the pre-swap models)
+            generation = self.result_cache.generation
         deadline_s = self._request_deadline_s(req)
         # ingress trace: minted per query — or ADOPTED from an inbound
         # X-PIO-Trace-Id (ISSUE 13), so a traced upstream caller's id
@@ -876,7 +1071,6 @@ class EngineServer:
         # answered it.
         with TRACER.trace("query",
                           **ingress_trace_kwargs(req.headers)) as qt:
-            t_q0 = time.perf_counter()
             if self.batcher is not None:
                 out = self.batcher.submit(d, deadline_s=deadline_s)
             else:
@@ -891,13 +1085,29 @@ class EngineServer:
                 version = out.pop("_pioCanary")
                 headers = dict(headers or {})
                 headers["X-PIO-Canary"] = str(version)
+                cacheable = False   # a canary arm answered after all
+            body = None
+            if cacheable and key is not None and isinstance(out, dict):
+                # serialize ONCE: the same bytes answer this request
+                # and every future hit (the serialize stage is paid
+                # exactly once per distinct query per model version)
+                try:
+                    body = json.dumps(out).encode("utf-8")
+                except (TypeError, ValueError):
+                    body = None
+                if body is not None:
+                    self.result_cache.put(
+                        key, body, RC.query_entities(d),
+                        result_items=self._result_item_ids(out),
+                        generation=generation, raw=req.body)
             if total_s >= slow_threshold_s():
                 # slow-query forensics (ISSUE 11): this request already
                 # blew the SLO latency bound — capture its stage
                 # waterfall (all capture work is off the fast path by
                 # construction)
                 self._capture_slow(qt, d, out, total_s)
-            return Response(200, out, headers=headers)
+            return Response(200, body if body is not None else out,
+                            headers=headers)
 
     def _capture_slow(self, qt, query_dict: dict, out, total_s: float):
         """Build + record the slow request's waterfall; never raises
@@ -1025,6 +1235,10 @@ class EngineServer:
                 # realized coalescing (avg/max batch size) — the datum
                 # for tuning micro_batch_wait_ms on a given link
                 out.update(self.batcher.stats())
+            if self.result_cache is not None:
+                # result cache (ISSUE 14): hit rate + residency next
+                # to the coalescing numbers they offload
+                out["resultCache"] = self.result_cache.stats()
             if self.coordinator is not None:
                 out["meshCoordinator"] = self.coordinator.health()
         # AOT registry + persistent-cache state (ISSUE 9 satellite):

@@ -1,0 +1,13 @@
+"""JAX006 true negative: the pipelined executor's idiomatic shape —
+serving-zone code enqueues via the ops-layer begin kernel and hands
+the deferred finish() (which owns the readback, outside this zone) to
+the completion stage; no sync appears here."""
+
+
+def dispatch_window(begin, queries):
+    finish = begin(queries)
+    return finish
+
+
+def complete_window(finish):
+    return finish()

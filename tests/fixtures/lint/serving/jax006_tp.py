@@ -1,0 +1,16 @@
+"""JAX006 true positive: a deliberate device sync inside the
+pipelined serve zone — block_until_ready on the dispatch result
+re-serializes the executor's stage overlap (the readback belongs in
+the completion stage's finish() closure, in the ops layer)."""
+
+import jax
+
+
+def _impl(y):
+    return y * 2.0
+
+
+def complete_window(fn, x):
+    out = fn(x)
+    jax.block_until_ready(out)
+    return out

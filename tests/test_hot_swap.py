@@ -170,8 +170,10 @@ class TestBatcherExitCounters:
     def test_serial_traffic_attributes_to_drain_gate(self, server):
         # server fixture has micro_batch=16 by default config
         port = server.config.port
-        for _ in range(6):
-            call(port, "/queries.json", {"user": "u1", "num": 2})
+        # distinct num per request: repeats of one query would answer
+        # from the result cache (ISSUE 14) without reaching the batcher
+        for i in range(6):
+            call(port, "/queries.json", {"user": "u1", "num": i + 1})
         st, stats = call(port, "/stats.json")
         assert st == 200
         # a lone closed-loop client: every dispatch closed because
@@ -190,5 +192,5 @@ class TestBatcherExitCounters:
             call(port, "/queries.json", {"user": "u2", "num": 1})
         st, stats = call(port, "/stats.json")
         total = (stats["exitDrainGate"] + stats["exitFullBatch"]
-                 + stats["exitWindow"])
+                 + stats["exitWindow"] + stats["exitAdaptive"])
         assert total == stats["batches"]

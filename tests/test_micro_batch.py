@@ -64,14 +64,17 @@ class TestMicroBatcher:
             b.stop()
 
     def test_inflight_straggler_holds_window_and_budget_caps_it(self):
-        """With a straggler counted in flight but never arriving, the
-        dispatcher holds up to max_wait; latency_budget_ms caps it."""
+        """With a straggler counted awaiting dispatch but never
+        arriving, the dispatcher holds up to max_wait (fixed-window
+        mode; the adaptive sizer scales the hold and has its own
+        tests); latency_budget_ms caps it."""
         import time
 
-        held = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=300)
+        held = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=300,
+                            adaptive=False)
         try:
             with held._flight_lock:
-                held._inflight += 1        # phantom straggler
+                held._undispatched += 1    # phantom straggler
             t0 = time.perf_counter()
             held.submit(1)
             assert time.perf_counter() - t0 >= 0.25   # window held
@@ -79,10 +82,10 @@ class TestMicroBatcher:
             held.stop()
 
         capped = MicroBatcher(lambda qs: qs, max_batch=8, max_wait_ms=300,
-                              latency_budget_ms=40)
+                              latency_budget_ms=40, adaptive=False)
         try:
             with capped._flight_lock:
-                capped._inflight += 1
+                capped._undispatched += 1
             t0 = time.perf_counter()
             capped.submit(1)
             assert time.perf_counter() - t0 < 0.2     # budget closed it
@@ -199,11 +202,14 @@ class TestMicroBatchedServer:
         s.stop()
 
     def test_server_stats_include_batching(self, server):
+        # distinct num per request: repeats of one query would answer
+        # from the result cache (ISSUE 14) without reaching the batcher
         with ThreadPoolExecutor(4) as ex:
             list(ex.map(lambda i: urllib.request.urlopen(
                 urllib.request.Request(
                     f"http://127.0.0.1:{server.config.port}/queries.json",
-                    data=json.dumps({"user": "u1", "num": 2}).encode(),
+                    data=json.dumps({"user": "u1",
+                                     "num": i + 1}).encode(),
                     headers={"Content-Type": "application/json"},
                     method="POST"), timeout=30).read(), range(8)))
         stats = json.loads(urllib.request.urlopen(

@@ -1,0 +1,413 @@
+"""Pipelined serving executor (ISSUE 14): overlap, backpressure,
+adaptive sizing, and the hot-swap/in-flight safety contract.
+
+- the two-stage batcher really overlaps: batch N+1 dispatches while
+  batch N awaits completion, bounded by PIO_SERVE_INFLIGHT;
+- error propagation from both stages, drain-on-stop with windows in
+  flight;
+- adaptive batch sizing: pow2-snapped targets driven by occupancy +
+  demand, window scaling, never past max_batch (never a compile);
+- the K>1 in-flight hot-swap hammer: no response mixes model
+  versions, a rollback mid-flight drains cleanly;
+- steady-state pipelined serving compiles nothing once its buckets
+  are warm.
+"""
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models import recommendation as R
+from predictionio_tpu.obs import costmon
+from predictionio_tpu.ops.als import ALSModel
+from predictionio_tpu.serving import EngineServer, ServerConfig
+from predictionio_tpu.serving.batcher import MicroBatcher, ShutdownError
+
+RANK = 4
+VERSION_CONSTS = (1.0, 2.0, 3.0, 4.0)
+ALLOWED_SCORES = {RANK * c for c in VERSION_CONSTS}
+
+
+# ---------------------------------------------------------------------------
+# batcher-level pipeline mechanics
+# ---------------------------------------------------------------------------
+
+class TestPipelinedBatcher:
+    def _pipelined(self, begin, inflight=2, **kw):
+        return MicroBatcher(
+            lambda qs: begin(qs)(), max_batch=8, max_wait_ms=5,
+            process_batch_begin=begin, inflight=inflight,
+            adaptive=False, **kw)
+
+    def test_results_fan_out_correctly(self):
+        def begin(queries):
+            qs = list(queries)
+            return lambda: [q * 10 for q in qs]
+
+        b = self._pipelined(begin)
+        try:
+            with ThreadPoolExecutor(8) as ex:
+                results = list(ex.map(b.submit, range(32)))
+            assert sorted(results) == [i * 10 for i in range(32)]
+            assert b.pipelined
+            assert b._inflight == 0 and b._inflight_batches == 0
+        finally:
+            b.stop()
+
+    def test_windows_overlap(self):
+        """Formation dispatches window N+1 while window N still awaits
+        completion — the overlap the executor exists for."""
+        release = threading.Event()
+        dispatched = []
+
+        def begin(queries):
+            dispatched.append(tuple(queries))
+
+            def finish():
+                release.wait(5)
+                return list(queries)
+            return finish
+
+        b = self._pipelined(begin, inflight=2)
+        try:
+            with ThreadPoolExecutor(4) as ex:
+                f1 = ex.submit(b.submit, 1)
+                # window 1 is dispatched and stuck in finish();
+                # window 2 must still DISPATCH (begin called) before
+                # window 1 completes
+                deadline = time.perf_counter() + 5
+                while not dispatched and time.perf_counter() < deadline:
+                    time.sleep(0.002)
+                f2 = ex.submit(b.submit, 2)
+                deadline = time.perf_counter() + 5
+                while len(dispatched) < 2 \
+                        and time.perf_counter() < deadline:
+                    time.sleep(0.002)
+                assert len(dispatched) >= 2, (
+                    "second window never dispatched while the first "
+                    "was in flight — no overlap")
+                release.set()
+                assert f1.result(timeout=5) == 1
+                assert f2.result(timeout=5) == 2
+        finally:
+            release.set()
+            b.stop()
+
+    def test_backpressure_caps_inflight_windows(self):
+        """At most `inflight` windows sit between dispatch and
+        completion; formation stalls (counted) rather than running
+        ahead unboundedly."""
+        release = threading.Event()
+        max_seen = [0]
+
+        def begin(queries):
+            def finish():
+                release.wait(10)
+                return list(queries)
+            return finish
+
+        b = self._pipelined(begin, inflight=2)
+        try:
+            with ThreadPoolExecutor(6) as ex:
+                futures = [ex.submit(b.submit, i) for i in range(6)]
+                deadline = time.perf_counter() + 3
+                while time.perf_counter() < deadline:
+                    max_seen[0] = max(max_seen[0], b._inflight_batches)
+                    if b.n_pipeline_stalls > 0:
+                        break
+                    time.sleep(0.002)
+                assert max_seen[0] <= 2
+                release.set()
+                assert sorted(f.result(timeout=10)
+                              for f in futures) == list(range(6))
+            assert b.n_pipeline_stalls >= 1
+        finally:
+            release.set()
+            b.stop()
+
+    def test_error_in_finish_propagates_to_all_waiters(self):
+        def begin(queries):
+            def finish():
+                raise RuntimeError("readback boom")
+            return finish
+
+        b = self._pipelined(begin)
+        try:
+            with ThreadPoolExecutor(4) as ex:
+                futures = [ex.submit(b.submit, i) for i in range(4)]
+                for f in futures:
+                    with pytest.raises(RuntimeError, match="boom"):
+                        f.result(timeout=5)
+            assert b._inflight == 0 and b._inflight_batches == 0
+        finally:
+            b.stop()
+
+    def test_error_in_begin_propagates(self):
+        def begin(queries):
+            raise RuntimeError("dispatch boom")
+
+        b = self._pipelined(begin)
+        try:
+            with pytest.raises(RuntimeError, match="dispatch boom"):
+                b.submit(1)
+            assert b._inflight == 0 and b._inflight_batches == 0
+        finally:
+            b.stop()
+
+    def test_stop_completes_dispatched_windows(self):
+        """A window already dispatched when stop() lands has its device
+        work enqueued — the completion thread finishes it; queued-only
+        requests fail loudly."""
+        started = threading.Event()
+        release = threading.Event()
+
+        def begin(queries):
+            started.set()
+
+            def finish():
+                release.wait(5)
+                return list(queries)
+            return finish
+
+        b = self._pipelined(begin, inflight=1)
+        with ThreadPoolExecutor(4) as ex:
+            f1 = ex.submit(b.submit, 1)
+            assert started.wait(5)
+            f2 = ex.submit(b.submit, 2)   # queued behind the in-flight
+            time.sleep(0.05)
+            stopper = ex.submit(b.stop)
+            time.sleep(0.1)
+            release.set()
+            stopper.result(timeout=15)
+            assert f1.result(timeout=5) == 1      # drained, not failed
+            with pytest.raises(ShutdownError):
+                f2.result(timeout=5)
+
+    def test_wrong_result_count_is_error(self):
+        def begin(queries):
+            return lambda: [0]
+
+        b = self._pipelined(begin)
+        try:
+            with ThreadPoolExecutor(2) as ex:
+                futures = [ex.submit(b.submit, i) for i in range(2)]
+                errors = 0
+                for f in futures:
+                    try:
+                        f.result(timeout=5)
+                    except RuntimeError:
+                        errors += 1
+            assert errors in (0, 2)
+        finally:
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# adaptive batch sizing
+# ---------------------------------------------------------------------------
+
+class TestAdaptiveSizing:
+    def test_target_is_pow2_and_capped(self, monkeypatch):
+        b = MicroBatcher(lambda qs: qs, max_batch=16, max_wait_ms=5,
+                         adaptive=True)
+        try:
+            monkeypatch.setattr(costmon, "occupancy", lambda: 0.0)
+            for undispatched in (1, 3, 5, 9, 40):
+                b._undispatched = undispatched
+                t = b._target_batch()
+                assert t <= 16
+                assert t & (t - 1) == 0, f"target {t} not a pow2"
+        finally:
+            b.stop()
+
+    def test_busy_device_raises_target_idle_lowers_it(self, monkeypatch):
+        b = MicroBatcher(lambda qs: qs, max_batch=16, max_wait_ms=5,
+                         adaptive=True)
+        try:
+            b._undispatched = 5
+            monkeypatch.setattr(costmon, "occupancy", lambda: 0.0)
+            idle_target = b._target_batch()
+            monkeypatch.setattr(costmon, "occupancy", lambda: 0.9)
+            busy_target = b._target_batch()
+            assert busy_target >= idle_target
+            assert idle_target == 8      # bucket over demand 5
+            assert busy_target == 16     # one bucket higher, capped
+        finally:
+            b.stop()
+
+    def test_window_scales_with_occupancy(self, monkeypatch):
+        b = MicroBatcher(lambda qs: qs, max_batch=16, max_wait_ms=100,
+                         adaptive=True)
+        try:
+            p = type("P", (), {"t_enqueue": 0.0})()
+            monkeypatch.setattr(costmon, "occupancy", lambda: 0.0)
+            short = b._window_deadline(0.0, p)
+            monkeypatch.setattr(costmon, "occupancy", lambda: 1.0)
+            full = b._window_deadline(0.0, p)
+            assert short == pytest.approx(0.025, rel=0.01)  # 0.25x
+            assert full == pytest.approx(0.100, rel=0.01)   # capped 1x
+        finally:
+            b.stop()
+
+    def test_adaptive_snap_dispatches_at_bucket(self, monkeypatch):
+        """With demand covered at a pow2 boundary and stragglers still
+        counted in flight, the window dispatches at the bucket instead
+        of holding for them (exit reason `adaptive`)."""
+        monkeypatch.setattr(costmon, "occupancy", lambda: 0.0)
+        release = threading.Event()
+
+        def handler(qs):
+            release.wait(2)
+            return list(qs)
+
+        b = MicroBatcher(handler, max_batch=16, max_wait_ms=500,
+                         adaptive=True)
+        try:
+            with ThreadPoolExecutor(8) as ex:
+                futures = [ex.submit(b.submit, i) for i in range(4)]
+                time.sleep(0.1)   # all 4 queued against held handler
+                # phantom stragglers: adaptive target (bucket over
+                # demand 4 = 4) is met, so the window must NOT hold
+                # the 500 ms straggler window
+                with b._flight_lock:
+                    b._undispatched += 2
+                release.set()
+                t0 = time.perf_counter()
+                for f in futures:
+                    f.result(timeout=5)
+                assert time.perf_counter() - t0 < 0.45
+            with b._flight_lock:
+                b._undispatched -= 2
+            assert b.n_exit_adaptive + b.n_exit_drain_gate \
+                + b.n_exit_full + b.n_exit_window == b.n_batches
+        finally:
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# server-level: K>1 in-flight hot-swap hammer
+# ---------------------------------------------------------------------------
+
+def _const_model(n_users=32, n_items=24, c=1.0) -> R.RecommendationModel:
+    from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap
+    user_ix = EntityIdIxMap(BiMap({f"u{i}": i for i in range(n_users)}))
+    item_ix = EntityIdIxMap(BiMap({f"i{i}": i for i in range(n_items)}))
+    als = ALSModel(
+        user_factors=np.full((n_users, RANK), c, dtype=np.float32),
+        item_factors=np.ones((n_items, RANK), dtype=np.float32),
+        rank=RANK)
+    return R.RecommendationModel(als, user_ix, item_ix)
+
+
+def _pipelined_server(inflight=3, micro_batch=8, result_cache=False):
+    engine = R.RecommendationEngineFactory.apply()
+    server = EngineServer(
+        ServerConfig(ip="127.0.0.1", port=0, micro_batch=micro_batch,
+                     micro_batch_wait_ms=2.0, serve_inflight=inflight,
+                     result_cache=result_cache),
+        engine=engine)
+    algo = R.ALSAlgorithm(R.ALSAlgorithmParams(rank=RANK))
+    server.algorithms = [algo]
+    server.models = [_const_model(c=VERSION_CONSTS[0])]
+    from predictionio_tpu.core import FirstServing
+    server.serving = FirstServing()
+    server.model_version = "v-0"
+    return server
+
+
+class TestInFlightHotSwapHammer:
+    def test_no_version_mixing_with_k_inflight(self, tmp_env, mesh8):
+        """4 hammer threads through a 3-deep pipelined batcher while
+        versions hot-swap: every response's scores come from exactly
+        ONE version constant — a window begun against version A must
+        complete against A even when B swapped in mid-flight."""
+        server = _pipelined_server(inflight=3)
+        assert server.batcher.pipelined
+        try:
+            stop = threading.Event()
+            failures = []
+            n_ok = [0]
+
+            def hammer(tid):
+                while not stop.is_set():
+                    try:
+                        out = server.batcher.submit(
+                            {"user": f"u{tid}", "num": 3})
+                    except Exception as e:
+                        failures.append(("error", repr(e)))
+                        continue
+                    scores = {s["score"] for s in out["itemScores"]}
+                    if len(scores) > 1:
+                        failures.append(("torn", sorted(scores)))
+                    elif scores and not scores <= ALLOWED_SCORES:
+                        failures.append(("alien", sorted(scores)))
+                    n_ok[0] += 1
+
+            threads = [threading.Thread(target=hammer, args=(t,))
+                       for t in range(4)]
+            for t in threads:
+                t.start()
+            for k, c in enumerate(VERSION_CONSTS[1:], start=1):
+                server.swap_models([_const_model(c=c)], version=f"v-{k}",
+                                   touched_entities={"user": [],
+                                                     "item": []})
+                deadline_n = n_ok[0] + 25
+                deadline = time.perf_counter() + 20
+                while n_ok[0] < deadline_n and not failures \
+                        and time.perf_counter() < deadline:
+                    time.sleep(0.001)
+            # rollback mid-flight: swap back to the first version while
+            # the hammer keeps windows in flight — must drain cleanly
+            server.swap_models([_const_model(c=VERSION_CONSTS[0])],
+                               version="v-0")
+            deadline_n = n_ok[0] + 25
+            deadline = time.perf_counter() + 20
+            while n_ok[0] < deadline_n and not failures \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.001)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads), "hammer hung"
+            assert not failures, failures[:5]
+            assert n_ok[0] > 100
+            # drained: nothing left in flight after the hammer stops
+            deadline = time.perf_counter() + 5
+            while (server.batcher._inflight
+                   or server.batcher._inflight_batches) \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert server.batcher._inflight == 0
+            assert server.batcher._inflight_batches == 0
+        finally:
+            server.batcher.stop()
+
+    def test_steady_state_pipelined_serving_compiles_nothing(
+            self, tmp_env, mesh8):
+        """Once the pow2 batch buckets are warm, a pipelined sweep over
+        every batch size adds ZERO attributed compile seconds (the
+        ISSUE 9 acceptance, extended to the pipelined executor)."""
+        server = _pipelined_server(inflight=2)
+        try:
+            def run_sweep():
+                with ThreadPoolExecutor(8) as ex:
+                    list(ex.map(
+                        lambda i: server.batcher.submit(
+                            {"user": f"u{i % 8}", "num": 3}),
+                        range(48)))
+
+            run_sweep()   # warm every bucket the load shape produces
+            before = sum(
+                costmon.compile_seconds_by_executable().values())
+            run_sweep()
+            after = sum(
+                costmon.compile_seconds_by_executable().values())
+            assert after == before, (
+                f"steady-state pipelined sweep compiled "
+                f"{after - before:.3f}s")
+        finally:
+            server.batcher.stop()

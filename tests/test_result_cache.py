@@ -1,0 +1,266 @@
+"""Result-cache correctness suite (ISSUE 14 tentpole c).
+
+Unit surface: LRU + entry/byte budget, entity indexing, generation
+fence, strict item mode. Server-level contract: a fold-tick swap
+touching user u invalidates EXACTLY u's entry; untouched entries are
+byte-identical across the swap; an unattributed swap/reload clears
+everything; the contract holds for replicated AND model-sharded
+factor-table layouts; telemetry names appear on /metrics.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models import recommendation as R
+from predictionio_tpu.ops.als import ALSModel
+from predictionio_tpu.serving import EngineServer, ServerConfig
+from predictionio_tpu.serving.result_cache import (ResultCache,
+                                                   entity_tags,
+                                                   query_entities,
+                                                   query_key)
+from predictionio_tpu.utils.http import Headers, Request
+
+RANK = 4
+
+
+# ---------------------------------------------------------------------------
+# unit surface
+# ---------------------------------------------------------------------------
+
+class TestResultCacheUnit:
+    def test_roundtrip_and_hit_miss_counters(self):
+        c = ResultCache()
+        k = query_key({"user": "u1", "num": 3})
+        assert c.get(k) is None
+        assert c.put(k, b'{"a":1}', query_entities({"user": "u1"}))
+        assert c.get(k) == b'{"a":1}'
+        assert c.hits == 1 and c.misses == 1
+
+    def test_key_canonicalization(self):
+        assert query_key({"num": 3, "user": "u1"}) \
+            == query_key({"user": "u1", "num": 3})
+        assert query_key({"user": "u1", "num": 4}) \
+            != query_key({"user": "u1", "num": 3})
+
+    def test_entity_tags_from_query_shapes(self):
+        assert query_entities({"user": "u1", "num": 1}) == ("user:u1",)
+        assert set(query_entities({"items": ["i1", "i2"]})) \
+            == {"item:i1", "item:i2"}
+        assert entity_tags({"user": ["a"], "item": ["b"]}) \
+            == ["user:a", "item:b"]
+
+    def test_invalidate_exactly_touched_user(self):
+        c = ResultCache()
+        for u in ("u1", "u2", "u3"):
+            c.put(query_key({"user": u}), f"body-{u}".encode(),
+                  query_entities({"user": u}))
+        dropped = c.invalidate_entities(["user:u2"])
+        assert dropped == 1
+        assert c.get(query_key({"user": "u1"})) == b"body-u1"
+        assert c.get(query_key({"user": "u2"})) is None
+        assert c.get(query_key({"user": "u3"})) == b"body-u3"
+        assert c.invalidations.get("fold_swap") == 1
+
+    def test_invalidate_all(self):
+        c = ResultCache()
+        c.put(query_key({"user": "u1"}), b"x",
+              query_entities({"user": "u1"}))
+        assert c.invalidate_all("reload") == 1
+        assert c.get(query_key({"user": "u1"})) is None
+
+    def test_entry_budget_lru(self):
+        c = ResultCache(max_entries=3)
+        for i in range(5):
+            c.put(query_key({"user": f"u{i}"}), b"x",
+                  query_entities({"user": f"u{i}"}))
+        assert len(c._entries) == 3
+        assert c.evictions == 2
+        # oldest evicted, newest resident
+        assert c.get(query_key({"user": "u0"})) is None
+        assert c.get(query_key({"user": "u4"})) == b"x"
+
+    def test_byte_budget(self):
+        c = ResultCache(max_entries=100, max_bytes=100)
+        for i in range(10):
+            c.put(query_key({"user": f"u{i}"}), b"x" * 20,
+                  query_entities({"user": f"u{i}"}))
+        assert c._bytes <= 100
+
+    def test_oversized_body_refused(self):
+        c = ResultCache(max_bytes=100)
+        assert not c.put(query_key({"user": "u"}), b"x" * 50,
+                         ("user:u",))
+
+    def test_generation_fence_refuses_stale_store(self):
+        c = ResultCache()
+        g = c.generation
+        c.invalidate_all("swap")   # a swap landed while computing
+        assert not c.put(query_key({"user": "u"}), b"x", ("user:u",),
+                         generation=g)
+        assert c.put(query_key({"user": "u"}), b"x", ("user:u",),
+                     generation=c.generation)
+
+    def test_strict_mode_drops_entries_containing_touched_item(
+            self, monkeypatch):
+        c = ResultCache()
+        c.put(query_key({"user": "u1"}), b"a", ("user:u1",),
+              result_items=("i5", "i6"))
+        c.put(query_key({"user": "u2"}), b"b", ("user:u2",),
+              result_items=("i7",))
+        monkeypatch.setenv("PIO_SERVE_CACHE_STRICT", "1")
+        dropped = c.invalidate_entities(["item:i5"])
+        assert dropped == 1
+        assert c.get(query_key({"user": "u1"})) is None
+        assert c.get(query_key({"user": "u2"})) == b"b"
+
+    def test_default_mode_keeps_other_users_on_item_touch(self):
+        """The documented staleness trade: without strict mode, a
+        touched ITEM drops only entries registered under it (queries
+        naming it), not every ranking that contains it."""
+        c = ResultCache()
+        c.put(query_key({"user": "u1"}), b"a", ("user:u1",),
+              result_items=("i5",))
+        assert c.invalidate_entities(["item:i5"]) == 0
+        assert c.get(query_key({"user": "u1"})) == b"a"
+
+
+# ---------------------------------------------------------------------------
+# server-level contract
+# ---------------------------------------------------------------------------
+
+def _model(c_per_user, n_items=12) -> R.RecommendationModel:
+    """User u's every score is exactly RANK * c_per_user[u] — entries
+    are distinguishable per user and per version from the body alone."""
+    from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap
+    users = sorted(c_per_user)
+    user_ix = EntityIdIxMap(
+        BiMap({u: i for i, u in enumerate(users)}))
+    item_ix = EntityIdIxMap(
+        BiMap({f"i{i}": i for i in range(n_items)}))
+    uf = np.stack([np.full(RANK, c_per_user[u], dtype=np.float32)
+                   for u in users])
+    als = ALSModel(user_factors=uf,
+                   item_factors=np.ones((n_items, RANK),
+                                        dtype=np.float32),
+                   rank=RANK)
+    return R.RecommendationModel(als, user_ix, item_ix)
+
+
+def _server(model, result_cache=True, micro_batch=4):
+    engine = R.RecommendationEngineFactory.apply()
+    s = EngineServer(
+        ServerConfig(ip="127.0.0.1", port=0, micro_batch=micro_batch,
+                     micro_batch_wait_ms=1.0,
+                     result_cache=result_cache),
+        engine=engine)
+    s.algorithms = [R.ALSAlgorithm(R.ALSAlgorithmParams(rank=RANK))]
+    s.models = [model]
+    from predictionio_tpu.core import FirstServing
+    s.serving = FirstServing()
+    return s
+
+
+def _ask(server, user, num=3) -> bytes:
+    req = Request("POST", "/queries.json", {}, Headers(),
+                  json.dumps({"user": user, "num": num}).encode())
+    resp = server._queries(req)
+    assert resp.status == 200
+    return resp.payload()
+
+
+@pytest.fixture(params=["replicated", "sharded"])
+def layout_server(request, tmp_env, mesh8):
+    base = {"u1": 1.0, "u2": 2.0, "u3": 3.0}
+    m = _model(base)
+    if request.param == "sharded":
+        from predictionio_tpu.parallel.sharded_table import ShardedTable
+        m = R.RecommendationModel(
+            ALSModel(ShardedTable.from_host(m.als.user_factors, 4),
+                     ShardedTable.from_host(m.als.item_factors, 4),
+                     RANK),
+            m.user_ix, m.item_ix)
+    s = _server(m)
+    try:
+        yield s, base
+    finally:
+        if s.batcher is not None:
+            s.batcher.stop()
+
+
+class TestServerCacheContract:
+    def test_hit_skips_pipeline_and_is_byte_identical(
+            self, layout_server):
+        s, base = layout_server
+        first = _ask(s, "u1")
+        batches_after_first = s.batcher.n_batches
+        again = _ask(s, "u1")
+        assert again == first                      # byte-identical
+        assert s.batcher.n_batches == batches_after_first  # no dispatch
+        assert s.result_cache.hits == 1
+
+    def test_fold_swap_invalidates_exactly_touched_user(
+            self, layout_server):
+        """The acceptance wording verbatim: fold-tick touching user u
+        invalidates exactly u's entry; untouched entries byte-identical
+        across the swap — replicated and sharded layouts."""
+        s, base = layout_server
+        bodies = {u: _ask(s, u) for u in ("u1", "u2", "u3")}
+        assert len(s.result_cache._entries) == 3
+        # the fold tick re-solved u2's row: same scores for u1/u3, a
+        # new constant for u2 (the new model OBJECT is what swaps in)
+        new = dict(base, u2=9.0)
+        swapped = _model(new)
+        if hasattr(s.models[0].als.user_factors, "n_shards"):
+            from predictionio_tpu.parallel.sharded_table import \
+                ShardedTable
+            swapped = R.RecommendationModel(
+                ALSModel(
+                    ShardedTable.from_host(
+                        swapped.als.user_factors, 4),
+                    ShardedTable.from_host(
+                        swapped.als.item_factors, 4),
+                    RANK),
+                swapped.user_ix, swapped.item_ix)
+        s.swap_models([swapped], version="fold-1",
+                      touched_entities={"user": ["u2"], "item": []})
+        assert len(s.result_cache._entries) == 2   # exactly u2 dropped
+        hits_before = s.result_cache.hits
+        assert _ask(s, "u1") == bodies["u1"]       # byte-identical hit
+        assert _ask(s, "u3") == bodies["u3"]
+        assert s.result_cache.hits == hits_before + 2
+        fresh = _ask(s, "u2")                      # recomputed
+        assert fresh != bodies["u2"]
+        assert json.loads(fresh)["itemScores"][0]["score"] \
+            == RANK * 9.0
+
+    def test_unattributed_swap_clears_everything(self, layout_server):
+        s, base = layout_server
+        for u in ("u1", "u2"):
+            _ask(s, u)
+        assert len(s.result_cache._entries) == 2
+        s.swap_models([s.models[0]], version="op-swap")
+        assert len(s.result_cache._entries) == 0
+        assert s.result_cache.invalidations.get("swap") == 2
+
+    def test_cache_metrics_exposed(self, layout_server):
+        s, _ = layout_server
+        _ask(s, "u1")
+        _ask(s, "u1")
+        text = s.metrics.render()
+        assert "pio_serve_cache_hits_total 1" in text
+        assert "pio_serve_cache_misses_total 1" in text
+        assert "pio_serve_cache_entries 1" in text
+        assert "pio_serve_cache_invalidations_total" in text
+        stats = s.result_cache.stats()
+        assert stats["hits"] == 1 and stats["entries"] == 1
+
+    def test_kill_switch(self, tmp_env, mesh8, monkeypatch):
+        monkeypatch.setenv("PIO_SERVE_CACHE", "off")
+        s = _server(_model({"u1": 1.0}))
+        try:
+            assert s.result_cache is None
+            assert _ask(s, "u1") == _ask(s, "u1")   # still correct
+        finally:
+            s.batcher.stop()

@@ -34,6 +34,7 @@ RULE_FIXTURES = {
     "JAX003": ("jax003_tp.py", "jax003_tn.py"),
     "JAX004": ("jax004_tp.py", "jax004_tn.py"),
     "JAX005": ("serving/jax005_tp.py", "serving/jax005_tn.py"),
+    "JAX006": ("serving/jax006_tp.py", "serving/jax006_tn.py"),
     "COST001": ("cost001_tp/event_server.py",
                 "cost001_tn/event_server.py"),
     "COST002": ("cost002_tp/server.py", "cost002_tn/server.py"),
