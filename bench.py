@@ -1208,6 +1208,146 @@ def bench_sharded(full_scale: bool):
     return out
 
 
+def bench_multitenant(full_scale: bool):
+    """Multi-tenant serving host (ISSUE 15, schema-additive): three
+    engine tenants of different vocab sizes packed on one device
+    behind a ServingHost, served by a 16-way closed loop round-robin
+    across tenants, under an HBM budget sized to hold only TWO
+    tenants' padded tables — so steady traffic exercises the
+    LRU-eviction + readmission path, not just routing. Emits
+    ``serve_p50_ms_multitenant`` / ``serve_p99_ms_multitenant`` (mixed
+    workload latency through the host's per-tenant routing),
+    ``tenant_evictions`` (budget evictions during the timed window)
+    and ``hbm_bytes_by_tenant`` (the per-tenant gauge at the end)."""
+    import datetime as dt
+    import threading
+
+    from predictionio_tpu.core import FirstServing
+    from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap
+    from predictionio_tpu.data.storage.base import EngineInstance
+    from predictionio_tpu.models import recommendation as R
+    from predictionio_tpu.ops.als import ALSModel
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    from predictionio_tpu.tenancy import (HostConfig, ServingHost,
+                                          TenantSpec,
+                                          estimate_padded_bytes)
+
+    rank = 32 if full_scale else 8
+    vocabs = ([(30_000, 60_000), (20_000, 40_000), (10_000, 20_000)]
+              if full_scale else [(600, 1200), (400, 800), (200, 400)])
+    rng = np.random.default_rng(7)
+
+    def make_server(key, n_users, n_items):
+        als = ALSModel(
+            user_factors=rng.standard_normal(
+                (n_users, rank)).astype(np.float32),
+            item_factors=rng.standard_normal(
+                (n_items, rank)).astype(np.float32),
+            rank=rank)
+        user_ix = EntityIdIxMap(
+            BiMap({str(i): i for i in range(n_users)}))
+        item_ix = EntityIdIxMap(
+            BiMap({str(i): i for i in range(n_items)}))
+        srv = EngineServer(
+            ServerConfig(ip="127.0.0.1", port=0, micro_batch=16),
+            engine=R.RecommendationEngineFactory.apply(), tenant=key,
+            shared_result_cache=host.result_cache)
+        now = dt.datetime.now(dt.timezone.utc)
+        srv.engine_instance = EngineInstance(
+            id=f"bench-{key}", status="COMPLETED", start_time=now,
+            end_time=now, engine_id=key, engine_version="0",
+            engine_variant="bench", engine_factory="recommendation")
+        srv.algorithms = [R.ALSAlgorithm(R.ALSAlgorithmParams(
+            rank=rank))]
+        srv.models = [R.RecommendationModel(als, user_ix, item_ix)]
+        srv.serving = FirstServing()
+        return srv
+
+    # budget: the two largest tenants' padded tables fit, all three
+    # don't — mixed traffic must evict to keep serving
+    host = ServingHost(HostConfig(ip="127.0.0.1", port=0,
+                                  budget_bytes=1))
+    servers = {}
+    expected = []
+    for k, (nu, ni) in zip(("t0", "t1", "t2"), vocabs):
+        servers[k] = make_server(k, nu, ni)
+        expected.append(estimate_padded_bytes(servers[k].models))
+    host.budget.budget_bytes = int(expected[0] + expected[1]
+                                   + expected[2] // 2)
+    for k in servers:
+        host.admit_server(TenantSpec(key=k, engine_id=k), servers[k])
+    host.start()
+    port = host.config.port
+    keys = list(servers)
+    sizes = {k: v[0] for k, v in zip(keys, vocabs)}
+    try:
+        # warm every tenant's serve bucket (compiles excluded from the
+        # timed window, like every other serve bench here)
+        warm_client = _Client(port)
+        for k in keys:
+            for i in range(8):
+                warm_client.post({"user": str(i), "num": 10},
+                                 timeout=600,
+                                 path=f"/engines/{k}/queries.json")
+        warm_client.close()
+        ev0 = sum(t["evictions"] for t in
+                  host.budget.snapshot()["tenants"].values())
+        n_threads, per_thread = 16, (40 if full_scale else 25)
+        lat, errors, lock = [], [], threading.Lock()
+
+        def worker(seed):
+            # failures are COLLECTED, not printed-and-dropped: a dead
+            # thread's missing samples would silently skew the
+            # published percentiles toward the survivors
+            try:
+                c = _Client(port)
+                r = np.random.default_rng(seed)
+                mine = []
+                for j in range(per_thread):
+                    k = keys[(seed + j) % len(keys)]
+                    u = int(r.integers(0, sizes[k]))
+                    t0 = time.perf_counter()
+                    c.post({"user": str(u), "num": 10}, timeout=600,
+                           path=f"/engines/{k}/queries.json")
+                    mine.append(time.perf_counter() - t0)
+                c.close()
+                with lock:
+                    lat.extend(mine)
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors or len(lat) < n_threads * per_thread:
+            raise RuntimeError(
+                f"multitenant bench lost samples: "
+                f"{len(lat)}/{n_threads * per_thread} completed, "
+                f"errors={errors[:3]}")
+        snap = host.budget.snapshot()
+        evictions = sum(t["evictions"]
+                        for t in snap["tenants"].values()) - ev0
+        return {
+            "serve_p50_ms_multitenant": round(
+                float(np.percentile(lat, 50)) * 1000, 3),
+            "serve_p99_ms_multitenant": round(
+                float(np.percentile(lat, 99)) * 1000, 3),
+            "multitenant_qps": round(len(lat) / wall, 1),
+            "tenant_evictions": int(evictions),
+            "hbm_bytes_by_tenant": {
+                k: int(v["hbmBytes"])
+                for k, v in sorted(snap["tenants"].items())},
+        }
+    finally:
+        host.stop()
+
+
 def bench_cold_start(full_scale: bool):
     """Cold-start economics (ISSUE 9, schema-additive): two fresh
     processes sharing one persistent-cache dir measure the
@@ -1988,8 +2128,14 @@ def main():
         # first-query through the persistent cache (schema-additive)
         _beat("bench_cold_start")
         coldstart_stats = bench_cold_start(full_scale)
+    multitenant_stats = {}
+    if not os.environ.get("PIO_BENCH_SKIP_MULTITENANT"):
+        # multi-tenant serving host (ISSUE 15): three tenants packed
+        # under a forced-tight HBM budget (schema-additive)
+        _beat("bench_multitenant")
+        multitenant_stats = bench_multitenant(full_scale)
     _beat("assemble_output", **ingest_stats, **fold_stats,
-          **sharded_stats, **coldstart_stats)
+          **sharded_stats, **coldstart_stats, **multitenant_stats)
     value = als_stats["ratings_per_sec_per_chip"]
     out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
@@ -2007,6 +2153,7 @@ def main():
         **fold_stats,
         **sharded_stats,
         **coldstart_stats,
+        **multitenant_stats,
     }
     if baseline_stats:
         # the north-star ratio computed from two numbers measured on

@@ -299,7 +299,10 @@ _SERVE_KERNELS = {"als.py", "similarity.py", "topk.py"}
 
 def in_serve_zone(relpath: str) -> bool:
     parts = relpath.split("/")
-    if {"serving", "guard"}.intersection(parts[:-1]):
+    # tenancy/ (ISSUE 15) joins the serve zone: the multi-tenant host
+    # sits directly on the query path, so a jit dispatched there
+    # without the compile plane recompiles per tenant shape
+    if {"serving", "guard", "tenancy"}.intersection(parts[:-1]):
         return True
     if parts[-1] == "fold_in.py":
         return True
@@ -361,7 +364,9 @@ def check_jax005(repo: RepoModel) -> List[Finding]:
 #: callables (that IS the completion stage).
 def in_pipelined_zone(relpath: str) -> bool:
     parts = relpath.split("/")
-    return "serving" in parts[:-1]
+    # tenancy/ routes into the pipelined executor (ISSUE 15): a host
+    # sync there would stall every tenant's overlap, not just one's
+    return bool({"serving", "tenancy"}.intersection(parts[:-1]))
 
 
 def check_jax006(repo: RepoModel) -> List[Finding]:

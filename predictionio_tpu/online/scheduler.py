@@ -148,7 +148,14 @@ class DeltaTrainingScheduler:
                  config: SchedulerConfig,
                  server=None, registry=None, reload_url: Optional[str] = None,
                  on_retrain: Optional[Callable[[dict], None]] = None,
-                 event_store=None, cursor: Optional[_dt.datetime] = None):
+                 event_store=None, cursor: Optional[_dt.datetime] = None,
+                 tenant: Optional[str] = None):
+        # multi-tenant serving (ISSUE 15): when this scheduler follows
+        # one tenant slot of a ServingHost, its fold ticks' device
+        # uploads and residency slots run under the tenant's
+        # device_cache attribution scope — so the HBM budget manager
+        # can evict THIS tenant's fold-resident tables by name
+        self.tenant = str(tenant) if tenant is not None else None
         self.engine = engine
         self.engine_params = engine_params
         self.instance = instance
@@ -765,6 +772,13 @@ class DeltaTrainingScheduler:
         registry publish -> hot swap), linked to the ingest traces of
         the events it absorbed; idle ticks are discarded so the poll
         loop doesn't flood the trace ring."""
+        if self.tenant is not None:
+            from predictionio_tpu.utils.device_cache import tenant_scope
+            with tenant_scope(self.tenant):
+                return self._tick_inner(force)
+        return self._tick_inner(force)
+
+    def _tick_inner(self, force: bool = False) -> Optional[dict]:
         t0 = _time.perf_counter()
         with TRACER.trace("fold_tick") as tr:
             with TRACER.span("tail_read") as sp:

@@ -365,6 +365,28 @@ class ShardedTable:
         self._dev = dev
         return self
 
+    def drop_device(self) -> int:
+        """Release the transient device handle (tenant eviction,
+        tenancy/budget.py): the host mirrors stay the source of truth
+        and the next :meth:`device` call re-uploads through the
+        budget-checked cold path. An in-flight dispatch that already
+        closed over the handle keeps its own reference — dropping here
+        only stops pinning HBM for future calls. Returns the per-device
+        bytes the handle was pinning (0 when none was resident)."""
+        with self._dev_lock:
+            freed = self.device_nbytes()
+            self._dev = None
+        return freed
+
+    def device_nbytes(self) -> int:
+        """Per-device bytes pinned by the resident handle (0 when not
+        resident) — the tenancy budget manager's sharded-table sizer."""
+        dev = self._dev
+        if dev is None:
+            return 0
+        from predictionio_tpu.utils.device_cache import _device_nbytes
+        return _device_nbytes(dev)
+
     # -- pickling ------------------------------------------------------------
     def __getstate__(self):
         state = dict(self.__dict__)

@@ -44,6 +44,13 @@ from typing import Dict, Iterable, List, Optional, Tuple
 #: their values register under (the invalidation join key)
 _ENTITY_FIELDS = (("user", "user"), ("item", "item"), ("items", "item"))
 
+#: namespace separator for tenant-prefixed keys/tags (ISSUE 15
+#: satellite): a control character no JSON-canonical query key or
+#: entity id produced by ``query_key``/``entity_tags`` can contain, so
+#: a namespaced key can never collide with (or alias) an unnamespaced
+#: one
+NS_SEP = "\x1f"
+
 
 def cache_enabled() -> bool:
     return os.environ.get("PIO_SERVE_CACHE", "").lower() not in (
@@ -248,20 +255,59 @@ class ResultCache:
         """Drop exactly the entries registered under any touched tag
         (plus, in strict mode, entries whose cached result contains a
         touched item id). O(touched + dropped), never a full scan —
-        untouched entries are not even visited."""
+        untouched entries are not even visited.
+
+        Tags may carry a tenant namespace prefix (``<ns>\\x1f<tag>``,
+        :class:`TenantResultCache`): the strict-mode result-item join
+        then only considers entries in the SAME namespace — tenant A's
+        fold tick touching item i must never drop tenant B's cached
+        rankings of a same-named item."""
         tags = list(tags)
         strict = strict_items()
-        touched_items = {t.split(":", 1)[1] for t in tags
-                         if strict and t.startswith("item:")}
+        # touched item ids per namespace ("" = unnamespaced keys)
+        touched_by_ns: Dict[str, set] = {}
+        if strict:
+            for t in tags:
+                ns, sep, rest = t.rpartition(NS_SEP)
+                if rest.startswith("item:"):
+                    touched_by_ns.setdefault(
+                        ns + sep, set()).add(rest.split(":", 1)[1])
         with self._lock:
             self.generation += 1
             doomed = set()
             for tag in tags:
                 doomed |= self._by_entity.get(tag, set())
-            if touched_items:
+            if touched_by_ns:
                 for k, e in self._entries.items():
-                    if touched_items.intersection(e.result_items):
-                        doomed.add(k)
+                    for nsp, items in touched_by_ns.items():
+                        if nsp:
+                            if not k.startswith(nsp):
+                                continue
+                        elif NS_SEP in k:
+                            continue
+                        if items.intersection(e.result_items):
+                            doomed.add(k)
+                            break
+            for k in doomed:
+                e = self._entries.pop(k, None)
+                if e is None:
+                    continue
+                self._unindex(k, e)
+                self._bytes -= e.nbytes
+            if doomed:
+                self.invalidations[reason] = \
+                    self.invalidations.get(reason, 0) + len(doomed)
+            return len(doomed)
+
+    def invalidate_prefix(self, prefix: str, reason: str = "full") -> int:
+        """Drop every entry whose key starts with ``prefix`` — the
+        tenant-scoped analog of :meth:`invalidate_all` on a shared
+        cache (one tenant's /reload must not clear its neighbors' hot
+        sets). O(entries) like invalidate_all, paid only on
+        unattributed model changes."""
+        with self._lock:
+            self.generation += 1
+            doomed = [k for k in self._entries if k.startswith(prefix)]
             for k in doomed:
                 e = self._entries.pop(k, None)
                 if e is None:
@@ -301,3 +347,75 @@ class ResultCache:
                 "evictions": self.evictions,
                 "invalidations": dict(self.invalidations),
             }
+
+
+class TenantResultCache:
+    """Tenant-namespaced view over a shared :class:`ResultCache`
+    (ISSUE 15 satellite bugfix). The underlying cache keyed entries on
+    request bytes / canonical query JSON / entity ids ONLY — correct
+    for one engine per process, but the moment a serving host packs
+    many engines, two tenants sending byte-identical queries (every
+    template shares the ``{"user": ..., "num": ...}`` wire shape) would
+    collide: tenant B could be answered with tenant A's cached ranking.
+    Every canonical key, exact-request-bytes alias and entity tag is
+    prefixed here with the tenant id + ``NS_SEP``, so cross-tenant hits
+    are structurally impossible while all tenants still share ONE
+    entry/byte budget and LRU order (a hot tenant can use the whole
+    pool when its neighbors are idle)."""
+
+    def __init__(self, inner: ResultCache, tenant: str):
+        tenant = str(tenant)
+        if NS_SEP in tenant:
+            raise ValueError("tenant id must not contain NS_SEP")
+        self.inner = inner
+        self.tenant = tenant
+        self._kp = tenant + NS_SEP
+        self._rp = self._kp.encode("utf-8")
+        # per-NAMESPACE store-time freshness fence: only THIS tenant's
+        # invalidations bump it. Proxying the shared inner counter
+        # would let tenant A's fold cadence refuse tenant B's
+        # concurrent stores (nothing in B's namespace changed) —
+        # cross-tenant hit-rate interference the isolation contract
+        # forbids. Int read/write under the GIL; the worst race is one
+        # refused store, the safe direction.
+        self._generation = 0
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def get(self, key: Optional[str]) -> Optional[bytes]:
+        return self.inner.get(None if key is None else self._kp + key)
+
+    def get_raw(self, raw: bytes) -> Optional[bytes]:
+        return self.inner.get_raw(self._rp + raw)
+
+    def put(self, key: Optional[str], body: bytes,
+            entities: Tuple[str, ...],
+            result_items: Tuple[str, ...] = (),
+            generation: Optional[int] = None,
+            raw: Optional[bytes] = None) -> bool:
+        # the fence is enforced HERE against the per-tenant counter;
+        # the inner cache's (cross-tenant) generation is bypassed
+        if generation is not None and generation != self._generation:
+            return False
+        return self.inner.put(
+            None if key is None else self._kp + key, body,
+            tuple(self._kp + t for t in entities),
+            result_items=result_items, generation=None,
+            raw=None if raw is None else self._rp + raw)
+
+    def invalidate_entities(self, tags: Iterable[str],
+                            reason: str = "fold_swap") -> int:
+        self._generation += 1
+        return self.inner.invalidate_entities(
+            [self._kp + t for t in tags], reason=reason)
+
+    def invalidate_all(self, reason: str = "full") -> int:
+        # tenant-scoped: this tenant's /reload or canary event clears
+        # ONLY its namespace; the neighbors' hot sets survive
+        self._generation += 1
+        return self.inner.invalidate_prefix(self._kp, reason=reason)
+
+    def stats(self) -> dict:
+        return dict(self.inner.stats(), tenant=self.tenant, shared=True)

@@ -120,8 +120,17 @@ class EngineServer:
                  engine: Optional[Engine] = None,
                  engine_params: Optional[EngineParams] = None,
                  plugin_context: Optional[EngineServerPluginContext] = None,
-                 mesh_coordinator=None):
+                 mesh_coordinator=None,
+                 tenant: Optional[str] = None,
+                 shared_result_cache=None):
         self.config = config
+        # multi-tenant serving (ISSUE 15): when this server is one slot
+        # of a tenancy.ServingHost, `tenant` names it — every device
+        # upload the query/warm paths trigger runs under a
+        # device_cache.tenant_scope so the HBM budget manager can
+        # account and evict this tenant's tables independently, and the
+        # (host-shared) result cache is namespaced per tenant.
+        self.tenant = str(tenant) if tenant is not None else None
         self._lock = threading.RLock()
         # multi-process mesh serving: under a >1-process JAX mesh every
         # process must run each query's SPMD program, so the primary
@@ -193,8 +202,9 @@ class EngineServer:
         # last-seen status per SLO name: the ok->breached transition
         # detector behind the ISSUE 11 auto-capture in _health
         self._slo_status: dict = {}
-        get_incidents().register_provider("engine_server",
-                                          self._incident_state)
+        get_incidents().register_provider(
+            "engine_server" if self.tenant is None
+            else f"engine_server.{self.tenant}", self._incident_state)
         # guarded deploys (ISSUE 5): canary controller + rollback
         # anchors. last_good_version tracks the newest version this
         # server trusts (the loaded instance, then every promotion);
@@ -239,10 +249,16 @@ class EngineServer:
         self.result_cache = None
         if config.result_cache and single_process \
                 and RC.cache_enabled():
-            self.result_cache = RC.ResultCache(
-                max_entries=config.result_cache_max_entries,
-                max_bytes=config.result_cache_max_bytes,
-                metrics=self.metrics)
+            if shared_result_cache is not None and self.tenant is not None:
+                # one host-wide budget, tenant-namespaced keys: two
+                # tenants' byte-identical queries can never alias
+                self.result_cache = RC.TenantResultCache(
+                    shared_result_cache, self.tenant)
+            else:
+                self.result_cache = RC.ResultCache(
+                    max_entries=config.result_cache_max_entries,
+                    max_bytes=config.result_cache_max_bytes,
+                    metrics=self.metrics)
         self.batcher = None
         if config.micro_batch > 1:
             from predictionio_tpu.serving.batcher import MicroBatcher
@@ -437,6 +453,15 @@ class EngineServer:
                       model_version=instance.id, source="load")
         return self
 
+    def _tenant_cm(self):
+        """Attribution scope for device uploads on this server's paths
+        (ISSUE 15): a nullcontext for single-tenant deployments."""
+        if self.tenant is None:
+            import contextlib
+            return contextlib.nullcontext()
+        from predictionio_tpu.utils import device_cache
+        return device_cache.tenant_scope(self.tenant)
+
     # -- compile plane (ISSUE 9) --------------------------------------------
     def _warm_aot(self, models, version: Optional[str]):
         """AOT-compile the serving executables for ``models`` BEFORE
@@ -446,9 +471,10 @@ class EngineServer:
         correctly."""
         try:
             from predictionio_tpu.compile.aot import warm_models
-            summary = warm_models(self.algorithms, models,
-                                  batch_hint=max(self.config.micro_batch,
-                                                 1))
+            with self._tenant_cm():
+                summary = warm_models(
+                    self.algorithms, models,
+                    batch_hint=max(self.config.micro_batch, 1))
             self.last_aot_warm = dict(summary, version=version)
             if summary.get("compiled"):
                 FLIGHT.record("aot_warm", model_version=version,
@@ -681,7 +707,7 @@ class EngineServer:
         qc = algorithms[0].query_class
         query = qc.from_dict(query_dict) if qc is not None else query_dict
         try:
-            with self._spmd_guard(query_dict):
+            with self._tenant_cm(), self._spmd_guard(query_dict):
                 with TRACER.span("supplement"):
                     supplemented = serving.supplement(query)
                 tp = time.perf_counter()
@@ -810,24 +836,26 @@ class EngineServer:
                 g.__exit__(*exc_info)
 
         try:
-            with TRACER.span("supplement"):
-                indexed = [(i, serving.supplement(q))
-                           for i, q in enumerate(queries)]
-            tp = time.perf_counter()
-            with TRACER.span("predict", batch=len(queries),
-                             algorithms=len(algorithms)):
-                fetchers = []
-                for algo, model in zip(algorithms, models):
-                    begin = getattr(algo, "batch_predict_begin", None)
-                    if begin is not None:
-                        fetchers.append(begin(model, indexed))
-                    else:
-                        # no async split for this algorithm: run the
-                        # full (sync) batch predict in this stage —
-                        # correct, just without overlap
-                        res = algo.batch_predict(model, indexed)
-                        fetchers.append(lambda res=res: res)
-            dispatch_dt = time.perf_counter() - tp
+            with self._tenant_cm():
+                with TRACER.span("supplement"):
+                    indexed = [(i, serving.supplement(q))
+                               for i, q in enumerate(queries)]
+                tp = time.perf_counter()
+                with TRACER.span("predict", batch=len(queries),
+                                 algorithms=len(algorithms)):
+                    fetchers = []
+                    for algo, model in zip(algorithms, models):
+                        begin = getattr(algo, "batch_predict_begin",
+                                        None)
+                        if begin is not None:
+                            fetchers.append(begin(model, indexed))
+                        else:
+                            # no async split for this algorithm: run
+                            # the full (sync) batch predict in this
+                            # stage — correct, just without overlap
+                            res = algo.batch_predict(model, indexed)
+                            fetchers.append(lambda res=res: res)
+                dispatch_dt = time.perf_counter() - tp
         except BaseException as e:
             _exit_guard(sys.exc_info())
             if isinstance(e, Exception):
@@ -1219,6 +1247,8 @@ class EngineServer:
                 # table layout (+ per-shard HBM cost when sharded)
                 "modelSharding": self._model_sharding(),
             }
+            if self.tenant is not None:
+                out["tenant"] = self.tenant
             pct = self._ring_percentiles()
             if pct is not None:
                 out.update({"p50ServingSec": float(pct[0]),

@@ -1,0 +1,35 @@
+"""Multi-tenant serving (ISSUE 15): many engines, one accelerator.
+
+Production PredictionIO hosts many apps/engines behind one deployment
+(the ``Apps``/``AccessKeys``/engine-instance metadata layer exists for
+exactly this), while the TPU build's :class:`EngineServer` assumed one
+model family per process. This package packs a *fleet* of engine
+tenants onto one device:
+
+- :mod:`tenancy.budget` — the HBM budget manager: per-tenant resident
+  byte accounting over ``utils/device_cache``'s tenant-tagged uploads
+  and residency slots, LRU/priority eviction of cold tenants' factor
+  tables back to their host mirrors, and admission control that
+  refuses a tenant whose padded tables can never fit
+  (:class:`~predictionio_tpu.utils.device_cache.TableBudgetExceeded`).
+- :mod:`tenancy.host` — the ServingHost: routes queries by app/engine
+  key to per-tenant engine slots (each a full ``EngineServer`` with
+  its own canary/rollback/last-known-good state, scheduler attachment
+  and tenant-namespaced result-cache view), shares ONE compile-plane
+  bucket ladder across tenants (identical shapes reuse executables),
+  and serves per-tenant telemetry: ``pio_engine_hbm_bytes{tenant}``,
+  ``pio_tenant_evictions_total{tenant,reason}``,
+  ``pio_tenant_requests_total{tenant}``, a ``tenants`` block on
+  ``/stats.json``, and the ``pio tenants {list,status,evict,pin}``
+  CLI surfaces.
+"""
+
+from predictionio_tpu.tenancy.budget import (HBMBudgetManager,
+                                             estimate_padded_bytes)
+from predictionio_tpu.tenancy.host import (HostConfig, ServingHost,
+                                           TenantSlot, TenantSpec)
+
+__all__ = [
+    "HBMBudgetManager", "estimate_padded_bytes",
+    "HostConfig", "ServingHost", "TenantSlot", "TenantSpec",
+]

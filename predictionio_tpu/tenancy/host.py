@@ -1,0 +1,512 @@
+"""ServingHost: route queries by app/engine key to per-tenant slots.
+
+One process, one accelerator, many engines. Each tenant is a full
+:class:`~predictionio_tpu.serving.server.EngineServer` slot — its own
+micro-batcher/pipelined executor, canary controller, rollback anchors,
+scheduler attachment and tenant-namespaced result-cache view — loaded
+from its own engine instance and addressed as
+``/engines/<tenant>/...``. What the slots SHARE is the device: the
+process-wide compile-plane bucket ladder (two tenants with identical
+shapes reuse the same AOT executables — the packing payoff), the
+persistent XLA cache, and the HBM the
+:class:`~predictionio_tpu.tenancy.budget.HBMBudgetManager` arbitrates.
+
+Isolation contracts (tested by tests/test_tenancy.py):
+
+- a query for tenant A can never be answered from tenant B's cached
+  result (tenant-prefixed result-cache keys, ISSUE 15 satellite);
+- tenant B's eviction never touches tenant A's models, caches,
+  canary state or last-known-good pins;
+- eviction never fires mid-dispatch on an in-flight window: the
+  evictor quiesces the slot first (the PR 13 snapshot discipline
+  extended to residency handles) and skips the drop on timeout;
+- an evicted tenant's next query re-uploads from host mirrors and
+  serves byte-identical rankings (the mirrors are the truth).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from predictionio_tpu.obs import FLIGHT, MetricsRegistry, fleet, \
+    get_registry
+from predictionio_tpu.serving.server import EngineServer, ServerConfig
+from predictionio_tpu.tenancy.budget import HBMBudgetManager, _iter_tables
+from predictionio_tpu.utils import device_cache
+from predictionio_tpu.utils.http import (HttpServer, Request, Response,
+                                         Router)
+
+logger = logging.getLogger(__name__)
+
+#: characters a tenant key must not contain: path separators (the key
+#: is a URL segment) and the result-cache namespace separator
+_FORBIDDEN = set("/\x1f\n\r")
+
+
+def _check_key(key: str) -> str:
+    key = str(key)
+    if not key or _FORBIDDEN.intersection(key):
+        raise ValueError(f"invalid tenant key {key!r}")
+    return key
+
+
+@dataclass
+class TenantSpec:
+    """One tenant: which engine instance to serve, and its packing
+    policy. ``key`` is the routing segment (conventionally
+    ``<app>-<engine>`` or the engine id). Higher ``priority`` evicts
+    later; ``pinned`` never auto-evicts (operator evict still works)."""
+    key: str
+    engine_id: Optional[str] = None
+    engine_version: str = "0"
+    engine_variant: str = "engine.json"
+    engine_instance_id: Optional[str] = None
+    priority: int = 0
+    pinned: bool = False
+    #: full per-slot ServerConfig override; None derives one from the
+    #: engine coordinates above with stock serving defaults
+    server_config: Optional[ServerConfig] = None
+
+
+class TenantSlot:
+    """One admitted tenant: its engine server plus the in-flight gate
+    the evictor quiesces against."""
+
+    def __init__(self, spec: TenantSpec, server: EngineServer):
+        self.key = spec.key
+        self.spec = spec
+        self.server = server
+        self.scheduler = None
+        self.requests = 0
+        self.errors = 0
+        self.admitted_at = time.time()
+        #: True when this tenant's tables may not be resident (fresh
+        #: admission or post-eviction) — the next query calls
+        #: ensure_room before dispatching
+        self.cold = True
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._evicting = False
+
+    # -- the in-flight gate --------------------------------------------------
+    @contextlib.contextmanager
+    def serving(self):
+        """Count one request in flight; entry waits out an active
+        eviction (eviction windows are bounded by the quiesce
+        timeout)."""
+        with self._cond:
+            while self._evicting:
+                self._cond.wait(timeout=1.0)
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                if self._inflight <= 0:
+                    self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def quiesced(self, timeout_s: float):
+        """Block new requests and wait for in-flight ones to drain;
+        yields True when drained (the evictor may drop residency) or
+        False on timeout (it must NOT — an in-flight window's inputs
+        stay pinned)."""
+        with self._cond:
+            self._evicting = True
+            deadline = time.monotonic() + timeout_s
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(timeout=left)
+            drained = self._inflight == 0
+        try:
+            yield drained
+        finally:
+            with self._cond:
+                self._evicting = False
+                self._cond.notify_all()
+
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    def status(self) -> dict:
+        srv = self.server
+        return {
+            "tenant": self.key,
+            "engineId": self.spec.engine_id,
+            "engineVersion": self.spec.engine_version,
+            "engineVariant": self.spec.engine_variant,
+            "modelVersion": srv.model_version,
+            "lastGoodVersion": srv.last_good_version,
+            "requests": self.requests,
+            "errors": self.errors,
+            "inflight": self.inflight(),
+            "cold": self.cold,
+            "scheduler": self.scheduler is not None,
+            "canary": srv.canary.stats(),
+            "modelSharding": srv._model_sharding(),
+            "admittedAt": self.admitted_at,
+        }
+
+
+@dataclass
+class HostConfig:
+    ip: str = "0.0.0.0"
+    port: int = 8100
+    #: per-device HBM table budget for the whole host; None reads the
+    #: enforced PIO_TABLE_BUDGET_BYTES (None there too = accounting
+    #: only)
+    budget_bytes: Optional[int] = None
+    #: one shared result cache for every tenant (tenant-namespaced
+    #: keys); budgets are host-wide so a hot tenant can use the pool
+    result_cache: bool = True
+    result_cache_max_entries: int = 8192
+    result_cache_max_bytes: int = 64 << 20
+    #: how long an eviction may wait for a slot's in-flight windows
+    #: before giving up (the drop is skipped, never forced)
+    evict_quiesce_timeout_s: float = 10.0
+
+
+class ServingHost:
+    def __init__(self, config: Optional[HostConfig] = None):
+        self.config = config or HostConfig()
+        self._lock = threading.RLock()
+        self.slots: Dict[str, TenantSlot] = {}
+        self.start_time = time.time()
+        self.metrics = MetricsRegistry(parent=get_registry())
+        self.budget = HBMBudgetManager(self.config.budget_bytes,
+                                       registry=self.metrics)
+        self._c_requests = self.metrics.counter(
+            "pio_tenant_requests_total",
+            "Queries routed to each serving tenant",
+            labelnames=("tenant",))
+        self.metrics.gauge_func(
+            "pio_host_tenants",
+            "Tenant slots admitted on this serving host",
+            lambda: len(self.slots))
+        from predictionio_tpu.serving import result_cache as RC
+        self.result_cache = None
+        if self.config.result_cache and RC.cache_enabled():
+            self.result_cache = RC.ResultCache(
+                max_entries=self.config.result_cache_max_entries,
+                max_bytes=self.config.result_cache_max_bytes,
+                metrics=self.metrics)
+        self.server: Optional[HttpServer] = None
+        self._fleet_id: Optional[str] = None
+        self.router = self._build_router()
+
+    # -- tenant lifecycle ---------------------------------------------------
+    def _slot_config(self, spec: TenantSpec) -> ServerConfig:
+        if spec.server_config is not None:
+            return spec.server_config
+        return ServerConfig(
+            engine_instance_id=spec.engine_instance_id,
+            engine_id=spec.engine_id,
+            engine_version=spec.engine_version,
+            engine_variant=spec.engine_variant)
+
+    def add_tenant(self, spec: TenantSpec, engine=None,
+                   engine_params=None) -> TenantSlot:
+        """Load + admit one tenant. The load happens OUTSIDE the host
+        lock (model deserialization can be slow; other tenants keep
+        serving); admission control runs before the slot becomes
+        routable — a tenant whose padded tables can never fit raises
+        :class:`TableBudgetExceeded` and leaves no slot behind."""
+        key = _check_key(spec.key)
+        with self._lock:
+            if key in self.slots:
+                raise ValueError(f"tenant {key!r} already admitted")
+        server = EngineServer(self._slot_config(spec), engine=engine,
+                              engine_params=engine_params, tenant=key,
+                              shared_result_cache=self.result_cache)
+        with device_cache.tenant_scope(key):
+            server.load()
+        slot = TenantSlot(spec, server)
+        try:
+            self.budget.admit(
+                key, server.models, priority=spec.priority,
+                pinned=spec.pinned,
+                sizer=lambda s=slot: self._sharded_devs(s),
+                evictor=lambda s=slot: self._evict_slot(s))
+        except Exception:
+            server.stop()
+            raise
+        with self._lock:
+            self.slots[key] = slot
+        FLIGHT.record("tenant_admitted", tenant=key,
+                      model_version=server.model_version,
+                      expectedPaddedBytes=self.budget.snapshot()
+                      ["tenants"][key]["expectedPaddedBytes"])
+        logger.info("tenant %s admitted (instance %s)", key,
+                    server.model_version)
+        return slot
+
+    def admit_server(self, spec: TenantSpec,
+                     server: EngineServer) -> TenantSlot:
+        """Admit a pre-built, already-loaded :class:`EngineServer` as a
+        tenant slot (bench/test path; production slots go through
+        :meth:`add_tenant`, which loads from the engine-instance
+        store). The server must have been constructed with
+        ``tenant=spec.key`` so its uploads carry the attribution tag —
+        refused otherwise (untagged uploads would make this tenant
+        unevictable AND unaccounted)."""
+        key = _check_key(spec.key)
+        if server.tenant != key:
+            raise ValueError(
+                f"server.tenant {server.tenant!r} != spec.key {key!r}: "
+                f"construct the EngineServer with tenant=<key>")
+        with self._lock:
+            if key in self.slots:
+                raise ValueError(f"tenant {key!r} already admitted")
+        slot = TenantSlot(spec, server)
+        self.budget.admit(
+            key, server.models, priority=spec.priority,
+            pinned=spec.pinned,
+            sizer=lambda s=slot: self._sharded_devs(s),
+            evictor=lambda s=slot: self._evict_slot(s))
+        with self._lock:
+            self.slots[key] = slot
+        return slot
+
+    def remove_tenant(self, key: str) -> bool:
+        with self._lock:
+            slot = self.slots.pop(key, None)
+        if slot is None:
+            return False
+        if slot.scheduler is not None:
+            try:
+                slot.scheduler.stop()
+            except Exception:
+                logger.exception("tenant %s scheduler stop failed", key)
+        self.budget.evict(key, reason="remove")
+        self.budget.forget(key)
+        slot.server.stop()
+        FLIGHT.record("tenant_removed", tenant=key)
+        return True
+
+    def attach_scheduler(self, key: str, config, **kw):
+        """Attach a fold-in scheduler to one tenant slot — every fold
+        tick runs under the tenant's device attribution scope, and its
+        publishes hot-swap only this slot."""
+        from predictionio_tpu.online.scheduler import attach_scheduler
+        slot = self._slot(key)
+        sched = attach_scheduler(slot.server, config, tenant=key, **kw)
+        slot.scheduler = sched
+        return sched
+
+    # -- eviction mechanism -------------------------------------------------
+    @staticmethod
+    def _sharded_tables(slot: TenantSlot):
+        from predictionio_tpu.parallel.sharded_table import is_sharded
+        return [t for t in _iter_tables(slot.server.models)
+                if is_sharded(t)]
+
+    def _sharded_devs(self, slot: TenantSlot) -> list:
+        """The slot's resident ShardedTable device handles — arrays,
+        not bytes: the budget manager identity-dedupes them against
+        the fold-residency payloads carrying the same handles."""
+        return [t._dev for t in self._sharded_tables(slot)
+                if t._dev is not None]
+
+    def _evict_slot(self, slot: TenantSlot):
+        """The per-slot evictor the budget manager calls: quiesce the
+        in-flight gate, then drop the tenant's device-cache entries,
+        residency slots and sharded-table handles. On quiesce timeout
+        the drop is SKIPPED — an in-flight window must complete against
+        the handles it snapshotted (PR 13 semantics; its closures pin
+        the arrays anyway, so a forced drop would only lie about
+        freed bytes)."""
+        with slot.quiesced(self.config.evict_quiesce_timeout_s) \
+                as drained:
+            if not drained:
+                logger.warning(
+                    "tenant %s eviction skipped: %d windows still in "
+                    "flight after %.1fs", slot.key, slot.inflight(),
+                    self.config.evict_quiesce_timeout_s)
+                return
+            device_cache.evict_tenant(slot.key)
+            for t in self._sharded_tables(slot):
+                t.drop_device()
+            slot.cold = True
+
+    def evict_tenant(self, key: str, reason: str = "operator") -> dict:
+        self._slot(key)   # KeyError on unknown tenant
+        return self.budget.evict(key, reason=reason)
+
+    # -- routing ------------------------------------------------------------
+    def _slot(self, key: str) -> TenantSlot:
+        slot = self.slots.get(key)
+        if slot is None:
+            raise KeyError(key)
+        return slot
+
+    def _tenant_query(self, req: Request) -> Response:
+        key = req.path_args[0]
+        slot = self.slots.get(key)
+        if slot is None:
+            return Response(404, {"message": f"unknown tenant {key!r}"})
+        self._c_requests.labels(tenant=key).inc()
+        slot.requests += 1
+        self.budget.touch(key)
+        if slot.cold:
+            # fresh admission or post-eviction readmission: make the
+            # budget hold before this tenant's tables come (back)
+            # resident — evicts the coldest neighbors if needed
+            self.budget.ensure_room(key)
+            slot.cold = False
+        req.path = "/queries.json"
+        with slot.serving():
+            resp = slot.server.router.dispatch(req)
+        if resp.status >= 500:
+            slot.errors += 1
+        return resp
+
+    def _delegate(self, req: Request) -> Response:
+        """Forward ``/engines/<key>/<endpoint>`` to the slot server's
+        own router (stats, metrics, health, reload, ...)."""
+        key = req.path_args[0]
+        slot = self.slots.get(key)
+        if slot is None:
+            return Response(404, {"message": f"unknown tenant {key!r}"})
+        req.path = req.path[len(f"/engines/{key}"):]
+        with slot.serving():
+            return slot.server.router.dispatch(req)
+
+    # -- host surfaces ------------------------------------------------------
+    def _tenants_block(self) -> dict:
+        budget = self.budget.snapshot()
+        out = {}
+        with self._lock:
+            slots = list(self.slots.values())
+        for slot in slots:
+            entry = slot.status()
+            entry.update(budget["tenants"].get(slot.key, {}))
+            out[slot.key] = entry
+        return out
+
+    def _stats(self, req: Request) -> Response:
+        budget = self.budget.snapshot()
+        with self._lock:
+            total = sum(s.requests for s in self.slots.values())
+        out = {
+            "role": "serving_host",
+            "startTime": self.start_time,
+            "requestCount": total,
+            "tenants": self._tenants_block(),
+            "budget": {k: budget[k]
+                       for k in ("budgetBytes", "residentBytes")},
+        }
+        if self.result_cache is not None:
+            out["resultCache"] = self.result_cache.stats()
+        try:
+            from predictionio_tpu.compile.aot import get_aot
+            out["aot"] = get_aot().snapshot()
+        except Exception:
+            logger.debug("aot stats unavailable", exc_info=True)
+        return Response(200, out)
+
+    def _tenants(self, req: Request) -> Response:
+        return Response(200, {"tenants": self._tenants_block()})
+
+    def _tenant_evict(self, req: Request) -> Response:
+        key = req.path_args[0]
+        try:
+            return Response(200, self.evict_tenant(key))
+        except KeyError:
+            return Response(404, {"message": f"unknown tenant {key!r}"})
+
+    def _tenant_pin(self, req: Request) -> Response:
+        key = req.path_args[0]
+        pinned = not req.path.endswith("/unpin")
+        if not self.budget.pin(key, pinned):
+            return Response(404, {"message": f"unknown tenant {key!r}"})
+        return Response(200, {"tenant": key, "pinned": pinned})
+
+    def _metrics(self, req: Request) -> Response:
+        from predictionio_tpu.utils.prometheus import CONTENT_TYPE
+        return Response(200, self.metrics.render(),
+                        content_type=CONTENT_TYPE)
+
+    def _health(self, req: Request) -> Response:
+        """Worst-of rollup across tenant slots' SLO engines."""
+        from predictionio_tpu.obs import health_response
+        rank = {"ok": 0, "burning": 1, "no_data": 0, "breached": 2}
+        worst, tenants = "ok", {}
+        with self._lock:
+            slots = list(self.slots.values())
+        for slot in slots:
+            h = health_response(slot.server.slo, extra={
+                "modelVersion": slot.server.model_version})
+            tenants[slot.key] = h
+            if rank.get(h.get("status"), 0) > rank.get(worst, 0):
+                worst = h["status"]
+        return Response(200, {"status": worst, "tenants": tenants})
+
+    def _status_page(self, req: Request) -> Response:
+        return Response(200, {
+            "role": "serving_host",
+            "tenants": sorted(self.slots),
+            "budget": self.budget.snapshot()["budgetBytes"],
+        })
+
+    def _build_router(self) -> Router:
+        r = Router()
+        r.add("GET", "/", self._status_page)
+        r.add("POST", "/engines/<key>/queries.json", self._tenant_query)
+        for ep in ("stats.json", "metrics", "health.json",
+                   "plugins.json", "slow.json", "flight.json",
+                   "traces.json"):
+            r.add("GET", f"/engines/<key>/{ep}", self._delegate)
+        r.add("POST", "/engines/<key>/reload", self._delegate)
+        r.add("GET", "/engines/<key>/reload", self._delegate)
+        r.add("GET", "/stats.json", self._stats)
+        r.add("GET", "/tenants.json", self._tenants)
+        r.add("POST", "/tenants/<key>/evict", self._tenant_evict)
+        r.add("POST", "/tenants/<key>/pin", self._tenant_pin)
+        r.add("POST", "/tenants/<key>/unpin", self._tenant_pin)
+        r.add("GET", "/metrics", self._metrics)
+        r.add("GET", "/health.json", self._health)
+        return r
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, background: bool = True) -> "ServingHost":
+        from predictionio_tpu.obs import profiler
+        profiler.ensure_started()
+        srv = HttpServer(self.router, self.config.ip, self.config.port)
+        self.server = srv
+
+        def _bound(s):
+            self.config.port = s.port
+            fid = fleet.register_member("serving_host", port=s.port,
+                                        host=self.config.ip)
+            with self._lock:
+                self._fleet_id = fid
+            logger.info("Serving host started on %s:%d (%d tenants)",
+                        self.config.ip, s.port, len(self.slots))
+
+        srv.on_bound = _bound
+        srv.start(background=background)
+        return self
+
+    def stop(self):
+        with self._lock:
+            fleet_id = self._fleet_id
+            self._fleet_id = None
+            keys = list(self.slots)
+        fleet.deregister_member(fleet_id)
+        if self.server:
+            self.server.stop()
+            self.server = None
+        for key in keys:
+            try:
+                self.remove_tenant(key)
+            except Exception:
+                logger.exception("tenant %s removal failed on stop", key)

@@ -1092,6 +1092,73 @@ def cmd_cache(args) -> int:
     return 1
 
 
+def cmd_tenants(args) -> int:
+    """`pio tenants {list,status,evict,pin,unpin}` (ISSUE 15): the
+    multi-tenant serving host's operator surface — which engines are
+    packed on the device, what each one's factor tables cost in HBM,
+    and the evict/pin levers the packing runbook uses."""
+    import json as _json
+
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.utils.http import fetch_json
+    base = args.url.rstrip("/")
+    sub = args.tenants_command
+
+    def _post(path):
+        try:
+            req = urllib.request.Request(base + path, data=b"",
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, _json.loads(e.read())
+            except Exception:
+                return e.code, {"error": str(e)}
+        except Exception as e:
+            return None, {"error": str(e)}
+
+    if sub in ("list", "status"):
+        out = fetch_json(base + "/stats.json", timeout=10)
+        if "error" in out:
+            _print(f"serving host unreachable at {base}: "
+                   f"{out['error']}")
+            return 1
+        tenants = out.get("tenants") or {}
+        if getattr(args, "tenant", None):
+            t = tenants.get(args.tenant)
+            if t is None:
+                _print(f"unknown tenant {args.tenant!r}; admitted: "
+                       f"{sorted(tenants)}")
+                return 1
+            _print(_json.dumps(t, indent=2, default=str))
+            return 0
+        budget = out.get("budget") or {}
+        bb = budget.get("budgetBytes")
+        _print(f"Serving host at {base}: {len(tenants)} tenant(s), "
+               f"{budget.get('residentBytes', 0)} HBM bytes resident"
+               + (f" of {bb} budget" if bb else " (no budget)"))
+        if sub == "list":
+            for k in sorted(tenants):
+                t = tenants[k]
+                pin = " pinned" if t.get("pinned") else ""
+                _print(f"  {k:20s} v={t.get('modelVersion') or '-':<18} "
+                       f"hbm={t.get('hbmBytes', 0):>10} "
+                       f"req={t.get('requests', 0):<8} "
+                       f"evictions={t.get('evictions', 0)}{pin}")
+            return 0
+        _print(_json.dumps(tenants, indent=2, default=str))
+        return 0
+    if sub in ("evict", "pin", "unpin"):
+        st, out = _post(f"/tenants/{args.tenant}/{sub}")
+        _print(_json.dumps(out, indent=2, default=str))
+        return 0 if st == 200 else 1
+    _print("tenants command must be list|status|evict|pin|unpin")
+    return 1
+
+
 def cmd_profile(args) -> int:
     """`pio profile top` (ISSUE 11): the running server's always-on
     sampling profiler, as a folded-stack top table — where the process
@@ -1456,6 +1523,26 @@ def build_parser() -> argparse.ArgumentParser:
                       help="also remove dead-salt directories left by "
                            "kernel changes")
     ca.set_defaults(func=cmd_cache)
+
+    tn = sub.add_parser(
+        "tenants", help="multi-tenant serving host (ISSUE 15): list "
+        "the engines packed on one device, read their per-tenant HBM "
+        "cost, and evict/pin tenants under the budget manager")
+    tnsub = tn.add_subparsers(dest="tenants_command", required=True)
+    tnl = tnsub.add_parser("list")
+    tns = tnsub.add_parser("status")
+    tns.add_argument("tenant", nargs="?",
+                     help="one tenant's full status (default: all)")
+    tne = tnsub.add_parser("evict")
+    tne.add_argument("tenant")
+    tnp = tnsub.add_parser("pin")
+    tnp.add_argument("tenant")
+    tnu = tnsub.add_parser("unpin")
+    tnu.add_argument("tenant")
+    for tsp in (tnl, tns, tne, tnp, tnu):
+        tsp.add_argument("--url", default="http://localhost:8100",
+                         help="serving host base URL")
+    tn.set_defaults(func=cmd_tenants)
 
     rb = sub.add_parser(
         "rollback", help="guarded deploys (ISSUE 5): demote model "

@@ -10,6 +10,8 @@ is garbage-collected.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 import threading
 import weakref
@@ -17,6 +19,59 @@ from typing import Any, Dict, Optional, Tuple
 
 _lock = threading.Lock()
 _cache: Dict[Tuple[int, Any], Tuple[Any, Any]] = {}
+
+# ---------------------------------------------------------------------------
+# Tenant attribution (ISSUE 15)
+#
+# A multi-tenant serving host packs many engines' factor tables into one
+# device's HBM. Every upload that lands in this cache (and every residency
+# slot) is tagged with the tenant active at put time, so the HBM budget
+# manager (tenancy/budget.py) can read per-tenant resident bytes and evict
+# one cold tenant's tables without touching another's. The scope is a
+# contextvar: it follows the query/fold call stack across the serving
+# lock, not threads created inside it.
+# ---------------------------------------------------------------------------
+
+_tenant_var: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("pio_tenant", default=None)
+# cache key -> tenant (entries whose upload ran under a tenant scope)
+_tenant_keys: Dict[Any, str] = {}
+# residency slot name -> tenant
+_tenant_slots: Dict[str, str] = {}
+
+
+def current_tenant() -> Optional[str]:
+    return _tenant_var.get()
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]):
+    """Attribute every upload/residency store inside the block to
+    ``tenant``. None is a no-op scope (single-tenant processes never
+    pay for the tagging)."""
+    if tenant is None:
+        yield
+        return
+    token = _tenant_var.set(str(tenant))
+    try:
+        yield
+    finally:
+        _tenant_var.reset(token)
+
+
+def _tag_key(key):
+    """Record the active tenant for a just-stored cache key. Caller
+    holds ``_lock``."""
+    t = _tenant_var.get()
+    if t is not None:
+        _tenant_keys[key] = t
+
+
+def _evict_cache_key(key):
+    """Weakref eviction callback body: lock-free (gc may run it while
+    this thread already holds ``_lock``; dict pops are GIL-atomic)."""
+    _cache.pop(key, None)
+    _tenant_keys.pop(key, None)
 
 
 def _sharding_key(sharding) -> Any:
@@ -109,11 +164,12 @@ def cached_put(arr, sharding=None):
         else jax.device_put(arr)
     _record_upload(arr)
     try:
-        ref = weakref.ref(arr, lambda r, k=key: _cache.pop(k, None))
+        ref = weakref.ref(arr, lambda r, k=key: _evict_cache_key(k))
     except TypeError:
         return dev  # not weakref-able; skip caching
     with _lock:
         _cache[key] = (ref, dev)
+        _tag_key(key)
     return dev
 
 
@@ -137,11 +193,12 @@ def cached_put_padded(arr, sharding, row_multiple: int):
     dev = jax.device_put(padded, sharding)
     _record_upload(padded)
     try:
-        ref = weakref.ref(arr, lambda r, k=key: _cache.pop(k, None))
+        ref = weakref.ref(arr, lambda r, k=key: _evict_cache_key(k))
     except TypeError:
         return dev
     with _lock:
         _cache[key] = (ref, dev)
+        _tag_key(key)
     return dev
 
 
@@ -178,11 +235,12 @@ def cached_put_rows(arr, target_rows: int, sharding=None):
         else jax.device_put(padded)
     _record_upload(padded)
     try:
-        ref = weakref.ref(arr, lambda r, k=key: _cache.pop(k, None))
+        ref = weakref.ref(arr, lambda r, k=key: _evict_cache_key(k))
     except TypeError:
         return dev
     with _lock:
         _cache[key] = (ref, dev)
+        _tag_key(key)
     return dev
 
 
@@ -195,6 +253,8 @@ def clear():
     with _lock:
         _cache.clear()
         _resident.clear()
+        _tenant_keys.clear()
+        _tenant_slots.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -246,17 +306,27 @@ def put_resident(name: str, key_arrays, payload: dict,
     # already holds _lock (dict pop is GIL-atomic; same discipline as
     # cached_put's eviction callback)
     try:
-        refs = tuple(weakref.ref(a, lambda r, k=name: _resident.pop(k, None))
+        refs = tuple(weakref.ref(a, lambda r, k=name: _evict_slot(k))
                      for a in key_arrays)
     except TypeError:
         return  # not weakref-able: skip residency rather than leak HBM
     with _lock:
         _resident[name] = (refs, payload, sharding)
+        t = _tenant_var.get()
+        if t is not None:
+            _tenant_slots[name] = t
+
+
+def _evict_slot(name: str):
+    """Residency weakref callback body (lock-free, see put_resident)."""
+    _resident.pop(name, None)
+    _tenant_slots.pop(name, None)
 
 
 def drop_resident(name: str):
     with _lock:
         _resident.pop(name, None)
+        _tenant_slots.pop(name, None)
 
 
 def resident_count() -> int:
@@ -304,3 +374,95 @@ def resident_sizes() -> "Dict[str, int]":
         items = list(_resident.items())
     return {name: _payload_nbytes(payload)
             for name, (_refs, payload, _tok) in items}
+
+
+def _payload_arrays(obj):
+    """Flatten a residency payload into its array-like leaves (the
+    same one-level walk as :func:`_payload_nbytes`)."""
+    if isinstance(obj, dict):
+        for v in obj.values():
+            yield from _payload_arrays(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            yield from _payload_arrays(v)
+    elif obj is not None and getattr(obj, "nbytes", 0):
+        yield obj
+
+
+def tenant_device_arrays() -> "Dict[str, list]":
+    """tenant -> live device arrays this cache/residency layer holds
+    for it (cache entries + residency payload leaves). The budget
+    manager sums these identity-DEDUPED together with each slot's own
+    handles — a fold tick attaches the same device arrays to its
+    ShardedTables AND its residency payload, and counting them twice
+    would double the gauge and thrash eviction."""
+    with _lock:
+        keys = [(k, t) for k, t in _tenant_keys.items() if k in _cache]
+        devs = [(t, _cache[k][1]) for k, t in keys]
+        slots = [(n, t) for n, t in _tenant_slots.items()
+                 if n in _resident]
+        payloads = [(t, _resident[n][1]) for n, t in slots]
+    out: Dict[str, list] = {}
+    for t, dev in devs:
+        out.setdefault(t, []).append(dev)
+    for t, payload in payloads:
+        out.setdefault(t, []).extend(_payload_arrays(payload))
+    return out
+
+
+def tenant_sizes() -> "Dict[str, int]":
+    """tenant -> per-device resident bytes across this cache AND the
+    residency slots, measured from the live device arrays (not from
+    put-time estimates), identity-deduped — the raw half of the
+    sample source behind ``pio_engine_hbm_bytes{tenant}``
+    (tenancy/budget.py adds each slot's ShardedTable handles). Tenants
+    with nothing resident simply have no entry."""
+    out: Dict[str, int] = {}
+    for t, arrs in tenant_device_arrays().items():
+        seen = set()
+        total = 0
+        for a in arrs:
+            if id(a) in seen:
+                continue
+            seen.add(id(a))
+            total += _device_nbytes(a)
+        out[t] = total
+    return out
+
+
+def evict_tenant(tenant: str) -> Tuple[int, int]:
+    """Drop every cache entry and residency slot attributed to
+    ``tenant``; the device arrays are freed once no in-flight dispatch
+    holds them (JAX arrays are refcounted — an enqueued window's
+    closure keeps its inputs alive, so eviction never corrupts a
+    dispatched computation; it only stops pinning HBM for the NEXT
+    one). Returns (entries_dropped, per_device_bytes_freed). The host
+    mirrors — the model objects' numpy tables — are untouched: the next
+    hit re-uploads through the budget-checked ``cached_put*`` /
+    ``ShardedTable.device`` paths."""
+    tenant = str(tenant)
+    with _lock:
+        doomed_keys = [k for k, t in _tenant_keys.items() if t == tenant]
+        doomed_slots = [n for n, t in _tenant_slots.items() if t == tenant]
+        freed = 0
+        dropped = 0
+        seen = set()   # identity-dedup: a residency payload may hold
+        #                the same device arrays a cache entry does
+        for k in doomed_keys:
+            entry = _cache.pop(k, None)
+            _tenant_keys.pop(k, None)
+            if entry is not None:
+                dropped += 1
+                if id(entry[1]) not in seen:
+                    seen.add(id(entry[1]))
+                    freed += _device_nbytes(entry[1])
+        for n in doomed_slots:
+            entry = _resident.pop(n, None)
+            _tenant_slots.pop(n, None)
+            if entry is not None:
+                dropped += 1
+                for a in _payload_arrays(entry[1]):
+                    if id(a) not in seen:
+                        seen.add(id(a))
+                        freed += _device_nbytes(a)
+    return dropped, freed

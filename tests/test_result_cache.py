@@ -264,3 +264,92 @@ class TestServerCacheContract:
             assert _ask(s, "u1") == _ask(s, "u1")   # still correct
         finally:
             s.batcher.stop()
+
+
+class TestTenantIsolation:
+    """ISSUE 15 satellite bugfix: the cache keyed on request bytes /
+    canonical query JSON / entity ids only — byte-identical queries
+    from two tenants of a multi-engine host would collide. Every key,
+    raw alias and entity tag is tenant-prefixed; zero cross-tenant
+    hits, ever."""
+
+    def _pair(self):
+        from predictionio_tpu.serving.result_cache import \
+            TenantResultCache
+        inner = ResultCache(max_entries=64, max_bytes=1 << 20)
+        return inner, TenantResultCache(inner, "ta"), \
+            TenantResultCache(inner, "tb")
+
+    def test_zero_cross_tenant_hits(self):
+        inner, a, b = self._pair()
+        q = {"user": "u1", "num": 3}
+        key = query_key(q)
+        raw = json.dumps(q).encode()
+        a.put(key, b'{"from":"a"}', query_entities(q), raw=raw)
+        # byte-identical query via tenant B: MISS on both lookup paths
+        assert b.get_raw(raw) is None
+        assert b.get(key) is None
+        b.put(key, b'{"from":"b"}', query_entities(q), raw=raw)
+        # each tenant still hits its own entry
+        assert a.get(key) == b'{"from":"a"}'
+        assert a.get_raw(raw) == b'{"from":"a"}'
+        assert b.get(key) == b'{"from":"b"}'
+        assert b.get_raw(raw) == b'{"from":"b"}'
+        # the shared pool holds two distinct entries
+        assert inner.stats()["entries"] == 2
+
+    def test_tenant_scoped_entity_invalidation(self):
+        inner, a, b = self._pair()
+        q = {"user": "u1", "num": 3}
+        key = query_key(q)
+        a.put(key, b"A", query_entities(q))
+        b.put(key, b"B", query_entities(q))
+        # tenant A's fold touches u1: ONLY A's entry drops
+        assert a.invalidate_entities(["user:u1"]) == 1
+        assert a.get(key) is None
+        assert b.get(key) == b"B"
+
+    def test_tenant_scoped_full_clear(self):
+        inner, a, b = self._pair()
+        key = query_key({"user": "u1", "num": 1})
+        a.put(key, b"A", ())
+        b.put(key, b"B", ())
+        assert a.invalidate_all("reload") == 1
+        assert a.get(key) is None
+        assert b.get(key) == b"B"
+
+    def test_strict_mode_stays_namespaced(self, monkeypatch):
+        monkeypatch.setenv("PIO_SERVE_CACHE_STRICT", "1")
+        inner, a, b = self._pair()
+        qa = {"user": "u1", "num": 2}
+        qb = {"user": "u9", "num": 2}
+        a.put(query_key(qa), b"A", query_entities(qa),
+              result_items=("i5",))
+        b.put(query_key(qb), b"B", query_entities(qb),
+              result_items=("i5",))
+        # tenant A's tick touches item i5: A's ranking containing i5
+        # drops; tenant B's same-named item is a DIFFERENT item
+        assert a.invalidate_entities(["item:i5"]) == 1
+        assert a.get(query_key(qa)) is None
+        assert b.get(query_key(qb)) == b"B"
+
+    def test_unnamespaced_strict_ignores_namespaced_entries(
+            self, monkeypatch):
+        monkeypatch.setenv("PIO_SERVE_CACHE_STRICT", "1")
+        from predictionio_tpu.serving.result_cache import \
+            TenantResultCache
+        inner = ResultCache(max_entries=64, max_bytes=1 << 20)
+        t = TenantResultCache(inner, "ta")
+        q = {"user": "u1", "num": 2}
+        t.put(query_key(q), b"T", query_entities(q),
+              result_items=("i5",))
+        # an unnamespaced invalidation (standalone-server tags) must
+        # not reach into tenant namespaces
+        assert inner.invalidate_entities(["item:i5"]) == 0
+        assert t.get(query_key(q)) == b"T"
+
+    def test_tenant_id_rejects_separator(self):
+        from predictionio_tpu.serving.result_cache import \
+            TenantResultCache
+        with pytest.raises(ValueError):
+            TenantResultCache(ResultCache(), "a\x1fb")

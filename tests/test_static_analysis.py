@@ -300,3 +300,37 @@ class TestTriageRegressions:
             assert rec.write_errors >= 1
         finally:
             rec.close()
+
+
+class TestZoneCoverage:
+    """ISSUE 15 satellite: tenancy/ modules joined the JAX005 serve
+    zone and the JAX006 pipelined serve zone — a jit dispatched or a
+    host sync written in the multi-tenant host must fail CI exactly
+    like one written in serving/."""
+
+    def test_tenancy_in_serve_zone(self):
+        from predictionio_tpu.analysis.rules_jax import in_serve_zone
+        assert in_serve_zone("predictionio_tpu/tenancy/host.py")
+        assert in_serve_zone("predictionio_tpu/tenancy/budget.py")
+        assert in_serve_zone("predictionio_tpu/serving/server.py")
+        assert not in_serve_zone("predictionio_tpu/ops/markov.py")
+
+    def test_tenancy_in_pipelined_zone(self):
+        from predictionio_tpu.analysis.rules_jax import \
+            in_pipelined_zone
+        assert in_pipelined_zone("predictionio_tpu/tenancy/host.py")
+        assert in_pipelined_zone("predictionio_tpu/serving/batcher.py")
+        assert not in_pipelined_zone("predictionio_tpu/obs/costmon.py")
+
+    def test_tenancy_modules_have_zero_findings(self):
+        """The shipped tenancy modules stay clean under their new zone
+        membership (no baseline entries were added for them)."""
+        import json
+        import pathlib
+        baseline = json.loads(
+            (pathlib.Path(__file__).parent.parent / "conf" /
+             "lint_baseline.json").read_text())
+        entries = baseline if isinstance(baseline, list) \
+            else baseline.get("entries", baseline)
+        text = json.dumps(entries)
+        assert "tenancy/" not in text

@@ -1,0 +1,320 @@
+"""Multi-tenant packing acceptance (ISSUE 15, slow; run by
+scripts/tenant_smoke.sh): three REAL engine tenants — recommendation,
+similarproduct (heterogeneous ALS shapes) and classification
+(naive_bayes, a serving-only tenant with zero HBM footprint) — trained
+through the normal pipeline, packed on one device behind a ServingHost
+under a forced-small ``PIO_TABLE_BUDGET_BYTES``:
+
+- per-tenant ``pio_engine_hbm_bytes{tenant}`` sums to the measured
+  resident bytes;
+- budget pressure triggers real evictions, and an evicted tenant's
+  readmission serves byte-identical responses (host mirrors are the
+  truth);
+- rolling back one tenant's canary leaves the other tenants' models,
+  caches and last-known-good pins untouched;
+- steady-state multi-tenant serving compiles NOTHING after the
+  per-tenant AOT warm (the shared bucket ladder pays once).
+"""
+
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineParams
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.models import classification as C
+from predictionio_tpu.models import recommendation as R
+from predictionio_tpu.models import similarproduct as S
+from predictionio_tpu.serving import ServerConfig
+from predictionio_tpu.tenancy import HostConfig, ServingHost, TenantSpec
+from predictionio_tpu.utils import device_cache
+from predictionio_tpu.workflow import run_train
+
+pytestmark = pytest.mark.slow
+
+#: small enough that all three tenants' padded tables cannot stay
+#: resident together, large enough that each fits alone (estimates at
+#: rank 4 / 64-row buckets: rec ~2 KiB, similarproduct ~3 KiB,
+#: classification ~1.5 KiB)
+BUDGET_BYTES = 4096
+
+
+def _seed_rec(app_id):
+    ev = Storage.get_events()
+    for u in range(4):
+        for i in range(6):
+            ev.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(1 + (u + i) % 5)})),
+                app_id)
+
+
+def _seed_sim(app_id):
+    ev = Storage.get_events()
+    for g in range(2):
+        for i in range(4):
+            ev.insert(Event(event="$set", entity_type="item",
+                            entity_id=f"i{g}{i}",
+                            properties=DataMap({"categories": ["cat"]})),
+                      app_id)
+    for u in range(6):
+        ev.insert(Event(event="$set", entity_type="user",
+                        entity_id=f"u{u}", properties=DataMap({})),
+                  app_id)
+        g = u % 2
+        for i in range(4):
+            ev.insert(Event(event="view", entity_type="user",
+                            entity_id=f"u{u}",
+                            target_entity_type="item",
+                            target_entity_id=f"i{g}{i}",
+                            properties=DataMap({})), app_id)
+
+
+def _seed_cls(app_id):
+    ev = Storage.get_events()
+    rng = np.random.default_rng(0)
+    for j in range(24):
+        label = float(j % 2)
+        base = np.array([8.0, 1.0, 1.0]) if label == 0 \
+            else np.array([1.0, 1.0, 8.0])
+        attrs = base + rng.integers(0, 2, 3)
+        ev.insert(Event(event="$set", entity_type="user",
+                        entity_id=f"u{j}",
+                        properties=DataMap({
+                            "plan": label, "attr0": float(attrs[0]),
+                            "attr1": float(attrs[1]),
+                            "attr2": float(attrs[2])})), app_id)
+
+
+def _train_all():
+    apps = Storage.get_meta_data_apps()
+    rec_app = apps.insert(App(0, "mt-rec"))
+    Storage.get_events().init(rec_app)
+    _seed_rec(rec_app)
+    sim_app = apps.insert(App(0, "mt-sim"))
+    _seed_sim(sim_app)
+    cls_app = apps.insert(App(0, "mt-cls"))
+    _seed_cls(cls_app)
+    run_train(
+        R.RecommendationEngineFactory.apply(),
+        EngineParams(
+            data_source_params=("", R.DataSourceParams(
+                app_name="mt-rec")),
+            preparator_params=("", R.PreparatorParams()),
+            algorithm_params_list=[("als", R.ALSAlgorithmParams(
+                rank=4, num_iterations=2, lam=0.1, seed=1))],
+            serving_params=("", None)),
+        engine_id="mt-rec", engine_version="1", engine_variant="v1",
+        engine_factory="recommendation")
+    run_train(
+        S.SimilarProductEngineFactory.apply(),
+        EngineParams(
+            data_source_params=("", S.DataSourceParams(
+                app_name="mt-sim")),
+            preparator_params=("", None),
+            algorithm_params_list=[("als", S.ALSAlgorithmParams(
+                rank=4, num_iterations=2, lam=0.1, seed=1,
+                alpha=2.0))],
+            serving_params=("", None)),
+        engine_id="mt-sim", engine_version="1", engine_variant="v1",
+        engine_factory="similarproduct")
+    run_train(
+        C.ClassificationEngineFactory.apply(),
+        EngineParams(
+            data_source_params=("", C.DataSourceParams(
+                app_name="mt-cls")),
+            preparator_params=("", None),
+            algorithm_params_list=[("naive",
+                                    C.NaiveBayesAlgorithmParams())],
+            serving_params=("", None)),
+        engine_id="mt-cls", engine_version="1", engine_variant="v1",
+        engine_factory="classification")
+
+
+def _call_raw(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        method="POST" if body is not None else "GET")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, resp.read()
+
+
+def _call(port, path, body=None):
+    st, raw = _call_raw(port, path, body)
+    try:
+        return st, json.loads(raw)
+    except ValueError:
+        return st, raw.decode()
+
+
+QUERIES = {
+    "mt-rec": {"user": "u1", "num": 3},
+    "mt-sim": {"items": ["i00"], "num": 3},
+    "mt-cls": {"attr0": 9.0, "attr1": 1.0, "attr2": 1.0},
+}
+
+
+@pytest.mark.timeout(600)
+def test_three_tenant_packing_under_budget(tmp_env, mesh8,
+                                           monkeypatch):
+    monkeypatch.setenv("PIO_TABLE_BUDGET_BYTES", str(BUDGET_BYTES))
+    # per-tenant AOT warm ON (the conftest default is off): the
+    # zero-compile steady-state claim needs the real deploy-time warm
+    monkeypatch.setenv("PIO_AOT_WARM", "on")
+    device_cache.clear()
+    _train_all()
+    # rec slot canaries (the rollback-isolation scenario below)
+    rec_cfg = ServerConfig(
+        ip="127.0.0.1", port=0, engine_id="mt-rec",
+        engine_version="1", engine_variant="v1",
+        canary_fraction=0.5, canary_window_s=3600,
+        canary_min_requests=10**6)
+    host = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+    assert host.budget.budget_bytes == BUDGET_BYTES
+    host.add_tenant(TenantSpec(key="mt-rec", engine_id="mt-rec",
+                               server_config=rec_cfg))
+    host.add_tenant(TenantSpec(key="mt-sim", engine_id="mt-sim",
+                               engine_version="1",
+                               engine_variant="v1"))
+    host.add_tenant(TenantSpec(key="mt-cls", engine_id="mt-cls",
+                               engine_version="1",
+                               engine_variant="v1"))
+    host.start()
+    port = host.config.port
+    try:
+        # -- all three families serve through one host ------------------
+        st, rec = _call(port, "/engines/mt-rec/queries.json",
+                        QUERIES["mt-rec"])
+        assert st == 200 and rec["itemScores"]
+        st, sim = _call(port, "/engines/mt-sim/queries.json",
+                        QUERIES["mt-sim"])
+        assert st == 200 and sim["itemScores"]
+        st, cls = _call(port, "/engines/mt-cls/queries.json",
+                        QUERIES["mt-cls"])
+        assert st == 200 and cls["label"] == 0.0
+
+        # -- the gauge sums to measured resident bytes ------------------
+        st, mtx = _call(port, "/metrics")
+        gauge = {m.group(1): float(m.group(2)) for m in re.finditer(
+            r'pio_engine_hbm_bytes\{tenant="([^"]+)"\} ([0-9.e+]+)',
+            mtx)}
+        assert set(gauge) == {"mt-rec", "mt-sim", "mt-cls"}
+        measured = host.budget.sizes()
+        for k, v in gauge.items():
+            assert v == measured.get(k, 0), (k, gauge, measured)
+        # heterogeneous shapes: ALS tenants pin HBM, the naive-bayes
+        # serving-only tenant pins none (host-numpy predict)
+        assert gauge["mt-cls"] == 0.0
+        # at least one ALS tenant is resident right now; the forced
+        # budget means the OTHER may have been evicted to make room
+        assert max(gauge["mt-rec"], gauge["mt-sim"]) > 0
+        total_evictions = sum(
+            t["evictions"]
+            for t in host.budget.snapshot()["tenants"].values())
+
+        # -- eviction + readmission: byte-identical responses -----------
+        slot_rec = host.slots["mt-rec"]
+        st, before = _call_raw(port, "/engines/mt-rec/queries.json",
+                               QUERIES["mt-rec"])
+        out = host.evict_tenant("mt-rec")
+        assert host.budget.sizes().get("mt-rec", 0) == 0
+        # drop the tenant's cached responses too: the readmission
+        # must RECOMPUTE from re-uploaded mirrors, not replay bytes
+        slot_rec.server.result_cache.invalidate_all("test")
+        st, after = _call_raw(port, "/engines/mt-rec/queries.json",
+                              QUERIES["mt-rec"])
+        assert after == before
+        assert host.budget.sizes().get("mt-rec", 0) > 0
+
+        # -- canary rollback isolation ----------------------------------
+        import dataclasses
+
+        from predictionio_tpu.guard.canary import CANDIDATE
+        from predictionio_tpu.ops.als import ALSModel
+        base = slot_rec.server.models[0]
+        poisoned = dataclasses.replace(base, als=ALSModel(
+            user_factors=np.full_like(base.als.user_factors, np.nan),
+            item_factors=base.als.item_factors, rank=base.als.rank))
+        lkg = {k: host.slots[k].server.last_good_version
+               for k in host.slots}
+        sim_model_before = host.slots["mt-sim"].server.models[0]
+        cache_entries_before = host.result_cache.stats()["entries"]
+        slot_rec.server.swap_models([poisoned], version="poisoned-rec")
+        assert slot_rec.server.canary.active
+        # a NaN candidate response rolls back instantly
+        slot_rec.server.canary.record(CANDIDATE, nonfinite=1)
+        slot_rec.server._apply_canary_decision()
+        assert not slot_rec.server.canary.active
+        dec = slot_rec.server.canary.last_decision
+        assert dec["decision"] == "rollback"
+        # the neighbors' models, caches and pins never moved
+        assert host.slots["mt-sim"].server.models[0] \
+            is sim_model_before
+        for k in host.slots:
+            assert host.slots[k].server.last_good_version == lkg[k]
+        assert host.result_cache.stats()["entries"] \
+            >= cache_entries_before - 0  # no cross-tenant clear
+        st, sim2 = _call(port, "/engines/mt-sim/queries.json",
+                         QUERIES["mt-sim"])
+        assert sim2 == sim
+
+        # -- steady state compiles nothing after warm -------------------
+        from predictionio_tpu.obs import costmon
+        for k, q in QUERIES.items():   # make every path warm+resident
+            _call(port, f"/engines/{k}/queries.json", q)
+        pre = sum(costmon.compile_seconds_by_executable().values())
+        for rep in range(3):
+            for k, q in QUERIES.items():
+                # num varies within the warmed pow2 ladder so repeats
+                # are not pure result-cache hits
+                body = dict(q)
+                if "num" in body:
+                    body["num"] = 2 + rep
+                st, _ = _call(port, f"/engines/{k}/queries.json", body)
+                assert st == 200
+        post = sum(costmon.compile_seconds_by_executable().values())
+        assert post == pre, (
+            f"steady-state multi-tenant serving compiled "
+            f"{post - pre:.3f}s of XLA after warm")
+
+        # -- per-tenant scheduler attachment: a fold tick hot-swaps
+        # ONLY its slot, and its residency slots carry the tenant tag
+        from predictionio_tpu.online.scheduler import SchedulerConfig
+        sched = host.attach_scheduler(
+            "mt-rec", SchedulerConfig(app_name="mt-rec", max_deltas=1,
+                                      gates=False))
+        assert sched.tenant == "mt-rec"
+        assert host.slots["mt-rec"].scheduler is sched
+        ev = Storage.get_events()
+        rec_app = Storage.get_meta_data_apps().get_by_name("mt-rec")
+        ev.insert(Event(
+            event="rate", entity_type="user", entity_id="u0",
+            target_entity_type="item", target_entity_id="i5",
+            properties=DataMap({"rating": 5.0})), rec_app.id)
+        sim_version = host.slots["mt-sim"].server.model_version
+        report = sched.tick(force=True)
+        assert report is not None and report["events"] >= 1
+        # the rec slot canaries: a fold publish STAGES a candidate on
+        # this slot (per-tenant guarded deploys), leaving mt-sim alone
+        assert host.slots["mt-rec"].server.canary.active
+        assert host.slots["mt-sim"].server.model_version == sim_version
+        assert not host.slots["mt-sim"].server.canary.active
+        # the fold's device-residency slot is attributed to the tenant
+        tagged = {t for t in device_cache._tenant_slots.values()}
+        assert "mt-rec" in tagged, device_cache._tenant_slots
+
+        # -- budget evictions actually happened under pressure ----------
+        st, stats = _call(port, "/stats.json")
+        assert set(stats["tenants"]) == {"mt-rec", "mt-sim", "mt-cls"}
+        assert stats["budget"]["budgetBytes"] == BUDGET_BYTES
+        evs = sum(t["evictions"]
+                  for t in host.budget.snapshot()["tenants"].values())
+        assert evs >= max(total_evictions, 1)
+    finally:
+        host.stop()
