@@ -545,6 +545,44 @@ def math_parity_report(out_path="MATH_PARITY.json", iters=6,
     return 0 if out["parity_ok"] else 1
 
 
+def _populate_columnar(ev, app_id, ui, ii, vv, beat_label="populate",
+                       ts0: int = 1000, user_prefix: str = "u"):
+    """Bulk import through the PRODUCT columnar write path (ISSUE 7
+    insert_columnar: minted ids, vectorized hashing/templating,
+    group-committed blocks) — the same route an operator's
+    /events/columnar.json import takes, so store population exercises
+    and times real product code on every backend instead of a
+    bench-only raw append. eventTime carries the day component so
+    timestamps stay parseable past 24h of millis (31 days covers nnz
+    up to 2.67e9)."""
+    from predictionio_tpu.data.columnar import ColumnarBatch
+
+    def time_str(ts):
+        sec, ms = divmod(ts, 1000)
+        mi, sec = divmod(sec, 60)
+        hh, mi = divmod(mi, 60)
+        dd, hh = divmod(hh, 24)
+        assert dd < 31, "bench populate: ts exceeds January 1970"
+        return "1970-01-%02dT%02d:%02d:%02d.%03dZ" % (
+            dd + 1, hh, mi, sec, ms)
+
+    nnz = len(vv)
+    chunk = 500_000   # bound host memory; heartbeat per chunk
+    for lo in range(0, nnz, chunk):
+        if lo:
+            _beat(f"{beat_label}: populate row {lo}")
+        hi = min(lo + chunk, nnz)
+        ev.insert_columnar(ColumnarBatch(
+            hi - lo, "rate", "user",
+            [f"{user_prefix}{int(u)}" for u in ui[lo:hi]],
+            target_entity_type="item",
+            target_entity_id=[f"i{int(it)}" for it in ii[lo:hi]],
+            properties=[{"rating": round(float(v), 1)}
+                        for v in vv[lo:hi]],
+            event_time=[time_str(ts0 + j) for j in range(lo, hi)]),
+            app_id)
+
+
 def bench_product_path(full_scale: bool):
     """`pio train`-equivalent timing: events already in the store (the
     realistic starting state) -> DataSource columnar scan -> Preparator
@@ -580,55 +618,8 @@ def bench_product_path(full_scale: bool):
         ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
         _beat("bench_product_path: populate")
         t0 = time.perf_counter()
-        if backend == "nativelog":
-            # bulk import straight through the C appender (the analog of
-            # the sqlite executemany below): pre-resolved shard handles,
-            # hand-built compact payloads identical to Events.insert's
-            lib = ev.lib
-            P = ev.partitions
-            handles = [ev._handle_of(app_id, None, p)[0] for p in range(P)]
-            name_hash = lib.el_hash(b"rate", 4)
-            for j, (u, it, v) in enumerate(zip(ui, ii, vv)):
-                if j % 500_000 == 0:  # populate is minutes of host loop
-                    _beat(f"bench_product_path: populate row {j}")
-                ent = b"user\x00u%d" % u
-                tgt = b"item\x00i%d" % it
-                eid = b"e%d" % j
-                ts = 1000 + j
-                # eventTime matches the header ts exactly, as
-                # Events.insert would have written it (day component
-                # carried so timestamps stay parseable past 24h of
-                # millis; 31 days covers nnz up to 2.67e9)
-                sec, ms = divmod(ts, 1000)
-                mi, sec = divmod(sec, 60)
-                hh, mi = divmod(mi, 60)
-                dd, hh = divmod(hh, 24)
-                assert dd < 31, "bench populate: ts exceeds January 1970"
-                payload = (b'{"eventId":"%s","event":"rate","entityType":'
-                           b'"user","entityId":"u%d","targetEntityType":'
-                           b'"item","targetEntityId":"i%d","properties":'
-                           b'{"rating":%.1f},"eventTime":'
-                           b'"1970-01-%02dT%02d:%02d:%02d.%03dZ"}'
-                           % (eid, u, it, v, dd + 1, hh, mi, sec, ms))
-                part = lib.el_hash(ent, len(ent)) % P
-                if lib.el_append(handles[part], eid, len(eid), payload,
-                                 len(payload), ts,
-                                 lib.el_hash(ent, len(ent)), name_hash,
-                                 lib.el_hash(tgt, len(tgt))) != 0:
-                    raise IOError("bench populate: append failed")
-            for h in handles:
-                lib.el_flush(h)
-        else:
-            rows = [(f"e{j}", app_id, 0, "rate", "user", f"u{int(u)}",
-                     "item", f"i{int(it)}", '{"rating": %.1f}' % v,
-                     1000 + j, "[]", None, 1000 + j)
-                    for j, (u, it, v) in enumerate(zip(ui, ii, vv))]
-            with ev.c.lock:
-                ev.c._conn.executemany(
-                    f"INSERT INTO {ev.t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                    rows)
-                ev.c._conn.commit()
-            del rows
+        _populate_columnar(ev, app_id, ui, ii, vv,
+                           beat_label="bench_product_path")
         populate_s = time.perf_counter() - t0
 
         ds = R.RecommendationDataSource(
@@ -1346,6 +1337,151 @@ def bench_multitenant(full_scale: bool):
         }
     finally:
         host.stop()
+
+
+def bench_backfill(full_scale: bool):
+    """Bulk data plane (ISSUE 16, schema-additive): streamed backfill —
+    chunked store cursors + double-buffered H2D staging — against the
+    serial drain (per-event ``find()`` iteration, then one monolithic
+    blocking upload). Also times the snapshot tenant bootstrap end to
+    end on the nativelog backend (restore -> streamed train -> fold
+    catch-up), reporting ``bootstrap_catchup_s``.
+
+    ``backfill_speedup_vs_serial`` compares per-row rates: the serial
+    drain is capped at ``backfill_serial_rows`` on full scale (minutes
+    of per-event Python otherwise) and the cap is REPORTED, never
+    silent."""
+    import tempfile
+
+    from predictionio_tpu.data.event import to_millis
+    from predictionio_tpu.data.storage.base import App
+
+    if full_scale:
+        n_users, n_items, nnz = 138_493, 26_744, 5_000_000
+        serial_cap = 500_000
+    else:
+        n_users, n_items, nnz = 2_000, 500, 60_000
+        serial_cap = 60_000
+
+    backend = os.environ.get("PIO_BENCH_PRODUCT_BACKEND", "nativelog")
+    base = tempfile.mkdtemp(prefix="pio_bench_backfill_")
+    with bench_storage_env(backend, base):
+        import jax
+
+        from predictionio_tpu.data.storage.registry import Storage
+        from predictionio_tpu.dataplane import BulkLoadExecutor
+        from predictionio_tpu.models import recommendation as R
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "backfillapp"))
+        ev = Storage.get_events()
+        ev.init(app_id)
+        ui, ii, vv = synthetic_ml20m(n_users, n_items, nnz)
+        _beat("bench_backfill: populate")
+        _populate_columnar(ev, app_id, ui, ii, vv,
+                           beat_label="bench_backfill")
+
+        p = R.DataSourceParams(app_name="backfillapp")
+
+        # serial drain baseline: the pre-dataplane shape — one event at
+        # a time through find(), per-row Python conversion, then a
+        # single blocking upload once everything is on the host
+        _beat("bench_backfill: serial drain")
+        t0 = time.perf_counter()
+        users, items, vals, ts = [], [], [], []
+        for e in ev.find(app_id=app_id, entity_type="user",
+                         target_entity_type="item",
+                         event_names=["rate", "buy"], limit=serial_cap):
+            users.append(e.entity_id)
+            items.append(e.target_entity_id)
+            vals.append(float(e.properties.fields.get("rating", 4.0))
+                        if e.event == "rate" else 4.0)
+            ts.append(to_millis(e.event_time))
+        n_serial = len(vals)
+        dev = (jax.device_put(np.asarray(vals, np.float32)),
+               jax.device_put(np.asarray(ts, np.int64)))
+        jax.block_until_ready(dev)
+        serial_s = time.perf_counter() - t0
+        del users, items, vals, ts, dev
+
+        # streamed pipeline: read thread -> per-chunk decode -> staged
+        # double-buffered uploads
+        _beat("bench_backfill: streamed pipeline")
+        t0 = time.perf_counter()
+        result = BulkLoadExecutor().run(
+            "backfillapp", property_field="rating",
+            decode=lambda c: R.RecommendationDataSource
+            ._ratings_from_cols(c, p),
+            encode=lambda rd: {"vals": rd.vals, "t": rd.ts},
+            entity_type="user", target_entity_type="item",
+            event_names=["rate", "buy"])
+        stream_s = time.perf_counter() - t0
+        st = result.stats
+        del result
+
+        out = {
+            "backfill_rows": int(st.rows),
+            "backfill_chunks": int(st.chunks),
+            "backfill_wall_s": round(stream_s, 3),
+            "backfill_read_mb_s": round(st.read_mb_s, 1),
+            "backfill_h2d_overlap_frac": round(st.h2d_overlap_frac, 3),
+            "backfill_steady_compiles": int(st.steady_compiles),
+            "backfill_steady_compile_s": round(st.steady_compile_s, 3),
+            "backfill_serial_rows": n_serial,
+            "backfill_serial_wall_s": round(serial_s, 3),
+        }
+        if n_serial and st.rows and stream_s > 0:
+            out["backfill_speedup_vs_serial"] = round(
+                (serial_s / n_serial) / (stream_s / st.rows), 2)
+
+        if backend == "nativelog":
+            # snapshot tenant bootstrap, end to end (restore ->
+            # streamed train -> fold-tail catch-up; no host admission
+            # here — the bench has no serving host to admit into)
+            _beat("bench_backfill: bootstrap")
+            from predictionio_tpu.core import EngineParams
+            from predictionio_tpu.data.storage import snapshot as S
+            from predictionio_tpu.dataplane import bootstrap_from_snapshot
+
+            snap_uri = "file://" + os.path.join(base, "backups")
+            S.create_snapshot(app_id, snap_uri, name="bench")
+
+            def fresh_events(_manifest):
+                # post-restore live traffic the catch-up must fold
+                from predictionio_tpu.data.columnar import ColumnarBatch
+                from predictionio_tpu.data.event import (format_event_time,
+                                                         utcnow)
+                k = 512
+                now = format_event_time(utcnow())
+                ev.insert_columnar(ColumnarBatch(
+                    k, "rate", "user",
+                    [f"fresh_u{j % 97}" for j in range(k)],
+                    target_entity_type="item",
+                    target_entity_id=[f"i{j % n_items}" for j in range(k)],
+                    properties=[{"rating": 5.0}] * k,
+                    event_time=now), app_id)
+
+            params = EngineParams(
+                data_source_params=("", R.DataSourceParams(
+                    app_name="backfillapp", stream=True)),
+                preparator_params=("", R.PreparatorParams()),
+                algorithm_params_list=[("als", R.ALSAlgorithmParams(
+                    rank=8, num_iterations=2, lam=0.05, seed=1))],
+                serving_params=("", None))
+            try:
+                report = bootstrap_from_snapshot(
+                    "bench-tenant", snap_uri, "bench",
+                    R.RecommendationEngineFactory.apply(), params,
+                    force=True, engine_factory="recommendation",
+                    on_restored=fresh_events)
+                out["bootstrap_restore_s"] = round(report.restore_s, 3)
+                out["bootstrap_train_s"] = round(report.train_s, 3)
+                out["bootstrap_catchup_s"] = round(
+                    report.bootstrap_catchup_s, 3)
+                out["bootstrap_catchup_events"] = int(
+                    report.catchup_events)
+            except Exception as e:
+                _beat(f"bench_backfill bootstrap failed: {e}")
+        return out
 
 
 def bench_cold_start(full_scale: bool):
@@ -2134,8 +2270,15 @@ def main():
         # under a forced-tight HBM budget (schema-additive)
         _beat("bench_multitenant")
         multitenant_stats = bench_multitenant(full_scale)
+    backfill_stats = {}
+    if not os.environ.get("PIO_BENCH_SKIP_BACKFILL"):
+        # bulk data plane (ISSUE 16): streamed backfill vs serial
+        # drain + snapshot tenant bootstrap (schema-additive)
+        _beat("bench_backfill")
+        backfill_stats = bench_backfill(full_scale)
     _beat("assemble_output", **ingest_stats, **fold_stats,
-          **sharded_stats, **coldstart_stats, **multitenant_stats)
+          **sharded_stats, **coldstart_stats, **multitenant_stats,
+          **backfill_stats)
     value = als_stats["ratings_per_sec_per_chip"]
     out = {
         "metric": "als_ml20m_rank200_ratings_per_sec_per_chip",
@@ -2154,6 +2297,7 @@ def main():
         **sharded_stats,
         **coldstart_stats,
         **multitenant_stats,
+        **backfill_stats,
     }
     if baseline_stats:
         # the north-star ratio computed from two numbers measured on

@@ -71,6 +71,8 @@ struct Handle {
       col_etype_off, col_ttype_off;
   std::vector<double> col_prop;
   std::vector<uint8_t> col_fallback;  // 1 = record needs python json parse
+  // planning state (el_scan_ts): event times only, no payload IO
+  std::vector<int64_t> plan_ts;
 };
 
 uint64_t fnv1a(const uint8_t* data, size_t len) {
@@ -401,6 +403,46 @@ int64_t el_scan(void* vh, int64_t start_ts, int64_t until_ts,
     h->scan_keys.push_back(&it->first);
   }
   return (int64_t)h->scan_keys.size();
+}
+
+// Planning scan: the same pushed-down predicate walk as el_scan but
+// collecting ONLY event times — no key list, no payload IO. The chunked
+// reader runs this once per shard, merges and sorts the times host-side,
+// and picks complete-millisecond window boundaries before any payload is
+// read, so each extraction window is sized to the chunk target up front.
+// Returns the match count; times are read via el_plan_ts.
+int64_t el_scan_ts(void* vh, int64_t start_ts, int64_t until_ts,
+                   uint64_t entity_hash, const uint64_t* name_hashes,
+                   int32_t n_names, uint64_t target_hash) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  h->plan_ts.clear();
+  for (const std::string& k : h->order) {
+    auto it = h->index.find(k);
+    if (it == h->index.end() || it->second.deleted) continue;
+    const IndexEntry& e = it->second;
+    if (start_ts != INT64_MIN && e.ts < start_ts) continue;
+    if (until_ts != INT64_MIN && e.ts >= until_ts) continue;
+    if (entity_hash != 0 && e.entity_hash != entity_hash) continue;
+    if (target_hash != 0 && e.target_hash != target_hash) continue;
+    if (n_names > 0) {
+      bool ok = false;
+      for (int32_t i = 0; i < n_names; i++) {
+        if (e.name_hash == name_hashes[i]) { ok = true; break; }
+      }
+      if (!ok) continue;
+    }
+    h->plan_ts.push_back(e.ts);
+  }
+  return (int64_t)h->plan_ts.size();
+}
+
+// Pointer to the last el_scan_ts result (valid until the next el_scan_ts
+// or el_close on this handle).
+const int64_t* el_plan_ts(void* vh) {
+  Handle* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  return h->plan_ts.data();
 }
 
 // Fetch the i-th scan result's key; returns key length (buffer valid until

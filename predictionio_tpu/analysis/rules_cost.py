@@ -58,6 +58,12 @@ HOT_PATH_ROOTS: Tuple[Tuple[str, str], ...] = (
     ("batcher.py", "submit"),
     ("batcher.py", "_dispatch"),
     ("batcher.py", "_loop"),
+    # bulk data plane (ISSUE 16): the per-chunk steady loop — an fsync,
+    # eager log render, or metric registration here repeats per chunk
+    # for the whole backfill
+    ("reader.py", "_run"),
+    ("upload.py", "stage"),
+    ("pipeline.py", "run"),
 )
 
 _LOG_METHODS = {"debug", "info", "warning", "error", "exception",

@@ -301,8 +301,13 @@ def in_serve_zone(relpath: str) -> bool:
     parts = relpath.split("/")
     # tenancy/ (ISSUE 15) joins the serve zone: the multi-tenant host
     # sits directly on the query path, so a jit dispatched there
-    # without the compile plane recompiles per tenant shape
-    if {"serving", "guard", "tenancy"}.intersection(parts[:-1]):
+    # without the compile plane recompiles per tenant shape.
+    # dataplane/ (ISSUE 16) joins too: the bulk loader's steady phase
+    # stages a chunk per iteration — a jit dispatched there without
+    # the compile plane's pow2 buckets recompiles per chunk shape,
+    # which is exactly the zero-steady-compile contract it must keep
+    if {"serving", "guard", "tenancy", "dataplane"}.intersection(
+            parts[:-1]):
         return True
     if parts[-1] == "fold_in.py":
         return True
@@ -365,8 +370,13 @@ def check_jax005(repo: RepoModel) -> List[Finding]:
 def in_pipelined_zone(relpath: str) -> bool:
     parts = relpath.split("/")
     # tenancy/ routes into the pipelined executor (ISSUE 15): a host
-    # sync there would stall every tenant's overlap, not just one's
-    return bool({"serving", "tenancy"}.intersection(parts[:-1]))
+    # sync there would stall every tenant's overlap, not just one's.
+    # dataplane/ (ISSUE 16) is pipelined the same way: read/decode of
+    # chunk N+1 overlaps the async upload of chunk N, and the only
+    # legitimate syncs live in ops/staging.py (device_stage submit,
+    # wait_ready) — a sync in dataplane/ re-serializes the backfill
+    return bool({"serving", "tenancy", "dataplane"}.intersection(
+        parts[:-1]))
 
 
 def check_jax006(repo: RepoModel) -> List[Finding]:

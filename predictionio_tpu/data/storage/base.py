@@ -20,11 +20,18 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence
 
 from predictionio_tpu.data.aggregator import aggregate_properties
 from predictionio_tpu.data.datamap import PropertyMap
-from predictionio_tpu.data.event import Event, to_millis as _millis
+from predictionio_tpu.data.event import (Event, from_millis,
+                                         to_millis as _millis)
 
 # Sentinel for "filter requires this field to be absent" (the reference's
 # Option[Option[String]] = Some(None) case in LEvents.futureFind).
 ABSENT = object()
+
+#: default rows per chunk for ``Events.find_columnar_chunked`` — sized so
+#: a chunk's decoded columns stay comfortably inside CPU cache pressure
+#: while still amortizing per-window scan overhead (~256k rows ≈ 10–25 MB
+#: of wire columns).
+DEFAULT_CHUNK_ROWS = 262_144
 
 
 class SQLError(Exception):
@@ -375,6 +382,82 @@ class Events(abc.ABC):
         if property_field is not None:
             out["prop"] = np.array(props, dtype=np.float32)
         return out
+
+    def find_columnar_chunked(self, app_id: int,
+                              channel_id: Optional[int] = None,
+                              property_field: Optional[str] = None,
+                              chunk_rows: Optional[int] = None,
+                              start_time: Optional[_dt.datetime] = None,
+                              until_time: Optional[_dt.datetime] = None,
+                              **filters) -> Iterator[Dict[str, "object"]]:
+        """Streaming columnar read: a generator of ``find_columnar``-shaped
+        column dicts of roughly ``chunk_rows`` rows each, in ascending
+        event-time order — the bulk data plane's cursor contract (the
+        dataplane reader drains it into bounded queues so read, decode
+        and upload overlap instead of draining the store in one shot).
+
+        Chunks break ONLY at complete milliseconds (a millisecond's rows
+        are never split across chunks; a single-millisecond burst larger
+        than ``chunk_rows`` comes back as one oversized chunk), so the
+        concatenation of all chunks is byte-identical to one
+        ``find_columnar`` call over the same range: within a chunk the
+        backend's own intra-millisecond order is preserved, and no row
+        is dropped or duplicated at a boundary. The reader is a forward
+        cursor, not a repeatable snapshot: rows inserted mid-stream
+        at/after the cursor are seen, rows landing behind it are not.
+
+        This default is keyset pagination through ``find_columnar``
+        (``start_time`` cursor + ``limit``), which backends with a query
+        engine already push down (sqlite/pgsql: ``WHERE eventtime >= ?
+        ORDER BY eventtime LIMIT ?`` against the time index); nativelog
+        overrides it with a per-shard planned-window scan and the event
+        server client with wire-level pagination. ``reversed_order`` is
+        not part of the contract."""
+        import numpy as np
+
+        if filters.pop("reversed_order", False):
+            raise ValueError(
+                "find_columnar_chunked streams ascending event time only")
+        if filters.pop("limit", None) not in (None, -1):
+            raise ValueError(
+                "find_columnar_chunked is unbounded; bound by until_time")
+        chunk_rows = int(chunk_rows or DEFAULT_CHUNK_ROWS)
+        if chunk_rows <= 0:
+            raise ValueError("chunk_rows must be positive")
+        cursor = start_time
+        while True:
+            cols = self.find_columnar(
+                app_id, channel_id=channel_id,
+                property_field=property_field, start_time=cursor,
+                until_time=until_time, limit=chunk_rows + 1, **filters)
+            t = cols["t"]
+            n = len(t)
+            if n <= chunk_rows:
+                # the store has no more than a chunk left past the
+                # cursor: this is the final chunk
+                if n:
+                    yield cols
+                return
+            last = int(t[-1])
+            cut = int(np.searchsorted(t, last, side="left"))
+            if cut == 0:
+                # the whole fetch is one millisecond and it overflows
+                # the chunk: fetch that millisecond whole (bounded by
+                # events-per-ms) so it is never split
+                cols = self.find_columnar(
+                    app_id, channel_id=channel_id,
+                    property_field=property_field,
+                    start_time=from_millis(last),
+                    until_time=from_millis(last + 1), limit=-1,
+                    **filters)
+                if len(cols["t"]):
+                    yield cols
+                cursor = from_millis(last + 1)
+            else:
+                # drop the trailing (possibly incomplete) millisecond;
+                # the next window refetches it whole
+                yield {k: v[:cut] for k, v in cols.items()}
+                cursor = from_millis(last)
 
     def find_columnar_by_entities(self, app_id: int,
                                   channel_id: Optional[int] = None,

@@ -175,6 +175,13 @@ def _load_lib():
         lib.el_scan_offsets.argtypes = [ctypes.c_void_p]
         lib.el_scan_nfetched.restype = ctypes.c_int64
         lib.el_scan_nfetched.argtypes = [ctypes.c_void_p]
+        lib.el_scan_ts.restype = ctypes.c_int64
+        lib.el_scan_ts.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int32, ctypes.c_uint64]
+        lib.el_plan_ts.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.el_plan_ts.argtypes = [ctypes.c_void_p]
         lib.el_scan_columnar.restype = ctypes.c_int64
         lib.el_scan_columnar.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.el_col_maxlen.restype = ctypes.c_int64
@@ -198,6 +205,13 @@ def _load_lib():
 
 
 _INT64_MIN = -(2 ** 63)
+
+#: distinguishes "shard invalidated mid-read" from "shard empty" in the
+#: columnar scan paths: the one-shot read drops stale shards (store
+#: removed mid-read, matching the object path), while the chunked reader
+#: must STOP the stream — yielding a chunk assembled next to a swapped
+#: namespace would hand the consumer a torn prefix.
+_STALE = object()
 
 
 def _hash(lib, s: str) -> int:
@@ -1846,12 +1860,10 @@ class NativeLogEvents(base.Events):
                     any_deleted = True
         return any_deleted
 
-    def _coarse_scan(self, h, start_time, until_time, entity_type,
-                     entity_id, event_names, target_entity_type,
-                     target_entity_id) -> int:
-        """Push the coarse predicates down to C (caller holds the
-        handle's per-handle lock — NOT self._lock; scan state is
-        per-handle and concurrent scans on other handles may run)."""
+    def _scan_hashes(self, entity_type, entity_id, event_names,
+                     target_entity_type, target_entity_id):
+        """Coarse-predicate hash arguments shared by every C scan entry
+        point (el_scan / el_scan_ts): 0 means no filter."""
         entity_hash = 0
         if entity_type is not None and entity_id is not None:
             entity_hash = _hash(self.lib, f"{entity_type}\x00{entity_id}")
@@ -1867,11 +1879,32 @@ class NativeLogEvents(base.Events):
         else:
             arr = None
             n_names = 0
-        return self.lib.el_scan(
+        return entity_hash, arr, n_names, target_hash
+
+    def _coarse_scan_ms(self, h, start_ms, until_ms, entity_type,
+                        entity_id, event_names, target_entity_type,
+                        target_entity_id) -> int:
+        """Millisecond-window coarse scan (caller holds the handle's
+        per-handle lock — NOT self._lock; scan state is per-handle and
+        concurrent scans on other handles may run). ``_INT64_MIN``
+        means unbounded on that side."""
+        entity_hash, arr, n_names, target_hash = self._scan_hashes(
+            entity_type, entity_id, event_names, target_entity_type,
+            target_entity_id)
+        return self.lib.el_scan(h, start_ms, until_ms, entity_hash, arr,
+                                n_names, target_hash)
+
+    def _coarse_scan(self, h, start_time, until_time, entity_type,
+                     entity_id, event_names, target_entity_type,
+                     target_entity_id) -> int:
+        """Push the coarse predicates down to C (datetime-flavored
+        wrapper over ``_coarse_scan_ms``)."""
+        return self._coarse_scan_ms(
             h,
             to_millis(start_time) if start_time else _INT64_MIN,
             to_millis(until_time) if until_time else _INT64_MIN,
-            entity_hash, arr, n_names, target_hash)
+            entity_type, entity_id, event_names, target_entity_type,
+            target_entity_id)
 
     def _scan_one(self, hkey, h, lk, start_time=None, until_time=None,
                   entity_type=None, entity_id=None, event_names=None,
@@ -1972,17 +2005,116 @@ class NativeLogEvents(base.Events):
             events = events[:limit]
         return base.events_to_columnar(events, property_field)
 
-    def find_columnar(self, app_id, channel_id=None, property_field=None,
-                      start_time=None, until_time=None, entity_type=None,
-                      entity_id=None, event_names=None,
-                      target_entity_type=None, target_entity_id=None,
-                      limit=None, reversed_order=False):
-        """Columnar ingest, C-side extraction: event times come from the
-        record headers, string fields and the numeric property from the
-        native scanner (el_scan_columnar) — zero JSON parsing on the fast
-        path. Records the scanner can't handle exactly (escapes, exotic
-        types) are flagged and re-parsed here, so correctness never
-        depends on the fast path (the HBPEvents scan-to-RDD role)."""
+    def _columnar_shard(self, hkey, h, lk, property_field, start_ms,
+                        until_ms, entity_type, entity_id, event_names,
+                        target_entity_type, target_entity_id):
+        """Columnar extraction of one shard over a millisecond window
+        (own lock: shard scans run concurrently; all scan state is
+        per-handle). Returns ``_STALE`` when the handle was invalidated
+        mid-read, ``None`` when the window matched nothing, else
+        ``(columns, needs_unicode_flags)``."""
+        import numpy as np
+
+        with lk:
+            if self._stale(hkey, h):
+                return _STALE      # namespace removed/restored mid-read
+            self._coarse_scan_ms(h, start_ms, until_ms, entity_type,
+                                 entity_id, event_names,
+                                 target_entity_type, target_entity_id)
+            n = self.lib.el_scan_columnar(
+                h, (property_field or "").encode("utf-8"))
+            if n < 0:
+                raise IOError("columnar scan failed")
+            if n == 0:
+                return None
+            ts = np.ctypeslib.as_array(
+                self.lib.el_col_ts(h), (n,)).copy()
+            prop = np.ctypeslib.as_array(
+                self.lib.el_col_prop(h), (n,)).astype(np.float32)
+            flags = np.ctypeslib.as_array(
+                self.lib.el_col_fallback(h), (n,)).copy()
+
+            def col(cid):
+                """[n] fixed-width BYTES array for string column
+                `cid` with zero per-record Python work: C fills a
+                row-major padded [n, maxlen] byte matrix (GIL
+                released, so shard columns fill in parallel) and
+                numpy views it as S-dtype — a 5M-row column costs
+                two C passes instead of 5M object allocations. The
+                unicode cast is deferred to the filtered/ordered
+                END of the merge (to_unicode below): filters and
+                gathers run on the ~4x narrower bytes arrays."""
+                na = ctypes.c_uint8(0)
+                m = self.lib.el_col_maxlen(h, cid, ctypes.byref(na))
+                if m < 0:
+                    raise IOError("columnar state missing")
+                if m == 0:
+                    return np.zeros(n, dtype="S1"), False
+                mat = np.zeros((n, int(m)), dtype=np.uint8)
+                if self.lib.el_col_fill(
+                        h, cid,
+                        mat.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint8)),
+                        int(m)) != n:
+                    raise IOError("columnar fill failed")
+                return mat.view(f"S{int(m)}")[:, 0], bool(na.value)
+
+            (ents, na0), (tgts, na1), (names, na2), \
+                (etypes, na3), (ttypes, na4) = (
+                    col(0), col(1), col(2), col(3), col(4))
+            nas = [na0, na1, na2, na3, na4]
+
+            # exact fallback for flagged records (escaped strings
+            # etc.): collected as index -> value, applied after the
+            # arrays exist (assignment into a fixed-width unicode
+            # array would silently truncate longer replacements, so
+            # the column is widened first)
+            repl = {k: {} for k in range(5)}
+            for i in np.nonzero(flags)[0]:
+                out = ctypes.POINTER(ctypes.c_uint8)()
+                klen = self.lib.el_scan_key(h, int(i),
+                                            ctypes.byref(out))
+                if klen < 0:
+                    continue
+                m = self.lib.el_get(h, ctypes.string_at(out, klen),
+                                    klen)
+                if m < 0:
+                    continue
+                d = json.loads(ctypes.string_at(
+                    self.lib.el_buf(h), m).decode("utf-8"))
+                i = int(i)
+                repl[0][i] = d.get("entityId", "")
+                repl[1][i] = d.get("targetEntityId") or ""
+                repl[2][i] = d["event"]
+                repl[3][i] = d.get("entityType", "")
+                repl[4][i] = d.get("targetEntityType") or ""
+                if property_field is not None:
+                    v = (d.get("properties") or {}).get(property_field)
+                    prop[i] = (np.nan
+                               if not isinstance(v, (int, float))
+                               or isinstance(v, bool) else float(v))
+
+            def patched(arr, r, ci):
+                if not r:
+                    return arr
+                enc = {i: v.encode("utf-8") for i, v in r.items()}
+                if any(len(b) != len(v)
+                       for b, v in zip(enc.values(), r.values())):
+                    nas[ci] = True
+                w = max(arr.dtype.itemsize,
+                        max(len(b) for b in enc.values()), 1)
+                arr = arr.astype(f"S{w}")
+                for i, b in enc.items():
+                    arr[i] = b
+                return arr
+
+            return ([patched(a, repl[ci], ci) for ci, a in
+                     enumerate((ents, tgts, names, etypes, ttypes))]
+                    + [ts, prop], nas)
+
+
+    @staticmethod
+    def _empty_columnar(property_field):
         import numpy as np
 
         empty = {"entity_id": np.array([], dtype=str),
@@ -1991,115 +2123,18 @@ class NativeLogEvents(base.Events):
                  "t": np.array([], dtype=np.int64)}
         if property_field is not None:
             empty["prop"] = np.array([], dtype=np.float32)
+        return empty
 
-        def one(hkey, h, lk):
-            """Columnar extraction of one shard (own lock: shard scans
-            run concurrently; all scan state is per-handle)."""
-            with lk:
-                if self._stale(hkey, h):
-                    return None        # store removed mid-read
-                self._coarse_scan(h, start_time, until_time, entity_type,
-                                  entity_id, event_names,
-                                  target_entity_type, target_entity_id)
-                n = self.lib.el_scan_columnar(
-                    h, (property_field or "").encode("utf-8"))
-                if n < 0:
-                    raise IOError("columnar scan failed")
-                if n == 0:
-                    return None
-                ts = np.ctypeslib.as_array(
-                    self.lib.el_col_ts(h), (n,)).copy()
-                prop = np.ctypeslib.as_array(
-                    self.lib.el_col_prop(h), (n,)).astype(np.float32)
-                flags = np.ctypeslib.as_array(
-                    self.lib.el_col_fallback(h), (n,)).copy()
+    def _columnar_merge(self, results, property_field, entity_type,
+                        entity_id, event_names, target_entity_type,
+                        target_entity_id, limit=None,
+                        reversed_order=False):
+        """Merge per-shard columnar results (shard/handle order is the
+        intra-millisecond tiebreak — the chunked reader relies on it
+        being identical between a one-shot read and each window) and
+        apply the exact residual filters + stable time sort."""
+        import numpy as np
 
-                def col(cid):
-                    """[n] fixed-width BYTES array for string column
-                    `cid` with zero per-record Python work: C fills a
-                    row-major padded [n, maxlen] byte matrix (GIL
-                    released, so shard columns fill in parallel) and
-                    numpy views it as S-dtype — a 5M-row column costs
-                    two C passes instead of 5M object allocations. The
-                    unicode cast is deferred to the filtered/ordered
-                    END of the merge (to_unicode below): filters and
-                    gathers run on the ~4x narrower bytes arrays."""
-                    na = ctypes.c_uint8(0)
-                    m = self.lib.el_col_maxlen(h, cid, ctypes.byref(na))
-                    if m < 0:
-                        raise IOError("columnar state missing")
-                    if m == 0:
-                        return np.zeros(n, dtype="S1"), False
-                    mat = np.zeros((n, int(m)), dtype=np.uint8)
-                    if self.lib.el_col_fill(
-                            h, cid,
-                            mat.ctypes.data_as(
-                                ctypes.POINTER(ctypes.c_uint8)),
-                            int(m)) != n:
-                        raise IOError("columnar fill failed")
-                    return mat.view(f"S{int(m)}")[:, 0], bool(na.value)
-
-                (ents, na0), (tgts, na1), (names, na2), \
-                    (etypes, na3), (ttypes, na4) = (
-                        col(0), col(1), col(2), col(3), col(4))
-                nas = [na0, na1, na2, na3, na4]
-
-                # exact fallback for flagged records (escaped strings
-                # etc.): collected as index -> value, applied after the
-                # arrays exist (assignment into a fixed-width unicode
-                # array would silently truncate longer replacements, so
-                # the column is widened first)
-                repl = {k: {} for k in range(5)}
-                for i in np.nonzero(flags)[0]:
-                    out = ctypes.POINTER(ctypes.c_uint8)()
-                    klen = self.lib.el_scan_key(h, int(i),
-                                                ctypes.byref(out))
-                    if klen < 0:
-                        continue
-                    m = self.lib.el_get(h, ctypes.string_at(out, klen),
-                                        klen)
-                    if m < 0:
-                        continue
-                    d = json.loads(ctypes.string_at(
-                        self.lib.el_buf(h), m).decode("utf-8"))
-                    i = int(i)
-                    repl[0][i] = d.get("entityId", "")
-                    repl[1][i] = d.get("targetEntityId") or ""
-                    repl[2][i] = d["event"]
-                    repl[3][i] = d.get("entityType", "")
-                    repl[4][i] = d.get("targetEntityType") or ""
-                    if property_field is not None:
-                        v = (d.get("properties") or {}).get(property_field)
-                        prop[i] = (np.nan
-                                   if not isinstance(v, (int, float))
-                                   or isinstance(v, bool) else float(v))
-
-                def patched(arr, r, ci):
-                    if not r:
-                        return arr
-                    enc = {i: v.encode("utf-8") for i, v in r.items()}
-                    if any(len(b) != len(v)
-                           for b, v in zip(enc.values(), r.values())):
-                        nas[ci] = True
-                    w = max(arr.dtype.itemsize,
-                            max(len(b) for b in enc.values()), 1)
-                    arr = arr.astype(f"S{w}")
-                    for i, b in enc.items():
-                        arr[i] = b
-                    return arr
-
-                return ([patched(a, repl[ci], ci) for ci, a in
-                         enumerate((ents, tgts, names, etypes, ttypes))]
-                        + [ts, prop], nas)
-
-        handles = self._read_handles(app_id, channel_id, entity_type,
-                                     entity_id)
-        results = [s for s in self._parallel(
-            [lambda k=k, h=h, lk=lk: one(k, h, lk)
-             for k, h, lk in handles])
-            if s is not None]
-        if not results:
-            return empty
         na_any = [any(r[1][i] for r in results) for i in range(5)]
         shards = [r[0] for r in results]
         ents, tgts, names, etypes, ttypes, ts, prop = (
@@ -2142,3 +2177,132 @@ class NativeLogEvents(base.Events):
         if property_field is not None:
             out["prop"] = prop[keep][order]
         return out
+
+    def find_columnar(self, app_id, channel_id=None, property_field=None,
+                      start_time=None, until_time=None, entity_type=None,
+                      entity_id=None, event_names=None,
+                      target_entity_type=None, target_entity_id=None,
+                      limit=None, reversed_order=False):
+        """Columnar ingest, C-side extraction: event times come from the
+        record headers, string fields and the numeric property from the
+        native scanner (el_scan_columnar) — zero JSON parsing on the fast
+        path. Records the scanner can't handle exactly (escapes, exotic
+        types) are flagged and re-parsed here, so correctness never
+        depends on the fast path (the HBPEvents scan-to-RDD role)."""
+        start_ms = to_millis(start_time) if start_time else _INT64_MIN
+        until_ms = to_millis(until_time) if until_time else _INT64_MIN
+        handles = self._read_handles(app_id, channel_id, entity_type,
+                                     entity_id)
+        results = [s for s in self._parallel(
+            [lambda k=k, h=h, lk=lk: self._columnar_shard(
+                k, h, lk, property_field, start_ms, until_ms,
+                entity_type, entity_id, event_names,
+                target_entity_type, target_entity_id)
+             for k, h, lk in handles])
+            if s is not None and s is not _STALE]
+        if not results:
+            return self._empty_columnar(property_field)
+        return self._columnar_merge(
+            results, property_field, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id, limit, reversed_order)
+
+    def find_columnar_chunked(self, app_id, channel_id=None,
+                              property_field=None, chunk_rows=None,
+                              start_time=None, until_time=None,
+                              entity_type=None, entity_id=None,
+                              event_names=None, target_entity_type=None,
+                              target_entity_id=None):
+        """Streaming columnar read with REAL pushdown: one ts-only
+        planning scan per shard (el_scan_ts — index walk, zero payload
+        IO) sizes complete-millisecond windows to ``chunk_rows`` up
+        front, then each window runs the parallel per-shard extraction
+        over its [start, until) range so every chunk costs O(window),
+        never O(remaining corpus).
+
+        Consistency contract (the prefix-consistent snapshot model):
+
+        * chunk-concatenation is byte-identical to a one-shot
+          ``find_columnar`` over the same range — windows only break at
+          complete milliseconds and the merge sort is stable by ``t``,
+          so intra-millisecond (shard, log) order is preserved;
+        * events inserted mid-stream at/after the cursor ARE seen (each
+          window re-scans the live index); events landing behind the
+          cursor are not — the reader is a forward cursor, not a
+          repeatable snapshot;
+        * ``invalidate_namespace`` / ``remove`` mid-stream ENDS the
+          stream before the next chunk (handle-identity check + the
+          per-shard ``_STALE`` signal): an in-flight reader sees a
+          consistent prefix of the pre-restore store, never a mix. A
+          reader opened after the restore sees the restored store.
+        """
+        import numpy as np
+
+        chunk_rows = int(chunk_rows or base.DEFAULT_CHUNK_ROWS)
+        if chunk_rows <= 0:
+            raise ValueError("chunk_rows must be positive")
+        start_ms = to_millis(start_time) if start_time else _INT64_MIN
+        until_ms = to_millis(until_time) if until_time else _INT64_MIN
+        handles = self._read_handles(app_id, channel_id, entity_type,
+                                     entity_id)
+        if not handles:
+            return
+
+        def plan_one(hkey, h, lk):
+            with lk:
+                if self._stale(hkey, h):
+                    return _STALE
+                eh, arr, nn, th = self._scan_hashes(
+                    entity_type, entity_id, event_names,
+                    target_entity_type, target_entity_id)
+                n = self.lib.el_scan_ts(h, start_ms, until_ms, eh, arr,
+                                        nn, th)
+                if n < 0:
+                    raise IOError("planning scan failed")
+                if n == 0:
+                    return np.array([], dtype=np.int64)
+                return np.ctypeslib.as_array(
+                    self.lib.el_plan_ts(h), (n,)).copy()
+
+        planned = self._parallel(
+            [lambda k=k, h=h, lk=lk: plan_one(k, h, lk)
+             for k, h, lk in handles])
+        if any(p is _STALE for p in planned):
+            return
+        ts_all = np.sort(np.concatenate(planned))
+        # complete-millisecond boundaries targeting chunk_rows per
+        # window; a single-millisecond burst larger than the chunk is
+        # taken as one whole (oversized) window — a millisecond is
+        # never split across chunks
+        bounds = []
+        i, total = 0, len(ts_all)
+        while total - i > chunk_rows:
+            b = int(ts_all[i + chunk_rows])
+            if b == int(ts_all[i]):
+                b += 1
+            bounds.append(b)
+            i = int(np.searchsorted(ts_all, b, side="left"))
+        windows = list(zip([start_ms] + bounds, bounds + [until_ms]))
+
+        for w0, w1 in windows:
+            results = self._parallel(
+                [lambda k=k, h=h, lk=lk: self._columnar_shard(
+                    k, h, lk, property_field, w0, w1, entity_type,
+                    entity_id, event_names, target_entity_type,
+                    target_entity_id)
+                 for k, h, lk in handles])
+            if any(r is _STALE for r in results):
+                return      # restored mid-stream: stop, never tear
+            # handle-identity re-check right before the yield: a restore
+            # that landed after the window scans finished must not let
+            # this (complete, but pre-restore) chunk imply the stream
+            # continued past it
+            if any(self._handles.get(k) is not h for k, h, _ in handles):
+                return
+            results = [r for r in results if r is not None]
+            if not results:
+                continue
+            out = self._columnar_merge(
+                results, property_field, entity_type, entity_id,
+                event_names, target_entity_type, target_entity_id)
+            if len(out["t"]):
+                yield out

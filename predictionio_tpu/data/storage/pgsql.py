@@ -653,7 +653,14 @@ class PGEvents(base.Events):
                       target_entity_type=None, target_entity_id=None,
                       limit=None, reversed_order=False):
         """Projected scan with server-side JSON extraction — the ingest
-        path (see sqlite.SQLEvents.find_columnar)."""
+        path (see sqlite.SQLEvents.find_columnar).
+
+        The streaming contract (``find_columnar_chunked``, base default)
+        rides this as keyset pagination: ``WHERE eventtime >= ? ORDER BY
+        eventtime LIMIT ?`` per window against the (appid, channelid,
+        eventtime) index. Windows break only at complete milliseconds,
+        so no row is lost or duplicated at a boundary; intra-millisecond
+        order within a window is backend-defined, as in ``find``."""
         import numpy as np
 
         where, params = self._where(

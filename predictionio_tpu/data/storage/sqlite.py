@@ -617,7 +617,16 @@ class SQLEvents(base.Events):
                       limit=None, reversed_order=False):
         """Projected scan: the property value is extracted SQL-side
         (json_extract), rows arrive as flat tuples, and no Event/DataMap
-        objects are built — the ML-20M-scale ingest path."""
+        objects are built — the ML-20M-scale ingest path.
+
+        The streaming contract (``find_columnar_chunked``, base default)
+        rides this as real keyset pagination: each window becomes
+        ``WHERE eventtime >= ? ... ORDER BY eventtime ASC LIMIT ?``
+        against the (appid, channelid, eventtime) index, so a chunk
+        costs one bounded index-range read — never a rescan of the
+        remainder. Equal-eventtime order is rowid (insertion) order,
+        which windowed queries preserve, keeping chunk concatenation
+        byte-identical to the one-shot read."""
         import numpy as np
 
         cols = "entityid, targetentityid, event, eventtime"

@@ -90,6 +90,22 @@ class EventStore:
             app_id=app_id, channel_id=channel_id,
             property_field=property_field, **filters)
 
+    def find_columnar_chunked(self, app_name: str,
+                              channel_name: Optional[str] = None,
+                              property_field: Optional[str] = None,
+                              chunk_rows: Optional[int] = None,
+                              **filters) -> Iterator[Dict[str, "object"]]:
+        """Streaming columnar bulk read (see
+        Events.find_columnar_chunked): a generator of chunk-sized column
+        dicts whose concatenation is byte-identical to ``find_columnar``
+        — the bulk data plane's cursor into the store (dataplane reader
+        threads drain it so read/decode/upload overlap)."""
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        return self.events.find_columnar_chunked(
+            app_id=app_id, channel_id=channel_id,
+            property_field=property_field, chunk_rows=chunk_rows,
+            **filters)
+
     def find_columnar_by_entities(self, app_name: str,
                                   channel_name: Optional[str] = None,
                                   entity_ids=None, target_entity_ids=None,

@@ -1,0 +1,37 @@
+"""Bulk data plane (ISSUE 16): stream the event store onto the device.
+
+The serving/online planes move one event or one query at a time; this
+package owns the BULK movements — training backfills, snapshot-based
+tenant bootstraps — as a three-stage stream with no serial drain:
+
+* :mod:`~predictionio_tpu.dataplane.reader` — parallel partition
+  readers: every backend's ``find_columnar_chunked`` cursor drained on
+  a background thread into a bounded queue;
+* :mod:`~predictionio_tpu.dataplane.upload` — double-buffered H2D
+  staging onto the compile plane's pow2 row buckets (zero steady-phase
+  XLA compiles);
+* :mod:`~predictionio_tpu.dataplane.pipeline` — the executor that
+  overlaps read / decode / upload and attributes each stage
+  (``pio_dataplane_*`` metrics);
+* :mod:`~predictionio_tpu.dataplane.bootstrap` — snapshot restore ->
+  streamed train -> fold-tail catch-up -> ServingHost admission.
+
+Zone discipline: these modules are in the pipelined zone (JAX006) —
+the only device syncs on the bulk path live in ``ops/staging.py``.
+"""
+
+from predictionio_tpu.dataplane.bootstrap import (BootstrapReport,
+                                                  bootstrap_from_snapshot)
+from predictionio_tpu.dataplane.pipeline import (BulkLoadExecutor,
+                                                 BulkLoadResult,
+                                                 BulkLoadStats)
+from predictionio_tpu.dataplane.reader import ChunkReader
+from predictionio_tpu.dataplane.upload import (DeviceStager, StagedSegment,
+                                               StageStats, StreamInterner)
+
+__all__ = [
+    "BootstrapReport", "bootstrap_from_snapshot",
+    "BulkLoadExecutor", "BulkLoadResult", "BulkLoadStats",
+    "ChunkReader",
+    "DeviceStager", "StagedSegment", "StageStats", "StreamInterner",
+]

@@ -1,0 +1,220 @@
+"""Snapshot-based tenant bootstrap: restore -> streamed train -> catch up
+-> admit.
+
+Standing up a new tenant on a serving host used to mean replaying its
+whole event history through the per-event write path before the first
+train could start. This module is the bulk alternative, end to end:
+
+1. **Restore** a ``pio snapshot`` of the source app's nativelog shard
+   files into the tenant's namespace (checksummed, replace-not-merge —
+   ``data/storage/snapshot.py``). The manifest's ``created`` stamp is
+   the catch-up cutover.
+2. **Train** from the restored store through the streaming bulk data
+   plane (chunked reads + double-buffered H2D staging), producing the
+   same engine instance a batch ``pio train`` would — the streamed read
+   is exact-parity by construction.
+3. **Catch up**: attach a delta-training scheduler with its cursor at
+   the snapshot's creation instant and run forced fold ticks until the
+   tail is drained — events that landed after the snapshot was taken
+   are folded in before anyone can query the tenant.
+4. **Admit**: only then does the :class:`ServingHost` get the slot
+   (``admit_server``), with the caught-up scheduler attached.
+
+CLI: ``pio bootstrap <tenant> --snapshot <name> --uri <store>``.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Optional
+
+from predictionio_tpu.data.event import parse_event_time
+
+logger = logging.getLogger(__name__)
+
+#: env var gating the streamed (dataplane) training read in data
+#: sources that support it; bootstrap sets it for its train step
+STREAM_ENV = "PIO_DATAPLANE_STREAM"
+
+
+@dataclass
+class BootstrapReport:
+    """What one snapshot bootstrap did, stage by stage."""
+    tenant: str = ""
+    snapshot: str = ""
+    app_id: int = 0
+    app_name: str = ""
+    cutover: str = ""
+    restored_files: int = 0
+    restored_bytes: int = 0
+    restore_s: float = 0.0
+    engine_instance_id: str = ""
+    train_s: float = 0.0
+    #: the streamed load's stage stats (dataplane.pipeline.last_stats),
+    #: None when the data source fell back to the batch read
+    load: Optional[object] = None
+    catchup_events: int = 0
+    catchup_folds: int = 0
+    bootstrap_catchup_s: float = 0.0
+    admitted: bool = False
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+        d = asdict(self)
+        if self.load is not None:
+            d["load"] = dict(d["load"])
+        return d
+
+
+@contextmanager
+def _env(name: str, value: str):
+    prev = os.environ.get(name)
+    os.environ[name] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
+
+
+def bootstrap_from_snapshot(
+        tenant: str, uri: str, snapshot: str,
+        engine, engine_params,
+        app_name: Optional[str] = None,
+        channel_name: Optional[str] = None,
+        host=None,
+        engine_id: Optional[str] = None,
+        engine_version: str = "0",
+        engine_variant: str = "bootstrap",
+        engine_factory: str = "",
+        force: bool = False,
+        stream: bool = True,
+        scheduler_config=None,
+        start_scheduler: bool = False,
+        max_catchup_folds: int = 100,
+        priority: int = 0, pinned: bool = False,
+        on_restored=None) -> BootstrapReport:
+    """Bootstrap one tenant from a snapshot; returns the stage report.
+
+    ``engine``/``engine_params`` describe what to train (the same
+    objects ``run_train`` takes). ``app_name`` defaults to the data
+    source params' app; the snapshot is restored INTO that app's id
+    (pass ``force=True`` to replace an existing namespace). When
+    ``host`` is given the loaded server is admitted as tenant
+    ``tenant`` after catch-up; without it the report and the trained
+    instance are the product (dry-run / two-phase rollouts).
+    """
+    from predictionio_tpu.data.storage import snapshot as S
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.online.scheduler import (SchedulerConfig,
+                                                   attach_scheduler)
+    from predictionio_tpu.serving import EngineServer, ServerConfig
+    from predictionio_tpu.workflow.core_workflow import run_train
+    from predictionio_tpu.dataplane import pipeline as _pipeline
+
+    report = BootstrapReport(tenant=str(tenant), snapshot=snapshot)
+    if app_name is None:
+        _, ds_params = engine_params.data_source_params
+        app_name = getattr(ds_params, "app_name", None)
+        if channel_name is None:
+            channel_name = getattr(ds_params, "channel_name", None)
+    if not app_name:
+        raise ValueError("no app to bootstrap into: pass app_name or set "
+                         "it in the engine's datasource params")
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise ValueError(f"app {app_name!r} does not exist; create it "
+                         f"first (pio app new)")
+    report.app_name = app_name
+    report.app_id = app.id
+
+    # 1. restore — cutover is the snapshot's creation instant: every
+    # event at/after it must come from the live tail, not the snapshot
+    t0 = time.perf_counter()
+    manifest = S.restore_snapshot(uri, snapshot, app_id=app.id,
+                                  force=force)
+    report.restore_s = time.perf_counter() - t0
+    report.restored_files = len(manifest["files"])
+    report.restored_bytes = sum(e["bytes"] for e in manifest["files"])
+    cutover: _dt.datetime = parse_event_time(manifest["created"])
+    report.cutover = manifest["created"]
+    if on_restored is not None:
+        # the moment to re-point live ingestion at the restored
+        # namespace: everything written from here lands after the
+        # cutover and is folded by the catch-up below (restore REPLACES
+        # the namespace, so writes landing before this call are gone)
+        on_restored(manifest)
+
+    # 2. streamed train over the restored store
+    eid = engine_id or f"bootstrap-{tenant}"
+    _pipeline.last_stats = None
+    t0 = time.perf_counter()
+    with _env(STREAM_ENV, "1" if stream else "0"):
+        instance_id = run_train(
+            engine, engine_params, engine_id=eid,
+            engine_version=engine_version,
+            engine_variant=engine_variant,
+            engine_factory=engine_factory)
+    report.train_s = time.perf_counter() - t0
+    report.engine_instance_id = instance_id
+    report.load = _pipeline.last_stats
+
+    # 3. load the instance into a tenant-tagged server and drain the
+    # fold tail from the cutover BEFORE anyone can route to it
+    server = EngineServer(
+        ServerConfig(ip="127.0.0.1", port=0, engine_id=eid,
+                     engine_version=engine_version,
+                     engine_variant=engine_variant, micro_batch=0),
+        engine=engine, engine_params=engine_params, tenant=str(tenant),
+        shared_result_cache=getattr(host, "result_cache", None))
+    server.load()
+    # gates=False for the catch-up: the pre-swap quality gates protect
+    # LIVE traffic from a bad fold, but nothing routes to this tenant
+    # until admission below — and the gate baseline (the just-trained
+    # model) predates the tail by construction, so drift-style gates
+    # would refuse exactly the catch-up this step exists to apply. An
+    # explicit scheduler_config overrides (and governs the ATTACHED
+    # scheduler's post-admission folds too).
+    cfg = scheduler_config or SchedulerConfig(
+        app_name=app_name, channel_name=channel_name, gates=False)
+    sched = attach_scheduler(server, cfg, cursor=cutover,
+                             tenant=str(tenant))
+    t0 = time.perf_counter()
+    folds = 0
+    while folds < max_catchup_folds:
+        tick = sched.tick(force=True)
+        if tick is None:      # tail drained: nothing pending after poll
+            break
+        folds += 1
+        report.catchup_events += tick.get("events", 0)
+    report.catchup_folds = folds
+    report.bootstrap_catchup_s = time.perf_counter() - t0
+    if scheduler_config is None:
+        # post-admission folds face live traffic again: gates back on
+        from dataclasses import replace
+        sched.config = replace(cfg, gates=True)
+    logger.info("bootstrap %s: caught up %d event(s) in %d fold(s), "
+                "%.3fs", tenant, report.catchup_events, folds,
+                report.bootstrap_catchup_s)
+
+    # 4. admission — the slot becomes routable only now
+    if host is not None:
+        from predictionio_tpu.tenancy import TenantSpec
+        spec = TenantSpec(key=str(tenant), engine_id=eid,
+                          engine_version=engine_version,
+                          engine_variant=engine_variant,
+                          engine_instance_id=instance_id,
+                          priority=priority, pinned=pinned)
+        slot = host.admit_server(spec, server)
+        slot.scheduler = sched
+        report.admitted = True
+        if start_scheduler:
+            sched.start()
+    return report

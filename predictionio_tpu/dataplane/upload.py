@@ -1,0 +1,169 @@
+"""Double-buffered H2D staging: chunk columns -> device segments.
+
+``DeviceStager`` keeps a bounded window (default two slots) of async
+uploads in flight: staging chunk N returns as soon as its
+``jax.device_put`` is SUBMITTED, so the caller decodes chunk N+1 while
+N's bytes cross the PCIe/ICI link; only when the window is full does
+the stager block on the OLDEST upload (that wait is the double-buffer
+back-pressure, and it is the only wait the steady phase ever takes).
+
+Shapes come from the compile plane's pow2 row buckets
+(``compile.buckets.bucket_rows`` via ``ops.staging``), so a stream of
+ragged chunk sizes lands as O(log n) distinct device shapes and any
+jitted consumer downstream compiles per bucket, never per chunk —
+zero XLA compiles in the steady streaming phase.
+
+The actual device touches (submit, completion wait) live in
+``ops/staging.py``: this module is in the pipelined zone, where no
+host sync may appear (JAX006) — the overlap the stager buys must not
+be re-serializable by a stray sync here.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional
+
+import numpy as np
+
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.ops import staging as ops_staging
+
+
+@dataclass
+class StagedSegment:
+    """One uploaded chunk: device-resident columns padded to a pow2
+    bucket, with the valid-row count (rows past ``rows`` are zero
+    padding)."""
+    arrays: Dict[str, "object"]
+    rows: int
+    padded_rows: int
+
+
+@dataclass
+class StageStats:
+    """Upload-stage accounting for one stream."""
+    segments: int = 0
+    rows: int = 0
+    h2d_bytes: int = 0
+    submit_s: float = 0.0   # time in async device_put submission
+    wait_s: float = 0.0     # time blocked on a full in-flight window
+    buckets: List[int] = field(default_factory=list)
+
+    @property
+    def overlap_frac(self) -> float:
+        """Fraction of upload-stage busy time that did NOT block the
+        pipeline: 1.0 means every transfer finished behind the next
+        chunk's decode; 0.0 means each upload was waited for in full
+        (the serial-drain behavior)."""
+        busy = self.submit_s + self.wait_s
+        if busy <= 0.0:
+            return 1.0
+        return 1.0 - (self.wait_s / busy)
+
+
+class DeviceStager:
+    """Bounded-window async uploader for chunk column dicts."""
+
+    def __init__(self, slots: int = 2):
+        self.slots = max(1, int(slots))
+        self._inflight: deque = deque()
+        self._segments: List[StagedSegment] = []
+        self.stats = StageStats()
+        # metric families resolve once at init; the chunk path only
+        # calls .inc() (the PR 2 obs contract)
+        reg = get_registry()
+        self._m_upload_s = reg.counter(
+            "pio_dataplane_upload_seconds_total",
+            "Seconds the dataplane upload stage spent submitting async "
+            "H2D transfers")
+        self._m_wait_s = reg.counter(
+            "pio_dataplane_upload_wait_seconds_total",
+            "Seconds the dataplane upload stage blocked on a full "
+            "in-flight window (un-hidden transfer time)")
+        self._m_bytes = reg.counter(
+            "pio_dataplane_upload_bytes_total",
+            "Host-to-device bytes staged by the dataplane (also "
+            "attributed to the global pio_jax_h2d_bytes_total)")
+        self._m_segments = reg.counter(
+            "pio_dataplane_upload_segments_total",
+            "Chunk segments staged to device by the dataplane")
+
+    def stage(self, arrays: Mapping[str, np.ndarray]) -> None:
+        """Submit one chunk's numeric columns; blocks only when the
+        in-flight window is full (and then only until the OLDEST
+        segment lands)."""
+        if not arrays:
+            return
+        dev, rows, padded, submit_s = ops_staging.device_stage(arrays)
+        nbytes = sum(padded * np.dtype(np.asarray(a).dtype).itemsize
+                     for a in arrays.values())
+        seg = StagedSegment(dev, rows, padded)
+        self._inflight.append(seg)
+        self._segments.append(seg)
+        self.stats.segments += 1
+        self.stats.rows += rows
+        self.stats.h2d_bytes += nbytes
+        self.stats.submit_s += submit_s
+        self.stats.buckets.append(padded)
+        self._m_upload_s.inc(submit_s)
+        self._m_bytes.inc(nbytes)
+        self._m_segments.inc(1)
+        while len(self._inflight) > self.slots:
+            oldest = self._inflight.popleft()
+            waited = ops_staging.wait_ready(oldest.arrays)
+            self.stats.wait_s += waited
+            self._m_wait_s.inc(waited)
+
+    def finish(self) -> List[StagedSegment]:
+        """Drain the in-flight window and return every staged segment
+        (device-resident, transfer complete)."""
+        while self._inflight:
+            oldest = self._inflight.popleft()
+            waited = ops_staging.wait_ready(oldest.arrays)
+            self.stats.wait_s += waited
+            self._m_wait_s.inc(waited)
+        return list(self._segments)
+
+
+class StreamInterner:
+    """First-appearance string -> dense int32 interning for streaming
+    encode stages: chunk N's ids are mapped without knowing chunk N+1's
+    vocabulary, and the mapping is deterministic for a given stream
+    order. ``remap_to_sorted`` returns the permutation onto the sorted
+    vocabulary (``np.unique`` order — what the batch preparator
+    builds), so streamed indices can be reconciled with the batch
+    path's exactly, in one vectorized gather at finalize."""
+
+    def __init__(self):
+        self._ix: Dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._ix)
+
+    def encode(self, ids: np.ndarray) -> np.ndarray:
+        ix = self._ix
+        out = np.empty(len(ids), dtype=np.int32)
+        for i, s in enumerate(ids):
+            key = str(s)
+            v = ix.get(key)
+            if v is None:
+                v = len(ix)
+                ix[key] = v
+            out[i] = v
+        return out
+
+    def vocabulary(self) -> np.ndarray:
+        """Ids in first-appearance (intern) order."""
+        return np.array(list(self._ix.keys()), dtype=str)
+
+    def remap_to_sorted(self) -> np.ndarray:
+        """``perm`` such that ``perm[intern_ix] == sorted_ix`` — apply
+        to streamed index columns to land in the batch path's sorted
+        vocabulary numbering."""
+        vocab = self.vocabulary()
+        order = np.argsort(vocab, kind="stable")
+        perm = np.empty(len(vocab), dtype=np.int32)
+        perm[order] = np.arange(len(vocab), dtype=np.int32)
+        return perm

@@ -47,35 +47,41 @@ def _is_compile_event(name: str) -> bool:
     return "compil" in name  # compile / compilation / compiling
 
 
+def _register_metrics(reg):
+    """One-time family registration — runs once per process under
+    ``install()``'s flag+lock, never per request (COST003 init-time)."""
+    global _m_compiles, _m_compile_s, _m_h2d, _m_d2h
+    _m_compiles = reg.counter(
+        "pio_jax_compiles_total",
+        "XLA compilation events observed via jax.monitoring")
+    _m_compile_s = reg.counter(
+        "pio_jax_compile_seconds_total",
+        "Cumulative backend compile wall time")
+    _m_h2d = reg.counter(
+        "pio_jax_host_to_device_bytes_total",
+        "Bytes uploaded host->device by instrumented paths "
+        "(model tables, solve plans, fold-in uploads)")
+    _m_d2h = reg.counter(
+        "pio_jax_device_to_host_bytes_total",
+        "Bytes fetched device->host by instrumented paths "
+        "(model gathers, predict results)")
+    reg.gauge_func(
+        "pio_jax_device_memory_bytes",
+        "Per-device memory from Device.memory_stats() "
+        "(kind=bytes_in_use|bytes_limit; absent on CPU backends)",
+        _device_memory_samples)
+
+
 def install(registry=None):
     """Register the JAX listeners and gauges on the process registry
     (or ``registry``). Idempotent; never raises — a jax without
     ``jax.monitoring`` just loses the compile counters."""
-    global _installed, _m_compiles, _m_compile_s, _m_h2d, _m_d2h
+    global _installed
     with _lock:
         if _installed:
             return
         _installed = True
-        reg = registry or get_registry()
-        _m_compiles = reg.counter(
-            "pio_jax_compiles_total",
-            "XLA compilation events observed via jax.monitoring")
-        _m_compile_s = reg.counter(
-            "pio_jax_compile_seconds_total",
-            "Cumulative backend compile wall time")
-        _m_h2d = reg.counter(
-            "pio_jax_host_to_device_bytes_total",
-            "Bytes uploaded host->device by instrumented paths "
-            "(model tables, solve plans, fold-in uploads)")
-        _m_d2h = reg.counter(
-            "pio_jax_device_to_host_bytes_total",
-            "Bytes fetched device->host by instrumented paths "
-            "(model gathers, predict results)")
-        reg.gauge_func(
-            "pio_jax_device_memory_bytes",
-            "Per-device memory from Device.memory_stats() "
-            "(kind=bytes_in_use|bytes_limit; absent on CPU backends)",
-            _device_memory_samples)
+        _register_metrics(registry or get_registry())
     try:
         from jax import monitoring
 

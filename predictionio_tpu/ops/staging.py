@@ -1,0 +1,81 @@
+"""Device staging primitives for the bulk data plane (ISSUE 16).
+
+The dataplane executor (``predictionio_tpu/dataplane``) lives in the
+pipelined zone: no host sync may appear there, the same contract the
+serving executor carries (JAX006). The two operations that must touch
+the device — the async upload submit and the bounded-slot completion
+wait — therefore live HERE, in the ops layer, next to the other
+finish()-style sync points:
+
+* :func:`device_stage` — pad a chunk's numeric columns to the compile
+  plane's pow2 row bucket and submit an async ``jax.device_put``,
+  attributing the bytes to the obs plane (``pio_jax_h2d_bytes_total``
+  via ``jaxmon.record_h2d``). Padding means a stream of arbitrary
+  chunk sizes produces only O(log n) distinct device shapes, so any
+  downstream jitted consumer compiles per bucket, never per chunk —
+  zero XLA compiles in the steady streaming phase.
+* :func:`wait_ready` — block until a staged segment's transfer has
+  completed. The dataplane calls this only when its two-slot in-flight
+  window is full (that wait IS the double-buffer back-pressure) and
+  once at finalize.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Mapping, Tuple
+
+import numpy as np
+
+from predictionio_tpu.compile.buckets import bucket_rows
+from predictionio_tpu.obs import jaxmon
+
+
+def pad_to_bucket(arr: np.ndarray, rows: int) -> np.ndarray:
+    """Zero-pad a 1-D column to ``rows`` (a pow2 bucket) so staged
+    shapes come from the compile plane's ladder, not from chunk sizes."""
+    n = len(arr)
+    if n == rows:
+        return np.ascontiguousarray(arr)
+    out = np.zeros(rows, dtype=arr.dtype)
+    out[:n] = arr
+    return out
+
+
+def device_stage(arrays: Mapping[str, np.ndarray]
+                 ) -> Tuple[Dict[str, "object"], int, int, float]:
+    """Submit one chunk's numeric columns to the device asynchronously.
+
+    Every column is padded to the SAME pow2 row bucket
+    (``compile.buckets.bucket_rows`` of the longest column) and shipped
+    with ``jax.device_put``; the put is async on real accelerators, so
+    the caller's next chunk decodes while this one's bytes move.
+    Returns ``(device_arrays, valid_rows, padded_rows, submit_s)``;
+    the uploaded bytes are recorded on the obs plane.
+    """
+    import jax
+
+    rows = max((len(a) for a in arrays.values()), default=0)
+    padded = bucket_rows(rows) if rows else 0
+    t0 = time.perf_counter()
+    out: Dict[str, "object"] = {}
+    nbytes = 0
+    for name, arr in arrays.items():
+        host = pad_to_bucket(np.asarray(arr), padded)
+        out[name] = jax.device_put(host)
+        nbytes += host.nbytes
+    jaxmon.record_h2d(nbytes)
+    return out, rows, padded, time.perf_counter() - t0
+
+
+def wait_ready(device_arrays: Mapping[str, "object"]) -> float:
+    """Block until every array of a staged segment is resident on
+    device; returns the seconds spent blocked. This is the data plane's
+    ONLY completion wait — called from the ops layer so the pipelined
+    dataplane modules stay sync-free (the JAX006 contract)."""
+    import jax
+
+    t0 = time.perf_counter()
+    for a in device_arrays.values():
+        jax.block_until_ready(a)
+    return time.perf_counter() - t0

@@ -727,6 +727,50 @@ def cmd_snapshot(args) -> int:
         return 1
 
 
+def cmd_bootstrap(args) -> int:
+    """`pio bootstrap <tenant> --snapshot <name> --uri <root>` — stand up
+    a new tenant from a snapshot through the bulk data plane (ISSUE 16):
+    restore the shard files, train from the restored store via the
+    streaming read, catch up the fold tail from the snapshot's creation
+    instant, and (with --serve) admit the tenant onto a ServingHost only
+    once caught up."""
+    import json as _json
+    from predictionio_tpu.dataplane import bootstrap_from_snapshot
+    from predictionio_tpu.data.storage.registry import StorageError
+    from predictionio_tpu.data.storage.snapshot import SnapshotError
+    from predictionio_tpu.workflow.create_workflow import (WorkflowConfig,
+                                                           _engine_and_params)
+
+    variant, factory_name, engine, engine_params = _engine_and_params(
+        WorkflowConfig(engine_variant=args.engine_json,
+                       engine_factory=args.engine_factory))
+    host = None
+    if args.serve:
+        from predictionio_tpu.tenancy import HostConfig, ServingHost
+        host = ServingHost(HostConfig(ip=args.ip, port=args.port))
+    try:
+        report = bootstrap_from_snapshot(
+            args.tenant, args.uri, args.snapshot,
+            engine, engine_params,
+            app_name=args.app_name, host=host,
+            engine_id=variant.get("id") or None,
+            engine_variant=args.engine_json,
+            engine_factory=factory_name,
+            force=args.force, stream=not args.no_stream,
+            start_scheduler=args.serve)
+    except (SnapshotError, StorageError, ValueError) as e:
+        _print(f"Bootstrap failed: {e}")
+        if host is not None:
+            host.stop()
+        return 1
+    _print(_json.dumps(report.to_dict(), default=str))
+    if host is None:
+        return 0
+    _print(f"Tenant {args.tenant!r} admitted; serving host live at "
+           f"http://{args.ip}:{args.port}.")
+    return _serve_foreground(host, "serving host")
+
+
 def cmd_run(args) -> int:
     """(Console run — execute a main class/module in the pio environment)"""
     import runpy
@@ -1481,6 +1525,31 @@ def build_parser() -> argparse.ArgumentParser:
     sl = snsub.add_parser("list")
     sl.add_argument("--uri", required=True)
     sn.set_defaults(func=cmd_snapshot)
+
+    bs = sub.add_parser(
+        "bootstrap", help="stand up a new tenant from a snapshot: "
+        "restore, train through the streaming bulk data plane, catch "
+        "up the fold tail, then admit (ISSUE 16)")
+    bs.add_argument("tenant", help="tenant key for the new slot")
+    bs.add_argument("--snapshot", required=True, help="snapshot name")
+    bs.add_argument("--uri", required=True,
+                    help="snapshot blob root, e.g. file:///backups")
+    _add_variant_arg(bs)
+    bs.add_argument("--engine-factory")
+    bs.add_argument("--app-name",
+                    help="app to restore + train into (default: the "
+                         "variant's datasource app_name)")
+    bs.add_argument("--force", action="store_true",
+                    help="replace an existing non-empty namespace")
+    bs.add_argument("--no-stream", action="store_true",
+                    help="train through the monolithic batch read "
+                         "instead of the streaming data plane")
+    bs.add_argument("--serve", action="store_true",
+                    help="start a ServingHost and admit the tenant "
+                         "once caught up (default: report only)")
+    bs.add_argument("--ip", default="0.0.0.0")
+    bs.add_argument("--port", type=int, default=8100)
+    bs.set_defaults(func=cmd_bootstrap)
 
     r = sub.add_parser("run")
     r.add_argument("main_py")

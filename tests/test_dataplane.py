@@ -1,0 +1,594 @@
+"""Bulk data plane (ISSUE 16): chunked store cursors, the streaming
+bulk-load executor, and snapshot-based tenant bootstrap.
+
+Covers the contracts the plane is built on:
+
+* ``find_columnar_chunked`` chunk-concatenation is byte-identical to
+  the one-shot ``find_columnar`` on every backend, for every chunk
+  size, filtered or not — chunks break only at complete milliseconds;
+* mid-stream inserts landing at/after the cursor are seen (forward
+  cursor, not a repeatable snapshot);
+* a snapshot restored mid-stream (``invalidate_namespace``) ENDS an
+  in-flight reader at a consistent prefix — never a torn mix — and a
+  reader opened after the restore sees the restored store;
+* ``ChunkReader`` propagates producer errors and reclaims its thread;
+* ``BulkLoadExecutor`` accumulates exact-parity decoded chunks while
+  double-buffering pow2-padded uploads (zero steady-phase compiles);
+* streamed ``read_training`` equals the batch read bit-for-bit;
+* snapshot bootstrap trains the same model a batch train over the
+  full live store produces, and folds the post-snapshot tail before
+  admission.
+"""
+
+import datetime as dt
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.memory import StorageClient as MemClient
+from predictionio_tpu.data.storage.registry import StorageClientConfig
+from predictionio_tpu.data.storage.sqlite import StorageClient as SQLClient
+
+UTC = dt.timezone.utc
+
+
+def t_ms(ms):
+    """Event time at millisecond ``ms`` past a fixed epoch."""
+    return dt.datetime(2015, 1, 1, tzinfo=UTC) + dt.timedelta(
+        milliseconds=ms)
+
+
+def mk(i, ms, event="rate", rating=None):
+    props = DataMap({"rating": rating} if rating is not None else {})
+    return Event(event=event, entity_type="user", entity_id=f"u{i % 13}",
+                 target_entity_type="item", target_entity_id=f"i{i % 7}",
+                 event_time=t_ms(ms), properties=props)
+
+
+def seed(ev, app_id=1, n=240):
+    """n events over ~n/3 distinct milliseconds (several rows per ms so
+    chunk boundaries actually exercise the complete-millisecond rule),
+    mixed rate/buy."""
+    events = []
+    for i in range(n):
+        ms = (i // 3) * 10          # 3 rows per millisecond
+        if i % 4 == 3:
+            events.append(mk(i, ms, event="buy"))
+        else:
+            events.append(mk(i, ms, rating=float(1 + i % 5)))
+    ev.insert_batch(events, app_id)
+    return n
+
+
+def concat_chunks(chunks, ref_keys):
+    """Concatenate a list of chunk column dicts into one column dict."""
+    if not chunks:
+        return None
+    out = {}
+    for k in ref_keys:
+        out[k] = np.concatenate([c[k] for c in chunks])
+    return out
+
+
+def assert_columns_equal(got, ref):
+    assert set(got.keys()) == set(ref.keys())
+    for k in ref:
+        assert len(got[k]) == len(ref[k]), k
+        nan_ok = np.issubdtype(np.asarray(ref[k]).dtype, np.floating)
+        assert np.array_equal(got[k], ref[k], equal_nan=nan_ok), (
+            f"column {k!r} diverges from the one-shot read")
+
+
+@pytest.fixture(params=["memory", "sqlite", "nativelog", "nativelog-p4"])
+def events(request, tmp_path):
+    if request.param == "memory":
+        c = MemClient(StorageClientConfig("TEST", "memory", {}))
+    elif request.param.startswith("nativelog"):
+        from predictionio_tpu.data.storage.nativelog import \
+            StorageClient as NativeClient
+        cfg = {"PATH": str(tmp_path / "log")}
+        if request.param == "nativelog-p4":
+            cfg["PARTITIONS"] = "4"
+        c = NativeClient(StorageClientConfig("TEST", "nativelog", cfg))
+    else:
+        c = SQLClient(StorageClientConfig(
+            "TEST", "sqlite", {"URL": str(tmp_path / "t.db")}))
+    ev = c.get_data_object("events", "test")
+    ev.init(1)
+    yield ev
+    c.close()
+
+
+class TestChunkedParity:
+    """Chunk-concat == one-shot, across all four backends."""
+
+    @pytest.mark.parametrize("chunk_rows", [1, 7, 50, 10_000])
+    def test_concat_identical(self, events, chunk_rows):
+        seed(events)
+        ref = events.find_columnar(1, property_field="rating")
+        got = concat_chunks(
+            list(events.find_columnar_chunked(
+                1, property_field="rating", chunk_rows=chunk_rows)),
+            ref.keys())
+        assert_columns_equal(got, ref)
+
+    def test_filtered_and_windowed(self, events):
+        seed(events)
+        kw = dict(property_field="rating", event_names=["rate"],
+                  entity_type="user", target_entity_type="item",
+                  start_time=t_ms(100), until_time=t_ms(600))
+        ref = events.find_columnar(1, **kw)
+        assert len(ref["t"])            # the filter matches something
+        got = concat_chunks(
+            list(events.find_columnar_chunked(1, chunk_rows=16, **kw)),
+            ref.keys())
+        assert_columns_equal(got, ref)
+
+    def test_single_ms_burst_never_split(self, events):
+        # 40 rows in ONE millisecond with chunk_rows=4: the burst must
+        # come back as one oversized chunk, identical to the one-shot
+        events.insert_batch(
+            [mk(i, 5, rating=float(i % 5 + 1)) for i in range(40)], 1)
+        ref = events.find_columnar(1, property_field="rating")
+        chunks = list(events.find_columnar_chunked(
+            1, property_field="rating", chunk_rows=4))
+        assert len(chunks) == 1
+        assert_columns_equal(chunks[0], ref)
+
+    def test_empty_store_yields_nothing(self, events):
+        assert list(events.find_columnar_chunked(
+            1, property_field="rating", chunk_rows=8)) == []
+
+    def test_midstream_inserts_after_cursor_are_seen(self, events):
+        """Forward-cursor contract: rows landing at/after the cursor
+        mid-stream show up; the final concat equals a one-shot over the
+        post-insert store."""
+        seed(events, n=120)                 # milliseconds 0..390
+        gen = events.find_columnar_chunked(
+            1, property_field="rating", chunk_rows=9)
+        first = next(gen)
+        # land new rows far PAST the cursor position
+        late = [mk(1000 + i, 5000 + i * 10, rating=5.0)
+                for i in range(12)]
+        events.insert_batch(late, 1)
+        ref = events.find_columnar(1, property_field="rating")
+        assert len(ref["t"]) == 132     # one-shot includes the late rows
+        got = concat_chunks([first] + list(gen), ref.keys())
+        assert_columns_equal(got, ref)
+
+
+@pytest.fixture(params=[1, 4])
+def native_events(request, tmp_path):
+    from predictionio_tpu.data.storage.nativelog import StorageClient
+    c = StorageClient(StorageClientConfig(
+        "TEST", "nativelog", {"PATH": str(tmp_path / "log"),
+                              "PARTITIONS": str(request.param)}))
+    ev = c.get_data_object("events", "test")
+    ev.init(1)
+    yield ev
+    c.close()
+
+
+class TestInvalidateMidStream:
+    """The ISSUE 16 satellite bugfix: chunked readers vs the nativelog
+    ``_absent``-cache/entidx invariants under ``invalidate_namespace``
+    (what a snapshot restore calls last)."""
+
+    def test_inflight_reader_ends_at_consistent_prefix(
+            self, native_events):
+        ev = native_events
+        seed(ev, n=240)
+        ref = ev.find_columnar(1, property_field="rating")
+        gen = ev.find_columnar_chunked(
+            1, property_field="rating", chunk_rows=9)
+        consumed = [next(gen), next(gen)]
+        ev.invalidate_namespace(1)      # the restore's last act
+        consumed.extend(gen)            # stream must END, never tear
+        got = concat_chunks(consumed, ref.keys())
+        n = len(got["t"])
+        assert 0 < n <= len(ref["t"])
+        for k in ref:
+            nan_ok = np.issubdtype(
+                np.asarray(ref[k]).dtype, np.floating)
+            assert np.array_equal(got[k], ref[k][:n],
+                                  equal_nan=nan_ok), (
+                f"column {k!r} is not a prefix of the pre-restore "
+                f"store: the in-flight reader tore")
+
+    def test_new_reader_after_restore_sees_restored_store(
+            self, native_events, tmp_path):
+        """Emulate the restore's effect: replace the namespace content,
+        invalidate, and require a NEW chunked reader to see exactly the
+        replacement (the `_absent` cache must not pin the old view)."""
+        ev = native_events
+        seed(ev, n=120)
+        gen = ev.find_columnar_chunked(
+            1, property_field="rating", chunk_rows=9)
+        next(gen)                       # reader in flight over old data
+        ev.remove(1)                    # replace-not-merge, as restore does
+        ev.init(1)
+        ev.insert_batch(
+            [mk(i, 42, rating=2.0) for i in range(10)], 1)
+        ev.invalidate_namespace(1)
+        list(gen)                       # old reader winds down cleanly
+        ref = ev.find_columnar(1, property_field="rating")
+        assert len(ref["t"]) == 10
+        got = concat_chunks(
+            list(ev.find_columnar_chunked(
+                1, property_field="rating", chunk_rows=4)),
+            ref.keys())
+        assert_columns_equal(got, ref)
+
+
+class _FakeStore:
+    """App-name-keyed store double for ChunkReader/BulkLoadExecutor:
+    yields canned wire chunks, optionally failing mid-stream."""
+
+    def __init__(self, chunks, fail_after=None, block=False):
+        self.chunks = chunks
+        self.fail_after = fail_after
+        self.block = block
+        self.kw = None
+
+    def find_columnar_chunked(self, app_name, channel_name=None,
+                              property_field=None, chunk_rows=None,
+                              **filters):
+        self.kw = dict(app_name=app_name, channel_name=channel_name,
+                       property_field=property_field,
+                       chunk_rows=chunk_rows, **filters)
+        for i, c in enumerate(self.chunks):
+            if self.fail_after is not None and i == self.fail_after:
+                raise RuntimeError("shard scan failed")
+            yield c
+        while self.block:       # infinite producer for close() tests
+            yield _wire_chunk(0, 1)
+            time.sleep(0.01)
+
+
+def _wire_chunk(base_ms, n):
+    return {
+        "entity_id": np.array([f"u{i}" for i in range(n)]),
+        "target_entity_id": np.array([f"i{i}" for i in range(n)]),
+        "event": np.array(["rate"] * n),
+        "t": np.arange(base_ms, base_ms + n, dtype=np.int64),
+        "prop": np.full(n, 3.0, dtype=np.float64),
+    }
+
+
+class TestChunkReader:
+    def test_streams_in_order_with_stats(self):
+        from predictionio_tpu.dataplane import ChunkReader
+        chunks = [_wire_chunk(i * 100, 5) for i in range(4)]
+        store = _FakeStore(chunks)
+        with ChunkReader(store, "app", property_field="rating",
+                         chunk_rows=5, event_names=["rate"]) as r:
+            got = list(r)
+        assert [c["t"][0] for c in got] == [0, 100, 200, 300]
+        assert r.rows == 20 and r.chunks == 4 and r.bytes > 0
+        # filters pass through to the store cursor verbatim
+        assert store.kw["event_names"] == ["rate"]
+        assert store.kw["property_field"] == "rating"
+
+    def test_producer_error_raises_at_consumer(self):
+        from predictionio_tpu.dataplane import ChunkReader
+        store = _FakeStore([_wire_chunk(0, 3)] * 3, fail_after=2)
+        with ChunkReader(store, "app") as r:
+            with pytest.raises(RuntimeError, match="shard scan failed"):
+                list(r)
+
+    def test_close_reclaims_thread_midstream(self):
+        from predictionio_tpu.dataplane import ChunkReader
+        store = _FakeStore([_wire_chunk(0, 2)], block=True)
+        r = ChunkReader(store, "app", queue_depth=1)
+        it = iter(r)
+        next(it)
+        r.close()
+        assert r._thread is not None
+        r._thread.join(timeout=5)
+        assert not r._thread.is_alive()
+        before = threading.active_count()
+        r.close()       # idempotent
+        assert threading.active_count() == before
+
+
+class TestBulkLoadExecutor:
+    def _run(self, chunks, **kw):
+        from predictionio_tpu.dataplane import BulkLoadExecutor
+        ex = BulkLoadExecutor(store=_FakeStore(chunks), chunk_rows=8)
+        return ex.run("app", property_field="rating", **kw)
+
+    def test_decode_accumulates_exact_parity(self, mesh8):
+        chunks = [_wire_chunk(i * 10, 4) for i in range(5)]
+        result = self._run(
+            chunks, decode=lambda c: c["t"] * 2,
+            encode=lambda d: {"t2": d})
+        ref = np.concatenate([c["t"] * 2 for c in chunks])
+        assert np.array_equal(np.concatenate(result.decoded), ref)
+        # staged segments round-trip: device arrays hold the encoded
+        # values, padded to pow2 buckets
+        from predictionio_tpu.compile.buckets import bucket_rows
+        assert len(result.segments) == 5
+        dev = np.concatenate([
+            np.asarray(s.arrays["t2"])[:s.rows] for s in result.segments])
+        assert np.array_equal(dev, ref)
+        for s in result.segments:
+            assert s.padded_rows == bucket_rows(s.rows)
+        st = result.stats
+        assert st.rows == 20 and st.chunks == 5
+        assert st.h2d_bytes > 0 and st.wall_s > 0
+        assert st.steady_compiles == 0
+
+    def test_default_encode_stages_numeric_wire_columns(self, mesh8):
+        result = self._run([_wire_chunk(0, 6)])
+        assert len(result.segments) == 1
+        seg = result.segments[0]
+        assert set(seg.arrays.keys()) == {"t", "prop"}
+        assert seg.rows == 6
+
+    def test_decode_none_skips_chunk(self, mesh8):
+        chunks = [_wire_chunk(0, 4), _wire_chunk(100, 4)]
+        result = self._run(
+            chunks,
+            decode=lambda c: None if c["t"][0] == 0 else c["t"])
+        assert len(result.decoded) == 1
+        # the skipped chunk never reached the stager; the other did
+        assert len(result.segments) == 1
+        assert np.asarray(result.segments[0].arrays["t"])[0] == 100
+        assert result.stats.rows == 8       # read stage still counted it
+
+    def test_stage_off_keeps_host_only(self, mesh8):
+        result = self._run([_wire_chunk(0, 4)], stage=False)
+        assert result.segments == []
+        assert result.stats.h2d_bytes == 0
+
+    def test_last_stats_module_hook(self, mesh8):
+        from predictionio_tpu.dataplane import pipeline
+        pipeline.last_stats = None
+        result = self._run([_wire_chunk(0, 4)])
+        assert pipeline.last_stats is result.stats
+
+
+@pytest.fixture
+def dp_seeded(tmp_env, mesh8):
+    """A sqlite-backed app with deterministic ratings for streamed
+    read_training parity."""
+    from predictionio_tpu.data.storage import App, Storage
+    app_id = Storage.get_meta_data_apps().insert(App(0, "dpapp"))
+    ev = Storage.get_events()
+    ev.init(app_id)
+    events = []
+    for u in range(12):
+        for i in range(9):
+            if (u + i) % 2 == 0:
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    event_time=t_ms(u * 97 + i),
+                    properties=DataMap(
+                        {"rating": float(1 + (u * i) % 5)})))
+            elif (u + i) % 5 == 0:
+                events.append(Event(
+                    event="buy", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    event_time=t_ms(u * 97 + i)))
+    ev.insert_batch(events, app_id)
+    return app_id
+
+
+class TestStreamedTrainingParity:
+    def test_streamed_read_equals_batch_read(self, dp_seeded):
+        from predictionio_tpu.models import recommendation as R
+        batch = R.RecommendationDataSource(R.DataSourceParams(
+            app_name="dpapp", stream=False))._read_ratings()
+        streamed = R.RecommendationDataSource(R.DataSourceParams(
+            app_name="dpapp", stream=True))._read_ratings()
+        assert np.array_equal(batch.users, streamed.users)
+        assert np.array_equal(batch.items, streamed.items)
+        assert np.array_equal(batch.vals, streamed.vals)
+        assert np.array_equal(batch.ts, streamed.ts)
+
+    def test_env_var_activates_stream(self, dp_seeded, monkeypatch):
+        from predictionio_tpu.dataplane import pipeline
+        from predictionio_tpu.models import recommendation as R
+        monkeypatch.setenv("PIO_DATAPLANE_STREAM", "1")
+        pipeline.last_stats = None
+        R.RecommendationDataSource(R.DataSourceParams(
+            app_name="dpapp"))._read_ratings()
+        assert pipeline.last_stats is not None
+        assert pipeline.last_stats.rows > 0
+
+    def test_small_stream_chunks_preserve_parity(self, dp_seeded,
+                                                 monkeypatch):
+        """Force many tiny chunks through the real store cursor — the
+        concat and interner remap must still be exact."""
+        from predictionio_tpu.dataplane import pipeline
+        from predictionio_tpu.models import recommendation as R
+        monkeypatch.setattr(
+            "predictionio_tpu.data.storage.base.DEFAULT_CHUNK_ROWS", 16)
+        batch = R.RecommendationDataSource(R.DataSourceParams(
+            app_name="dpapp", stream=False))._read_ratings()
+        streamed = R.RecommendationDataSource(R.DataSourceParams(
+            app_name="dpapp", stream=True))._read_ratings()
+        assert pipeline.last_stats.chunks > 1
+        assert np.array_equal(batch.users, streamed.users)
+        assert np.array_equal(batch.items, streamed.items)
+        assert np.array_equal(batch.vals, streamed.vals)
+        assert np.array_equal(batch.ts, streamed.ts)
+
+
+# -- snapshot bootstrap e2e -------------------------------------------------
+
+@pytest.fixture
+def nativelog_env(tmp_path, monkeypatch):
+    """tmp_env-style isolated storage with a 4-partition nativelog
+    EVENTDATA backend (snapshots need shard files)."""
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path / "pio"))
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_NAME",
+                       "pio_meta")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE",
+                       "SQLITE")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME",
+                       "pio_event")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE",
+                       "NLOG")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_NAME",
+                       "pio_model")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE",
+                       "LOCALFS")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_SQLITE_TYPE", "sqlite")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_SQLITE_URL",
+                       str(tmp_path / "pio" / "pio.db"))
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_LOCALFS_TYPE", "localfs")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_LOCALFS_HOSTS",
+                       str(tmp_path / "pio" / "models"))
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_NLOG_TYPE", "nativelog")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_NLOG_PATH",
+                       str(tmp_path / "plog"))
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_NLOG_PARTITIONS", "4")
+    from predictionio_tpu.data.storage import registry
+    registry.clear_cache()
+    yield tmp_path
+    registry.clear_cache()
+
+
+def _boot_params(R):
+    from predictionio_tpu.core import EngineParams
+    return EngineParams(
+        data_source_params=("", R.DataSourceParams(app_name="bootapp")),
+        preparator_params=("", R.PreparatorParams()),
+        algorithm_params_list=[("als", R.ALSAlgorithmParams(
+            rank=4, num_iterations=3, lam=0.1, seed=7))],
+        serving_params=("", None))
+
+
+def _boot_seed(app_name="bootapp"):
+    from predictionio_tpu.data.storage import App, Storage
+    app_id = Storage.get_meta_data_apps().insert(App(0, app_name))
+    ev = Storage.get_events()
+    ev.init(app_id)
+    events = []
+    for u in range(8):
+        for i in range(8):
+            if (u + i) % 2 == 0:
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    event_time=t_ms(u * 31 + i),
+                    properties=DataMap(
+                        {"rating": float(1 + (u * i) % 5)})))
+    ev.insert_batch(events, app_id)
+    return app_id, ev
+
+
+def _model_of(server):
+    m = server.models[0]
+    return m
+
+
+class TestSnapshotBootstrap:
+    def test_exact_parity_vs_full_live_train(self, nativelog_env,
+                                             tmp_path, mesh8):
+        """No post-snapshot tail: the bootstrapped tenant's model must
+        equal a batch train over the full live store bit-for-bit (the
+        streamed read is a throughput knob, not a semantics knob)."""
+        from predictionio_tpu.data.storage import snapshot as S
+        from predictionio_tpu.dataplane import bootstrap_from_snapshot
+        from predictionio_tpu.models import recommendation as R
+        from predictionio_tpu.serving import EngineServer, ServerConfig
+        from predictionio_tpu.tenancy import HostConfig, ServingHost
+        from predictionio_tpu.workflow import run_train
+
+        app_id, ev = _boot_seed()
+        uri = f"file://{tmp_path}/backups"
+        S.create_snapshot(app_id, uri, name="snap")
+
+        host = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+        try:
+            report = bootstrap_from_snapshot(
+                "t1", uri, "snap",
+                R.RecommendationEngineFactory.apply(), _boot_params(R),
+                host=host, engine_factory="recommendation", force=True)
+            assert report.admitted
+            assert report.catchup_events == 0
+            assert report.load is not None      # streamed, not batch
+            assert report.load.steady_compiles == 0
+            assert report.load.rows == 32
+            boot_model = _model_of(host.slots["t1"].server)
+
+            # reference: a plain batch train over the same live store
+            iid = run_train(
+                R.RecommendationEngineFactory.apply(), _boot_params(R),
+                engine_id="ref", engine_version="0",
+                engine_variant="ref", engine_factory="recommendation")
+            ref = EngineServer(ServerConfig(
+                ip="127.0.0.1", port=0, engine_id="ref",
+                engine_version="0", engine_variant="ref",
+                micro_batch=0))
+            ref.load()
+            assert ref.engine_instance.id == iid
+            ref_model = _model_of(ref)
+
+            assert boot_model.user_ix.ids_of(
+                range(len(boot_model.user_ix))) == \
+                ref_model.user_ix.ids_of(range(len(ref_model.user_ix)))
+            assert boot_model.item_ix.ids_of(
+                range(len(boot_model.item_ix))) == \
+                ref_model.item_ix.ids_of(range(len(ref_model.item_ix)))
+            assert np.array_equal(
+                np.asarray(boot_model.als.user_factors),
+                np.asarray(ref_model.als.user_factors))
+            assert np.array_equal(
+                np.asarray(boot_model.als.item_factors),
+                np.asarray(ref_model.als.item_factors))
+        finally:
+            host.stop()
+
+    def test_tail_folded_before_admission(self, nativelog_env,
+                                          tmp_path, mesh8):
+        """Events landing after the snapshot (via the on_restored
+        re-point hook) are caught up by fold ticks before the host
+        admits the tenant, and the fresh user is servable."""
+        from predictionio_tpu.data.event import format_event_time
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage import snapshot as S
+        from predictionio_tpu.dataplane import bootstrap_from_snapshot
+        from predictionio_tpu.models import recommendation as R
+        from predictionio_tpu.tenancy import HostConfig, ServingHost
+
+        app_id, ev = _boot_seed()
+        uri = f"file://{tmp_path}/backups"
+        S.create_snapshot(app_id, uri, name="snap")
+
+        def fresh(_manifest):
+            # live ingestion re-pointed at the restored namespace:
+            # these land AFTER the cutover and form the fold tail
+            now = dt.datetime.now(UTC)
+            Storage.get_events().insert_batch([
+                Event(event="rate", entity_type="user",
+                      entity_id="fresh_u", target_entity_type="item",
+                      target_entity_id=f"i{i}", event_time=now,
+                      properties=DataMap({"rating": 5.0}))
+                for i in range(4)], app_id)
+
+        host = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+        try:
+            report = bootstrap_from_snapshot(
+                "t2", uri, "snap",
+                R.RecommendationEngineFactory.apply(), _boot_params(R),
+                host=host, engine_factory="recommendation", force=True,
+                on_restored=fresh)
+            assert report.admitted
+            assert report.catchup_events == 4
+            assert report.catchup_folds >= 1
+            assert report.bootstrap_catchup_s > 0
+            # post-catch-up, default config turns the gates back on for
+            # the live-traffic folds
+            assert host.slots["t2"].scheduler.config.gates
+            server = host.slots["t2"].server
+            out = server.handle_query({"user": "fresh_u", "num": 3})
+            assert len(out["itemScores"]) > 0
+        finally:
+            host.stop()

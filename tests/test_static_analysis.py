@@ -334,3 +334,42 @@ class TestZoneCoverage:
             else baseline.get("entries", baseline)
         text = json.dumps(entries)
         assert "tenancy/" not in text
+
+    def test_dataplane_in_both_jax_zones(self):
+        """ISSUE 16 satellite: dataplane/ joins the serve zone (a jit
+        dispatched per chunk without the compile plane recompiles per
+        chunk shape) and the pipelined zone (a host sync in the bulk
+        loop re-serializes the read/decode/upload overlap — syncs
+        belong in ops/staging.py, which stays OUT of both zones only
+        for JAX006; it is in the JAX001 hot zone like all of ops/)."""
+        from predictionio_tpu.analysis.rules_jax import (
+            in_pipelined_zone, in_serve_zone)
+        for mod in ("reader.py", "upload.py", "pipeline.py",
+                    "bootstrap.py"):
+            rel = f"predictionio_tpu/dataplane/{mod}"
+            assert in_serve_zone(rel), rel
+            assert in_pipelined_zone(rel), rel
+        # the staging ops module is where the syncs legitimately live
+        assert not in_pipelined_zone("predictionio_tpu/ops/staging.py")
+
+    def test_dataplane_cost_roots_pinned(self):
+        """The per-chunk steady-loop entry points are COST hot-path
+        roots: fsync / eager log / metric registration reachable from
+        them repeats per chunk for the whole backfill."""
+        from predictionio_tpu.analysis.rules_cost import HOT_PATH_ROOTS
+        for root in (("reader.py", "_run"), ("upload.py", "stage"),
+                     ("pipeline.py", "run")):
+            assert root in HOT_PATH_ROOTS, root
+
+    def test_dataplane_modules_have_zero_findings(self):
+        """The shipped dataplane modules stay clean under their zone
+        membership — no baseline entries were added for them."""
+        import json
+        import pathlib
+        baseline = json.loads(
+            (pathlib.Path(__file__).parent.parent / "conf" /
+             "lint_baseline.json").read_text())
+        entries = baseline if isinstance(baseline, list) \
+            else baseline.get("entries", baseline)
+        text = json.dumps(entries)
+        assert "dataplane/" not in text
