@@ -1209,7 +1209,14 @@ def bench_multitenant(full_scale: bool):
     ``serve_p50_ms_multitenant`` / ``serve_p99_ms_multitenant`` (mixed
     workload latency through the host's per-tenant routing),
     ``tenant_evictions`` (budget evictions during the timed window)
-    and ``hbm_bytes_by_tenant`` (the per-tenant gauge at the end)."""
+    and ``hbm_bytes_by_tenant`` (the per-tenant gauge at the end).
+
+    ISSUE 17 additions: ``serve_p99_ms_by_tenant`` (the same timed
+    window split per tenant), ``device_time_share_by_tenant`` (costmon
+    attribution at the end of the run) and ``tenant_obs_overhead_ms``
+    (the per-request cost of the tenant observability additions —
+    scope entry, contextvar reads, labeled-child bookkeeping — which
+    must stay under 1% of serve p50)."""
     import datetime as dt
     import threading
 
@@ -1300,7 +1307,7 @@ def bench_multitenant(full_scale: bool):
                     t0 = time.perf_counter()
                     c.post({"user": str(u), "num": 10}, timeout=600,
                            path=f"/engines/{k}/queries.json")
-                    mine.append(time.perf_counter() - t0)
+                    mine.append((k, time.perf_counter() - t0))
                 c.close()
                 with lock:
                     lat.extend(mine)
@@ -1324,16 +1331,53 @@ def bench_multitenant(full_scale: bool):
         snap = host.budget.snapshot()
         evictions = sum(t["evictions"]
                         for t in snap["tenants"].values()) - ev0
+        all_lat = [d for _, d in lat]
+        by_tenant = {k: [d for kk, d in lat if kk == k] for k in keys}
+
+        # tenant obs tax (ISSUE 17): the per-request additions are one
+        # scope entry + the contextvar/registered-set reads + one
+        # labeled-child inc — measured standalone, best-of-3, and held
+        # to <= 1% of serve p50 by tests/test_obs_overhead.py
+        from predictionio_tpu.obs import MetricsRegistry
+        from predictionio_tpu.obs.tenantctx import (current_tenant,
+                                                    metric_tenant_label,
+                                                    tenant_scope)
+        reg = MetricsRegistry()
+        fam = reg.counter("bench_tenant_obs", "x",
+                          labelnames=("tenant",))
+
+        def _tenant_obs_once():
+            with tenant_scope("t0"):
+                current_tenant()
+                fam.labels(tenant=metric_tenant_label()).inc()
+
+        n = 2000
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                _tenant_obs_once()
+            d = time.perf_counter() - t0
+            best = d if best is None else min(best, d)
+        obs_ms = best / n * 1000.0
+        from predictionio_tpu.obs import costmon
+        dev_share = costmon.tenant_device_time_share()
         return {
             "serve_p50_ms_multitenant": round(
-                float(np.percentile(lat, 50)) * 1000, 3),
+                float(np.percentile(all_lat, 50)) * 1000, 3),
             "serve_p99_ms_multitenant": round(
-                float(np.percentile(lat, 99)) * 1000, 3),
+                float(np.percentile(all_lat, 99)) * 1000, 3),
+            "serve_p99_ms_by_tenant": {
+                k: round(float(np.percentile(v, 99)) * 1000, 3)
+                for k, v in sorted(by_tenant.items()) if v},
             "multitenant_qps": round(len(lat) / wall, 1),
             "tenant_evictions": int(evictions),
             "hbm_bytes_by_tenant": {
                 k: int(v["hbmBytes"])
                 for k, v in sorted(snap["tenants"].items())},
+            "device_time_share_by_tenant": {
+                k: dev_share.get(k, 0.0) for k in sorted(keys)},
+            "tenant_obs_overhead_ms": round(obs_ms, 6),
         }
     finally:
         host.stop()

@@ -32,6 +32,18 @@ The runtime-attribution plane (ISSUE 11) completes the picture:
 - ``obs.slowlog`` — slow-query stage waterfalls (``GET /slow.json``)
   with exemplar trace ids.
 
+The tenant signals plane (ISSUE 17) adds the attribution dimension:
+
+- ``obs.tenantctx`` — the process-wide tenant contextvar
+  (``tenant_scope``/``current_tenant``) every routing, tick and
+  device-dispatch path enters, plus the registered-tenant set that
+  bounds the ``tenant`` metric label's cardinality.
+- ``obs.costmon`` books device time per ``{executable,tenant}`` and
+  derives per-tenant occupancy/device-time shares.
+- ``obs.slo`` instantiates per-tenant spec sets and evaluates them
+  against only that tenant's series; ``obs.incidents`` bundles carry
+  the tenant and slice forensics to it.
+
 The fleet plane (ISSUE 13) makes all of it cross-process:
 
 - ``obs.trace`` gains the ``X-PIO-Trace-Id``/``X-PIO-Parent-Span``
@@ -67,6 +79,11 @@ from predictionio_tpu.obs.profiler import (PROFILER, SamplingProfiler,
                                            get_profiler)
 from predictionio_tpu.obs.slowlog import (SLOWLOG, SlowQueryLog,
                                           get_slowlog, slow_response)
+from predictionio_tpu.obs.tenantctx import (TENANT_LABEL, current_tenant,
+                                            metric_tenant_label,
+                                            register_tenant,
+                                            registered_tenants,
+                                            tenant_scope)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "FuncCollector", "Gauge", "Histogram",
@@ -82,4 +99,6 @@ __all__ = [
     "default_event_specs", "health_response",
     "PROFILER", "SamplingProfiler", "get_profiler",
     "SLOWLOG", "SlowQueryLog", "get_slowlog", "slow_response",
+    "TENANT_LABEL", "current_tenant", "metric_tenant_label",
+    "register_tenant", "registered_tenants", "tenant_scope",
 ]

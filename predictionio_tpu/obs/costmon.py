@@ -34,11 +34,11 @@ ROADMAP item builds on (ALX-style per-core memory budgeting).
 Device-time attribution (ISSUE 11): compile seconds explain the warmup;
 ``device_timed(label, fn, *args)`` explains the steady state. Every
 AOT/jit dispatch through it counts its **dispatch wall** (the async
-enqueue — µs) into ``pio_dispatch_seconds_total{executable}``, and a
-1-in-N sampled dispatch additionally ``block_until_ready``s the result
-to measure the **true device wall**, incrementing
-``pio_device_time_seconds_total{executable}`` by ``wall * N`` (the
-standard sampled extrapolation — unbiased as long as the sampled
+enqueue — µs) into ``pio_dispatch_seconds_total{executable,tenant}``,
+and a 1-in-N sampled dispatch additionally ``block_until_ready``s the
+result to measure the **true device wall**, incrementing
+``pio_device_time_seconds_total{executable,tenant}`` by ``wall * N``
+(the standard sampled extrapolation — unbiased as long as the sampled
 dispatch is exchangeable with its window, which steady serving traffic
 is). The synced walls also feed a per-label rolling ring
 (``device_time_percentiles``) and the ``pio_device_occupancy`` EWMA
@@ -46,6 +46,18 @@ gauge — the ALX-style "which executable owns the accelerator"
 accounting the sharding/multi-tenant ROADMAP items need.
 ``PIO_DEVICE_SYNC_EVERY`` tunes N (default 16; 0 disables the sync,
 leaving only the dispatch-wall counters).
+
+Tenant dimension (ISSUE 17): the ``tenant`` label value is the active
+``obs.tenantctx`` scope — entered at host routing, the pipelined
+batcher's formation/completion threads, and tenant-attached scheduler
+ticks — mapped through ``metric_tenant_label`` so cardinality stays
+bounded by registered tenants (unregistered scopes book under ``""``,
+the shared/untenanted series). Per-tenant occupancy shares ride the
+same ~1s window as the process EWMA: each window's attributed seconds
+split by tenant feed ``pio_tenant_occupancy_share{tenant}`` (EWMA,
+decayed when a tenant goes quiet), and the cumulative device-seconds
+split backs ``tenant_device_time_share()`` — the noisy-neighbor
+signal ``GET /tenants/signals.json`` serves.
 """
 
 from __future__ import annotations
@@ -61,6 +73,7 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 from predictionio_tpu.obs.metrics import get_registry
+from predictionio_tpu.obs.tenantctx import metric_tenant_label
 
 logger = logging.getLogger(__name__)
 
@@ -89,6 +102,7 @@ _c_dispatch_s = None
 _c_device_s = None
 _c_device_syncs = None
 _g_occupancy = None
+_g_tenant_occ = None
 
 
 def _is_backend_compile(name: str) -> bool:
@@ -101,7 +115,7 @@ def install(registry=None):
     """Register the listener + gauges. Idempotent; never raises."""
     global _installed, _c_seconds, _c_hits, _c_misses, _g_flops, \
         _g_bytes, _c_pc_hits, _c_pc_misses, _c_dispatch_s, \
-        _c_device_s, _c_device_syncs, _g_occupancy
+        _c_device_s, _c_device_syncs, _g_occupancy, _g_tenant_occ
     with _lock:
         if _installed:
             return
@@ -147,23 +161,31 @@ def install(registry=None):
         _c_dispatch_s = reg.counter(
             "pio_dispatch_seconds_total",
             "Wall time spent in device dispatch calls (the async "
-            "enqueue, NOT device execution) by executable label",
-            labelnames=("executable",))
+            "enqueue, NOT device execution) by executable label and "
+            "serving tenant (empty = untenanted)",
+            labelnames=("executable", "tenant"))
         _c_device_s = reg.counter(
             "pio_device_time_seconds_total",
-            "Estimated device execution wall time by executable: each "
-            "1-in-N sampled dispatch is synced (block_until_ready) and "
-            "its wall extrapolated by the sampling factor",
-            labelnames=("executable",))
+            "Estimated device execution wall time by executable and "
+            "serving tenant: each 1-in-N sampled dispatch is synced "
+            "(block_until_ready) and its wall extrapolated by the "
+            "sampling factor",
+            labelnames=("executable", "tenant"))
         _c_device_syncs = reg.counter(
             "pio_device_syncs_total",
             "Sampled dispatches that paid a block_until_ready to "
-            "measure true device wall", labelnames=("executable",))
+            "measure true device wall",
+            labelnames=("executable", "tenant"))
         _g_occupancy = reg.gauge(
             "pio_device_occupancy",
             "EWMA fraction of wall-clock time the device spent "
             "executing attributed work (clamped to 1; from the sampled "
             "device-time estimates)")
+        _g_tenant_occ = reg.gauge(
+            "pio_tenant_occupancy_share",
+            "Per-tenant EWMA share of wall-clock device occupancy "
+            "(from the sampled device-time estimates; decays when a "
+            "tenant stops dispatching)", labelnames=("tenant",))
     try:
         from jax import monitoring
         monitoring.register_event_duration_secs_listener(_on_duration)
@@ -258,25 +280,31 @@ def _sync_every_default() -> int:
 
 
 class _DeviceState:
-    """Per-label hot-path state: pre-resolved counter children (no
-    .labels() lock per dispatch), an atomic dispatch tick for the
-    1-in-N sampling decision, and a bounded ring of sampled device
-    walls for percentile views."""
+    """Per-(label, tenant) hot-path state: pre-resolved counter
+    children (no .labels() lock per dispatch), an atomic dispatch tick
+    for the 1-in-N sampling decision, and a bounded ring of sampled
+    device walls for percentile views."""
 
     __slots__ = ("dispatch_s", "device_s", "syncs", "tick", "ring",
-                 "every")
+                 "every", "tenant")
 
-    def __init__(self, label: str, every: int):
-        self.dispatch_s = _c_dispatch_s.labels(executable=label)
-        self.device_s = _c_device_s.labels(executable=label)
-        self.syncs = _c_device_syncs.labels(executable=label)
+    def __init__(self, label: str, tenant: str, every: int):
+        self.tenant = tenant
+        self.dispatch_s = _c_dispatch_s.labels(executable=label,
+                                               tenant=tenant)
+        self.device_s = _c_device_s.labels(executable=label,
+                                           tenant=tenant)
+        self.syncs = _c_device_syncs.labels(executable=label,
+                                            tenant=tenant)
         self.tick = itertools.count()       # next() is GIL-atomic
         self.ring = collections.deque(maxlen=128)
         self.every = every
 
 
 _dev_lock = threading.Lock()
-_dev_state: Dict[str, _DeviceState] = {}
+# (executable label, tenant label value) -> state; the tenant half is
+# already cardinality-bounded by metric_tenant_label
+_dev_state: Dict[tuple, _DeviceState] = {}
 _block_until_ready = None
 # process occupancy state: estimated device seconds ACCUMULATE into a
 # ~1s wall window shared by every label, and the EWMA updates once per
@@ -287,41 +315,71 @@ _OCC_WINDOW_S = 1.0
 _occ_window_t0: Optional[float] = None
 _occ_acc = 0.0
 _occ_ewma = 0.0
+# per-tenant split of the same window: tenant label value -> attributed
+# seconds this window, and the EWMA share map signals.json reads
+_occ_acc_tenant: Dict[str, float] = {}
+_occ_share_ewma: Dict[str, float] = {}
 
 
-def _device_state(label: str) -> _DeviceState:
-    st = _dev_state.get(label)
+def _device_state(label: str, tenant: str = "") -> _DeviceState:
+    st = _dev_state.get((label, tenant))
     if st is None:
         if not _installed:
             install()
         with _dev_lock:
-            st = _dev_state.get(label)
+            st = _dev_state.get((label, tenant))
             if st is None:
-                st = _DeviceState(label, _sync_every_default())
-                _dev_state[label] = st
+                every = _sync_every_default()
+                # a tenant's sampling cadence (tests override
+                # st.every) applies to every scope it dispatches
+                # under: inherit the untenanted state's cadence so
+                # `st.every = 0` keeps governing label-wide
+                base = _dev_state.get((label, ""))
+                if base is not None:
+                    every = base.every
+                st = _DeviceState(label, tenant, every)
+                _dev_state[(label, tenant)] = st
     return st
 
 
-def _note_device_time(est_s: float):
+def _note_device_time(est_s: float, tenant: str = ""):
     """Fold one sampled dispatch's extrapolated device seconds into the
     occupancy window; when the window (~1s) closes, its accumulated
     estimate over its wall becomes the instantaneous occupancy feeding
     the EWMA (clamped to 1 — concurrent dispatch threads can attribute
-    more than wall)."""
+    more than wall). The same window's per-tenant split feeds the
+    ``pio_tenant_occupancy_share`` EWMAs; tenants absent from a window
+    decay toward 0 instead of freezing at their last busy share."""
     global _occ_window_t0, _occ_acc, _occ_ewma
     with _dev_lock:
         now = time.monotonic()
         if _occ_window_t0 is None:
             _occ_window_t0 = now
         _occ_acc += est_s
+        if tenant:
+            _occ_acc_tenant[tenant] = \
+                _occ_acc_tenant.get(tenant, 0.0) + est_s
         wall = now - _occ_window_t0
         if wall >= _OCC_WINDOW_S:
             inst = min(_occ_acc / wall, 1.0)
             _occ_ewma = (inst if _occ_ewma == 0.0
                          else 0.7 * _occ_ewma + 0.3 * inst)
             _g_occupancy.set(round(_occ_ewma, 4))
+            for t in set(_occ_share_ewma) | set(_occ_acc_tenant):
+                inst_t = min(_occ_acc_tenant.get(t, 0.0) / wall, 1.0)
+                old = _occ_share_ewma.get(t, 0.0)
+                share = (inst_t if old == 0.0
+                         else 0.7 * old + 0.3 * inst_t)
+                if share < 1e-6:
+                    _occ_share_ewma.pop(t, None)
+                    share = 0.0
+                else:
+                    _occ_share_ewma[t] = share
+                if _g_tenant_occ is not None:
+                    _g_tenant_occ.labels(tenant=t).set(round(share, 4))
             _occ_window_t0 = now
             _occ_acc = 0.0
+            _occ_acc_tenant.clear()
 
 
 def device_timed(label: str, fn, *args):
@@ -335,8 +393,14 @@ def device_timed(label: str, fn, *args):
     time — separating true device seconds from dispatch wall without
     paying a sync per request. Inside an active trace the sampled sync
     annotates the current span (``deviceMs``) so slow-query waterfalls
-    gain a device_sync stage."""
-    st = _device_state(label)
+    gain a device_sync stage.
+
+    The active tenant scope (obs.tenantctx — entered by host routing,
+    the batcher's pipeline threads, scheduler ticks) selects the
+    ``{executable,tenant}`` series; the added cost on the unsampled
+    path is one contextvar read and a tuple-keyed dict get (still
+    priced by tests/test_obs_overhead.py)."""
+    st = _device_state(label, metric_tenant_label())
     t0 = time.perf_counter()
     compile_before = getattr(_tls, "compile_s", 0.0)
     out = fn(*args)
@@ -366,7 +430,7 @@ def device_timed(label: str, fn, *args):
         st.syncs.inc()
         with _dev_lock:   # scrape-time percentile reads copy under it
             st.ring.append(wall)
-        _note_device_time(est)
+        _note_device_time(est, st.tenant)
         try:
             from predictionio_tpu.obs.trace import TRACER
             TRACER.annotate(deviceMs=round(wall * 1000.0, 3),
@@ -383,6 +447,42 @@ def occupancy() -> float:
     return _occ_ewma
 
 
+def tenant_occupancy_shares() -> Dict[str, float]:
+    """{tenant: EWMA occupancy share} — each tenant's share of wall-
+    clock device time over the recent windows (ISSUE 17). Values decay
+    once a tenant stops dispatching; the sum is bounded by the process
+    occupancy (itself clamped to 1)."""
+    with _dev_lock:
+        return {t: round(v, 4) for t, v in _occ_share_ewma.items()}
+
+
+def device_time_by_tenant() -> Dict[str, float]:
+    """{tenant label value: cumulative estimated device seconds}
+    summed across executables (``""`` = untenanted dispatches)."""
+    out: Dict[str, float] = {}
+    if _c_device_s is None:
+        return out
+    for labels, v in _c_device_s.samples():
+        if not labels:
+            continue
+        t = labels.get("tenant", "")
+        out[t] = out.get(t, 0.0) + v
+    return {t: round(v, 4) for t, v in out.items()}
+
+
+def tenant_device_time_share() -> Dict[str, float]:
+    """{tenant: fraction of ALL attributed device seconds} — the
+    cumulative cost-attribution split behind signals.json's
+    ``device_time_share``. Includes the ``""`` untenanted share, so
+    the values sum to 1.0 whenever any device time was booked (and the
+    named tenants' shares alone sum to <= 1.0)."""
+    by_tenant = device_time_by_tenant()
+    total = sum(by_tenant.values())
+    if total <= 0:
+        return {}
+    return {t: round(v / total, 4) for t, v in by_tenant.items()}
+
+
 def device_time_by_executable() -> Dict[str, float]:
     """{label: estimated device seconds} — the bench/stats view."""
     return {k: round(v, 4)
@@ -396,12 +496,14 @@ def dispatch_seconds_by_executable() -> Dict[str, float]:
 
 def device_time_percentiles(label: str) -> Optional[Dict[str, float]]:
     """p50/p99 of the SAMPLED per-dispatch device walls (ms) for one
-    label; None before the first sampled sync."""
-    st = _dev_state.get(label)
-    if st is None:
+    label (merged across tenants); None before the first sampled
+    sync."""
+    states = [st for (lab, _t), st in list(_dev_state.items())
+              if lab == label]
+    if not states:
         return None
     with _dev_lock:   # appenders hold it too — no mutation mid-sort
-        walls = sorted(st.ring)
+        walls = sorted(w for st in states for w in st.ring)
     if not walls:
         return None
     def pick(q):
@@ -421,8 +523,12 @@ def device_snapshot() -> Dict[str, object]:
         "occupancy": round(_occ_ewma, 4),
         "syncEvery": _sync_every_default(),
     }
-    pct = {label: device_time_percentiles(label)
-           for label in list(_dev_state)}
+    by_tenant = device_time_by_tenant()
+    if any(t for t in by_tenant):
+        out["secondsByTenant"] = by_tenant
+        out["tenantOccupancyShare"] = tenant_occupancy_shares()
+    labels = {lab for (lab, _t) in list(_dev_state)}
+    pct = {label: device_time_percentiles(label) for label in labels}
     out["sampledWallMs"] = {k: v for k, v in pct.items()
                             if v is not None}
     return out
@@ -464,10 +570,17 @@ def analyze_jit(label: str, fn, *args, **kwargs) -> Optional[dict]:
 
 # -- bench/JSON views ---------------------------------------------------
 def _labeled_values(counter) -> Dict[str, float]:
+    """Sum per executable label (families that also carry a tenant
+    label collapse across tenants here — the per-executable view)."""
     if counter is None:
         return {}
-    return {labels["executable"]: v
-            for labels, v in counter.samples() if labels}
+    out: Dict[str, float] = {}
+    for labels, v in counter.samples():
+        if not labels:
+            continue
+        k = labels["executable"]
+        out[k] = out.get(k, 0.0) + v
+    return out
 
 
 def compile_seconds_by_executable() -> Dict[str, float]:

@@ -530,6 +530,48 @@ def federate_metrics(members: Optional[List[dict]] = None,
     return "\n".join(chunks) + "\n"
 
 
+def merge_scrapes(parts: List[tuple]) -> str:
+    """Merge locally-rendered expositions into one classic-format
+    scrape: ``parts`` is ``[(text, extra_labels_dict), ...]``; each
+    part's samples get its extras injected as FIRST labels (an empty
+    dict injects nothing), HELP/TYPE emitted once per family, clashing
+    types dropped with a comment — the same discipline as
+    ``federate_metrics`` but without HTTP. The multi-tenant host uses
+    this to publish every slot's own registry under a ``tenant`` label
+    beside its process-level families (ISSUE 17)."""
+    families: Dict[str, dict] = {}
+    order: List[str] = []
+    notes: List[str] = []
+    for text, extra in parts:
+        if not text:
+            continue
+        for name, mtype, help_, lines in _parse_scrape(text):
+            f = families.get(name)
+            if f is None:
+                f = families[name] = {"type": mtype, "help": help_,
+                                      "lines": []}
+                order.append(name)
+            elif f["type"] != mtype:
+                notes.append(f"# merge: dropped {name} "
+                             f"({mtype} clashes with {f['type']})")
+                continue
+            if not extra:
+                f["lines"].extend(lines)
+                continue
+            for line in lines:
+                out = _inject_labels(line, extra)
+                if out is not None:
+                    f["lines"].append(out)
+    chunks = []
+    for name in order:
+        f = families[name]
+        chunks.append(f"# HELP {name} {f['help']}")
+        chunks.append(f"# TYPE {name} {f['type']}")
+        chunks.extend(f["lines"])
+    chunks.extend(notes)
+    return "\n".join(chunks) + "\n"
+
+
 # -- status / health / trace federation ---------------------------------
 
 def fleet_status(members: Optional[List[dict]] = None,

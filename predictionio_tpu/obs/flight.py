@@ -270,6 +270,7 @@ class FlightRecorder:
                 self.spent_s += dt
 
     def _build(self, kind, model_version, fields) -> dict:
+        from predictionio_tpu.obs.tenantctx import current_tenant
         from predictionio_tpu.obs.trace import TRACER
         rec = {"seq": next(self._seq), "t": time.time(), "kind": kind}
         tid = TRACER.current_trace_id()
@@ -277,6 +278,12 @@ class FlightRecorder:
             rec["traceId"] = tid
         if model_version is not None:
             rec["modelVersion"] = model_version
+        # tenant attribution (ISSUE 17): a record emitted inside a
+        # tenant scope carries the id; an explicit tenant= field
+        # (tenant_admitted/eviction records) wins below
+        ten = current_tenant()
+        if ten is not None:
+            rec["tenant"] = ten
         if fields:
             rec.update(fields)
         deltas = self._metric_deltas()
@@ -468,14 +475,21 @@ class FlightRecorder:
 
     # -- reads ----------------------------------------------------------
     def snapshot(self, limit: int = 100, kind: Optional[str] = None,
-                 trace_id: Optional[str] = None) -> List[dict]:
-        """Newest-first records from the ring, optionally filtered."""
+                 trace_id: Optional[str] = None,
+                 tenant: Optional[str] = None) -> List[dict]:
+        """Newest-first records from the ring, optionally filtered.
+        The ``tenant`` filter keeps that tenant's records PLUS
+        untenanted (shared-device) ones — the slice a tenant-scoped
+        incident bundle wants."""
         with self._lock:
             recs = list(self._ring)
         if kind is not None:
             recs = [r for r in recs if r.get("kind") == kind]
         if trace_id is not None:
             recs = [r for r in recs if r.get("traceId") == trace_id]
+        if tenant is not None:
+            recs = [r for r in recs
+                    if r.get("tenant") in (tenant, None)]
         recs.reverse()
         return recs[:max(0, int(limit))]
 
@@ -513,9 +527,11 @@ def get_flight() -> FlightRecorder:
 
 def flight_response(params: dict) -> dict:
     """Shared ``GET /flight.json`` handler body for both HTTP servers:
-    ``?n=``/``?limit=`` (default 100), ``?kind=``, ``?trace_id=``."""
+    ``?n=``/``?limit=`` (default 100), ``?kind=``, ``?trace_id=``,
+    ``?tenant=`` (that tenant's records plus untenanted ones)."""
     limit = int(params.get("n", params.get("limit", 100)))
     return {"records": FLIGHT.snapshot(
         limit=limit, kind=params.get("kind"),
-        trace_id=params.get("trace_id") or params.get("traceId")),
+        trace_id=params.get("trace_id") or params.get("traceId"),
+        tenant=params.get("tenant")),
         "dropped": FLIGHT.dropped}

@@ -50,6 +50,31 @@ except ImportError:                                  # pragma: no cover
     _thread_atexit = atexit.register
 
 
+def _tenant_trace_slice(traces: List[dict], tenant: str) -> List[dict]:
+    """Keep this tenant's traces plus shared (untenanted) ones. Only
+    records stamped with a DIFFERENT registered tenant are dropped —
+    unstamped traces (device work, process-level ticks) are context the
+    postmortem needs, and an unknown stamp means the registry rotated,
+    not that the trace belongs to a neighbor."""
+    from predictionio_tpu.obs.tenantctx import registered_tenants
+    others = registered_tenants() - {tenant}
+    return [t for t in traces
+            if t.get("root", {}).get("attrs", {}).get("tenant")
+            not in others]
+
+
+def _tenant_provider_slice(providers: Dict[str, Callable],
+                           tenant: str) -> Dict[str, Callable]:
+    """Drop providers whose dotted suffix names ANOTHER registered
+    tenant (``engine_server.other`` when capturing for ``tenant``).
+    Un-suffixed providers (event store, scheduler, device plane) are
+    shared context and stay in the bundle."""
+    from predictionio_tpu.obs.tenantctx import registered_tenants
+    others = registered_tenants() - {tenant}
+    return {name: fn for name, fn in providers.items()
+            if name.rsplit(".", 1)[-1] not in others}
+
+
 class IncidentManager:
     def __init__(self, incidents_dir: Optional[str] = None,
                  flight_tail: int = 200, traces_limit: int = 50,
@@ -138,14 +163,26 @@ class IncidentManager:
     def capture(self, kind: str, reason: str,
                 context: Optional[dict] = None,
                 trace_ids: Sequence[str] = (),
-                sync: bool = False) -> Optional[str]:
+                sync: bool = False,
+                tenant: Optional[str] = None) -> Optional[str]:
         """Fire-and-forget bundle capture. Returns the incident id (or
         None when suppressed by the cooldown / disabled). Never raises
         — a diagnosis failure must not worsen the incident.
 
         ``sync=True`` (CLI, tests) blocks until the bundle is on disk.
-        """
+
+        ``tenant`` (or, absent that, the active tenant scope — a
+        capture fired inside a tenant slot's routing/tick path) names
+        the tenant the bundle belongs to: ``incident.json`` carries a
+        top-level ``tenant`` field, and the bundle's flight/trace/
+        provider slices keep only that tenant's records plus the
+        shared-device context (ISSUE 17 — a noisy-neighbor postmortem
+        must not leak every OTHER tenant's traffic into one slot's
+        bundle)."""
         try:
+            if tenant is None:
+                from predictionio_tpu.obs.tenantctx import current_tenant
+                tenant = current_tenant()
             self._register_metrics()
             if os.environ.get("PIO_INCIDENTS", "").strip().lower() \
                     in ("off", "0", "false"):
@@ -172,9 +209,14 @@ class IncidentManager:
             # inside of can commit first
             from predictionio_tpu.obs.flight import FLIGHT
             flight = FLIGHT.tail(self.flight_tail)
+            if tenant is not None:
+                # the slot's slice plus shared-device records (no
+                # tenant stamp): neighbors' traffic stays out
+                flight = [r for r in flight
+                          if r.get("tenant") in (tenant, None)]
             if sync:
                 self._write_bundle(incident_id, kind, reason, context,
-                                   flight, tuple(trace_ids))
+                                   flight, tuple(trace_ids), tenant)
             else:
                 # daemon + bounded at-exit drain: a short-lived
                 # process (a one-shot `pio update` whose fold was
@@ -186,7 +228,7 @@ class IncidentManager:
                 t = threading.Thread(
                     target=self._write_bundle,
                     args=(incident_id, kind, reason, context, flight,
-                          tuple(trace_ids)),
+                          tuple(trace_ids), tenant),
                     daemon=True, name="pio-incident-capture")
                 with self._lock:
                     self._threads = [th for th in self._threads
@@ -235,11 +277,13 @@ class IncidentManager:
         return out[:self.traces_limit]
 
     def _write_bundle(self, incident_id, kind, reason, context,
-                      flight, trace_ids):
+                      flight, trace_ids, tenant=None):
         try:
             if self.trace_settle_s > 0:
                 time.sleep(self.trace_settle_s)
             traces = self._matching_traces(trace_ids)
+            if tenant is not None:
+                traces = _tenant_trace_slice(traces, tenant)
             d = os.path.join(self.incidents_dir(), incident_id)
             os.makedirs(d, exist_ok=True)
             with self._lock:
@@ -253,6 +297,8 @@ class IncidentManager:
                         del self._providers[name]
                     else:
                         providers[name] = fn
+            if tenant is not None:
+                providers = _tenant_provider_slice(providers, tenant)
             provider_state = {}
             for name, fn in providers.items():
                 try:
@@ -263,11 +309,14 @@ class IncidentManager:
                 "id": incident_id, "kind": kind, "reason": reason,
                 "capturedAt": _dt.datetime.now(
                     _dt.timezone.utc).isoformat(),
-                "context": context or {},
+                "context": dict(context or {}),
                 "providers": provider_state,
                 "flightRecords": len(flight),
                 "traces": len(traces),
             }
+            if tenant is not None:
+                meta["tenant"] = tenant
+                meta["context"].setdefault("tenant", tenant)
             with open(os.path.join(d, "incident.json"), "w") as f:
                 json.dump(meta, f, indent=2, default=str)
             with open(os.path.join(d, "flight.jsonl"), "w") as f:
@@ -403,6 +452,7 @@ class IncidentManager:
                 out.append({"id": m.get("id", name),
                             "kind": m.get("kind"),
                             "reason": m.get("reason"),
+                            "tenant": m.get("tenant"),
                             "capturedAt": m.get("capturedAt")})
             except (OSError, ValueError):
                 out.append({"id": name, "kind": "?",

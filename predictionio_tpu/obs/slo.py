@@ -91,11 +91,24 @@ def timed_acquire(lock, probe: Histogram):
 
 # -- SLO specs ----------------------------------------------------------
 
-def _env_f(name: str, default: float) -> float:
+def _env_f(name: str, default: float,
+           tenant: Optional[str] = None) -> float:
+    """Float env knob. A tenant-scoped lookup checks the per-tenant
+    override ``NAME__<TENANT>`` (tenant upper-cased, non-alphanumerics
+    folded to ``_``) before falling back to the fleet-wide ``NAME`` —
+    so one latency-sensitive slot can run a tighter serve p99 than its
+    neighbors without re-deploying the host (ISSUE 17)."""
+    raw = None
+    if tenant:
+        safe = "".join(ch if ch.isalnum() else "_"
+                       for ch in tenant).upper()
+        raw = os.environ.get(f"{name}__{safe}")
+    if raw is None:
+        raw = os.environ.get(name)
     try:
-        return float(os.environ.get(name, default))
+        return float(raw) if raw is not None else float(default)
     except (TypeError, ValueError):
-        return default
+        return float(default)
 
 
 @dataclass(frozen=True)
@@ -117,26 +130,36 @@ class SLOSpec:
     slow_burn: float = 6.0
 
 
-def default_engine_specs() -> List[SLOSpec]:
-    """The engine server's objectives (docs/operations.md)."""
+def default_engine_specs(tenant: Optional[str] = None) -> List[SLOSpec]:
+    """The engine server's objectives (docs/operations.md). With
+    ``tenant``, every threshold honours per-tenant env overrides
+    (``PIO_SLO_SERVE_P99_MS__<TENANT>`` etc) so slots on one host can
+    carry different objectives (ISSUE 17)."""
+    fw = _env_f("PIO_SLO_FAST_WINDOW_S", 60.0, tenant)
+    sw = _env_f("PIO_SLO_SLOW_WINDOW_S", 600.0, tenant)
     return [
         SLOSpec("serve_p99", "latency",
                 ("pio_engine_query_seconds",),
                 objective=0.99,
-                threshold_s=_env_f("PIO_SLO_SERVE_P99_MS", 250.0)
-                / 1000.0),
+                threshold_s=_env_f("PIO_SLO_SERVE_P99_MS", 250.0,
+                                   tenant) / 1000.0,
+                fast_window_s=fw, slow_window_s=sw),
         SLOSpec("fold_tick_duration", "latency",
                 ("pio_fold_tick_seconds",),
                 objective=0.95,
-                threshold_s=_env_f("PIO_SLO_FOLD_TICK_MS", 2500.0)
-                / 1000.0),
+                threshold_s=_env_f("PIO_SLO_FOLD_TICK_MS", 2500.0,
+                                   tenant) / 1000.0,
+                fast_window_s=fw, slow_window_s=sw),
         SLOSpec("model_staleness", "gauge_max",
                 ("pio_engine_model_staleness_seconds",),
-                max_value=_env_f("PIO_SLO_STALENESS_MAX_S", 600.0)),
+                max_value=_env_f("PIO_SLO_STALENESS_MAX_S", 600.0,
+                                 tenant),
+                fast_window_s=fw, slow_window_s=sw),
         SLOSpec("guarded_deploys", "counter_budget",
                 ("pio_guard_rollbacks_total",
                  "pio_guard_gate_rejects_total"),
-                budget=_env_f("PIO_SLO_GUARD_BUDGET", 0.0)),
+                budget=_env_f("PIO_SLO_GUARD_BUDGET", 0.0, tenant),
+                fast_window_s=fw, slow_window_s=sw),
     ]
 
 
@@ -165,9 +188,15 @@ class SLOEngine:
     def __init__(self, specs: Sequence[SLOSpec], registries=(),
                  clock=time.monotonic, max_samples: int = 512,
                  min_window_s: float = 1.0,
-                 sample_spacing_s: Optional[float] = None):
+                 sample_spacing_s: Optional[float] = None,
+                 tenant: Optional[str] = None):
         self.specs = list(specs)
         self.registries = list(registries)
+        # a tenant-scoped engine (one per host slot, ISSUE 17) reads
+        # ONLY its own tenant's children out of tenant-labeled
+        # families — fold ticks and guard events booked by a neighbor
+        # must not move this slot's burn rates
+        self.tenant = tenant
         self.clock = clock
         self.min_window_s = min_window_s
         self._lock = threading.Lock()
@@ -196,15 +225,34 @@ class SLOEngine:
                 return fam
         return get_registry().get(name)
 
-    @staticmethod
-    def _scalar(family) -> Optional[float]:
+    def _scalar(self, family) -> Optional[float]:
         if family is None:
             return None
         try:
-            return float(sum(v for _, v in family.samples()
+            samples = family.samples()
+            if self.tenant and "tenant" in getattr(
+                    family, "labelnames", ()):
+                samples = [(lab, v) for lab, v in samples
+                           if (lab or {}).get("tenant") == self.tenant]
+            return float(sum(v for _, v in samples
                              if not isinstance(v, str)))
         except Exception:
             return None
+
+    def _hist_children(self, fam: Histogram) -> List[Histogram]:
+        """The concrete histograms holding a family's data. A labeled
+        parent keeps its own counters empty — the children carry the
+        observations — so a labeled family aggregates its children,
+        and a tenant-scoped engine reads only its own tenant's child
+        out of a tenant-labeled family."""
+        if not fam.labelnames:
+            return [fam]
+        with fam._lock:
+            items = sorted(fam._children.items())
+        if self.tenant and "tenant" in fam.labelnames:
+            i = fam.labelnames.index("tenant")
+            items = [(k, c) for k, c in items if k[i] == self.tenant]
+        return [c for _, c in items]
 
     def _counter_sum(self, names: Tuple[str, ...]) -> Optional[float]:
         total, seen = 0.0, False
@@ -213,7 +261,7 @@ class SLOEngine:
             if fam is None:
                 continue
             if isinstance(fam, Histogram):
-                total += fam.count
+                total += sum(h.count for h in self._hist_children(fam))
                 seen = True
                 continue
             v = self._scalar(fam)
@@ -229,7 +277,14 @@ class SLOEngine:
         fam = self._family(name)
         if not isinstance(fam, Histogram):
             return None
-        counts = fam.bucket_counts()
+        children = self._hist_children(fam)
+        if not children:
+            return None
+        counts: Optional[List[float]] = None
+        for h in children:
+            c = h.bucket_counts()
+            counts = c if counts is None \
+                else [a + b for a, b in zip(counts, c)]
         k = bisect.bisect_right(list(fam.bounds), threshold_s)
         good = float(sum(counts[:k]))
         total = float(sum(counts))
@@ -282,7 +337,10 @@ class SLOEngine:
         dt = time.perf_counter() - t0
         with self._lock:   # concurrent /health.json polls
             self.spent_s += dt
-        return {"status": overall, "slo": slo}
+        out = {"status": overall, "slo": slo}
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        return out
 
     def _windows(self, spec, cur_val, history, now):
         """((delta, window_dt) fast, (delta, window_dt) slow) for a

@@ -200,13 +200,19 @@ def capture_slow_query(query_trace, total_s: float,
                        query: Optional[dict] = None,
                        model_version: Optional[str] = None,
                        serialize_s: Optional[float] = None,
-                       batch_trace_id: Optional[str] = None) -> dict:
+                       batch_trace_id: Optional[str] = None,
+                       tenant: Optional[str] = None) -> dict:
     """Build + record one slow-query entry (request thread, slow path
     only). Resolves the answering batch trace from the query trace's
     links, emits the ``slow_query`` flight record (which stamps the
-    current trace id), and returns the entry."""
+    current trace id), and returns the entry. ``tenant`` (or, absent
+    that, the active tenant scope) rides the waterfall row — the field
+    that makes host-routed slow queries attributable (ISSUE 17)."""
     from predictionio_tpu.obs.flight import FLIGHT
+    from predictionio_tpu.obs.tenantctx import current_tenant
     from predictionio_tpu.obs.trace import TRACER
+    if tenant is None:
+        tenant = current_tenant()
     batch_trace = None
     if batch_trace_id:
         batch_trace = TRACER.get(batch_trace_id)
@@ -222,6 +228,8 @@ def capture_slow_query(query_trace, total_s: float,
     if batch_trace is not None:
         entry["batchTraceId"] = batch_trace.trace_id
         entry["batchSize"] = batch_trace.root.attrs.get("batch")
+    if tenant is not None:
+        entry["tenant"] = tenant
     if model_version is not None:
         entry["modelVersion"] = model_version
     if query is not None:

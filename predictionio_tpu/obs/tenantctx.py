@@ -1,0 +1,95 @@
+"""Process-wide tenant attribution context (ISSUE 17).
+
+PR 15 introduced a tenant contextvar inside ``utils/device_cache`` so
+HBM uploads could be attributed to the serving tenant that triggered
+them. This module GENERALIZES that scope into the one the whole
+observability stack reads: ``costmon.device_timed`` books device
+seconds per ``{executable,tenant}``, flight records / slow-query
+waterfalls / trace roots stamp the tenant id, and incident captures
+name the tenant whose slot they fired in. ``device_cache`` now
+delegates here — one contextvar, entered once (host routing, scheduler
+ticks, canary/feedback paths), read everywhere.
+
+Cardinality discipline: metric label values are BOUNDED by the
+registered-tenant set. Every admission path (ServingHost, EngineServer
+slots, tenant-attached schedulers) calls :func:`register_tenant`;
+:func:`metric_tenant_label` maps an unregistered or absent scope to
+``""`` so a buggy caller can never mint an unbounded ``tenant`` label
+series (the metric-lint rule in tests/test_metric_lint.py enforces
+this). Flight/trace/slowlog stamps carry the raw scope value — they
+are ring-bounded, not series-minting.
+
+The scope itself is a contextvar: it follows the request/fold call
+stack across locks, not into threads created inside it — thread-
+spawning paths (the pipelined batcher's formation/completion loops)
+re-enter it explicitly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+from typing import FrozenSet, Optional
+
+#: the shared metric label name every tenant-labeled family uses
+#: (tests/test_metric_lint.py rejects synonyms like tenant_id)
+TENANT_LABEL = "tenant"
+
+_tenant_var: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("pio_tenant", default=None)
+
+_reg_lock = threading.Lock()
+# copy-on-write frozenset: readers (the device_timed hot path) get a
+# lock-free membership test; registration is rare (tenant admission)
+_registered: FrozenSet[str] = frozenset()
+
+
+def current_tenant() -> Optional[str]:
+    """The tenant the calling context is attributed to (None outside
+    any scope). One contextvar read — hot-path safe."""
+    return _tenant_var.get()
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]):
+    """Attribute everything inside the block — device uploads, device
+    time, flight records, traces, slow queries, incident captures — to
+    ``tenant``. ``None`` is a no-op scope (single-tenant processes
+    never pay for the tagging)."""
+    if tenant is None:
+        yield
+        return
+    token = _tenant_var.set(str(tenant))
+    try:
+        yield
+    finally:
+        _tenant_var.reset(token)
+
+
+def register_tenant(tenant: str) -> str:
+    """Admit ``tenant`` to the bounded metric-label set. Idempotent;
+    called from every admission path (host slots, tenant-tagged
+    EngineServers, tenant-attached schedulers)."""
+    global _registered
+    tenant = str(tenant)
+    with _reg_lock:
+        if tenant not in _registered:
+            _registered = _registered | {tenant}
+    return tenant
+
+
+def registered_tenants() -> FrozenSet[str]:
+    """The admitted tenant set — the cardinality bound metric lint
+    checks tenant-labeled families against."""
+    return _registered
+
+
+def metric_tenant_label(tenant: Optional[str] = None) -> str:
+    """The ``tenant`` label VALUE for a metric series: the active (or
+    given) tenant when registered, else ``""`` — unregistered scope
+    values must not mint unbounded series."""
+    t = tenant if tenant is not None else _tenant_var.get()
+    if t is not None and t in _registered:
+        return t
+    return ""

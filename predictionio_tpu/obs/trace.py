@@ -135,6 +135,20 @@ class Span:
 _tid_pool = threading.local()
 
 
+def _stamp_tenant(root: "Span"):
+    """Tenant attribution (ISSUE 17): a trace minted inside an active
+    tenant scope carries the tenant id as a root attr — the key that
+    lets waterfalls, incident slices and the dashboard tell one
+    tenant's requests from another's. An explicit ``tenant=`` attr
+    passed by the caller wins; one contextvar read otherwise."""
+    if "tenant" in root.attrs:
+        return
+    from predictionio_tpu.obs.tenantctx import current_tenant
+    t = current_tenant()
+    if t is not None:
+        root.attrs["tenant"] = t
+
+
 def _new_trace_id() -> str:
     """16-hex trace id, entropy drawn 128 ids at a time into a
     thread-local pool — one request-path os.urandom syscall (with its
@@ -247,6 +261,7 @@ class Tracer:
         t = Trace(kind, trace_id=trace_id)
         if attrs:
             t.root.attrs.update(attrs)
+        _stamp_tenant(t.root)
         token = self._ctx.set((t, t.root))
         try:
             yield t
@@ -269,6 +284,7 @@ class Tracer:
         t = Trace(kind)
         if attrs:
             t.root.attrs.update(attrs)
+        _stamp_tenant(t.root)
         return t
 
     @contextmanager

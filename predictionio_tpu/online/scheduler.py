@@ -223,32 +223,47 @@ class DeltaTrainingScheduler:
         self._pending_trace_ids: Set[str] = set()
         # process-wide fold instruments (get-or-create: schedulers in
         # one process share the families, and both HTTP servers expose
-        # them through the registry parent chain)
+        # them through the registry parent chain). Every family
+        # carries a ``tenant`` label (ISSUE 17 cost attribution; ""
+        # for an untenanted scheduler) — the child for THIS
+        # scheduler's tenant is resolved once here, so the tick path
+        # observes exactly as before, and a host's per-tenant SLO
+        # engines read only their own tenant's series out of the
+        # shared families.
+        if self.tenant is not None:
+            from predictionio_tpu.obs.tenantctx import register_tenant
+            register_tenant(self.tenant)
+        self._metric_tenant = self.tenant or ""
         reg = get_registry()
         self._h_tick = reg.histogram(
             "pio_fold_tick_seconds",
             "Wall time of a scheduler tick that ran a fold-in "
-            "(tail read + touched-row solves + publish + swap)")
+            "(tail read + touched-row solves + publish + swap)",
+            labelnames=("tenant",)).labels(tenant=self._metric_tenant)
         self._c_fold_events = reg.counter(
             "pio_fold_events_total",
-            "Fresh events absorbed by completed fold-ins")
+            "Fresh events absorbed by completed fold-ins",
+            labelnames=("tenant",)).labels(tenant=self._metric_tenant)
         self._c_fold_h2d = reg.counter(
             "pio_fold_upload_bytes_total",
             "Host->device bytes uploaded by fold-in solves (the "
-            "per-tick upload cost; ROADMAP open item)")
+            "per-tick upload cost; ROADMAP open item)",
+            labelnames=("tenant",)).labels(tenant=self._metric_tenant)
         self._c_tick_failures = reg.counter(
             "pio_fold_tick_failures_total",
             "Scheduler ticks that raised (tail read, solve, or publish "
-            "failure); consecutive failures back off exponentially")
+            "failure); consecutive failures back off exponentially",
+            labelnames=("tenant",)).labels(tenant=self._metric_tenant)
         self._c_fold_read_rows = reg.counter(
             "pio_fold_read_rows_total",
             "Training-data rows read by fold ticks, by read path "
             "(entity_filtered = O(touched) pushdown, full_scan = the "
-            "whole corpus)", labelnames=("path",))
+            "whole corpus)", labelnames=("path", "tenant"))
         self._c_gate_rejects = reg.counter(
             "pio_guard_gate_rejects_total",
             "Fold publishes refused by the pre-swap quality gates "
-            "(the live model kept serving)")
+            "(the live model kept serving)",
+            labelnames=("tenant",)).labels(tenant=self._metric_tenant)
         self.gatekeeper = (QualityGatekeeper(config.gate_config, reg)
                            if config.gates else None)
         self.gate_rejects = 0
@@ -577,7 +592,8 @@ class DeltaTrainingScheduler:
         TRACER.annotate(h2dBytes=report["h2dBytes"])
         if read_info.get("readRows") is not None:
             self._c_fold_read_rows.labels(
-                path=read_info["readPath"]).inc(read_info["readRows"])
+                path=read_info["readPath"],
+                tenant=self._metric_tenant).inc(read_info["readRows"])
         if not folded_any:
             logger.warning("no algorithm supports fold_in; deltas dropped")
             self.last_report = report

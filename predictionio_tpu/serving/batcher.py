@@ -145,7 +145,8 @@ class MicroBatcher:
                  metrics=None,
                  process_batch_begin: Optional[Callable] = None,
                  inflight: Optional[int] = None,
-                 adaptive: bool = True):
+                 adaptive: bool = True,
+                 tenant: Optional[str] = None):
         """process_batch: fn(List[query]) -> List[result].
         ``process_batch_begin``: fn(List[query]) -> finish() -> results
         — the two-stage split enabling the pipelined executor; with it
@@ -155,6 +156,11 @@ class MicroBatcher:
         counters below stay the single source of truth (stats() reads
         them directly) and the registry samples them at scrape time;
         the batch-wait distribution is a native histogram."""
+        # device dispatch runs on the formation/completion threads,
+        # not the request thread — so tenant attribution (ISSUE 17
+        # costmon device-time booking, flight/trace stamps) must be
+        # entered HERE, once per thread, not per request
+        self.tenant = str(tenant) if tenant is not None else None
         self.process_batch = process_batch
         self.process_batch_begin = process_batch_begin
         self.max_batch = max_batch
@@ -466,7 +472,17 @@ class MicroBatcher:
         return deadline
 
     # -- formation loop ------------------------------------------------------
+    def _enter_tenant(self):
+        """Pin this thread's context to the batcher's tenant. The
+        formation/completion threads serve exactly one tenant for
+        their whole lifetime, so a one-shot contextvar set (no scope
+        exit) is correct and free on the per-batch path."""
+        if self.tenant is not None:
+            from predictionio_tpu.obs.tenantctx import _tenant_var
+            _tenant_var.set(self.tenant)
+
     def _loop(self):
+        self._enter_tenant()
         while not self._stop.is_set():
             try:
                 first = self._q.get(timeout=0.1)
@@ -649,6 +665,7 @@ class MicroBatcher:
         return TRACER.resume(bt, commit=True)
 
     def _completion_loop(self):
+        self._enter_tenant()
         while True:
             item = self._completions.get()
             if item is None:        # stop() sentinel

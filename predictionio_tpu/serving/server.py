@@ -131,6 +131,11 @@ class EngineServer:
         # account and evict this tenant's tables independently, and the
         # (host-shared) result cache is namespaced per tenant.
         self.tenant = str(tenant) if tenant is not None else None
+        if self.tenant is not None:
+            # bounded metric-label cardinality: only registered
+            # tenants get a named ``tenant`` label value (ISSUE 17)
+            from predictionio_tpu.obs.tenantctx import register_tenant
+            register_tenant(self.tenant)
         self._lock = threading.RLock()
         # multi-process mesh serving: under a >1-process JAX mesh every
         # process must run each query's SPMD program, so the primary
@@ -197,8 +202,12 @@ class EngineServer:
         from predictionio_tpu.obs import costmon
         costmon.install()
         FLIGHT.add_source(self.metrics)
-        self.slo = SLOEngine(default_engine_specs(),
-                             registries=[self.metrics])
+        # a tenant slot evaluates per-tenant spec thresholds
+        # (PIO_SLO_*__<TENANT> overrides) and reads only its own
+        # tenant's children out of tenant-labeled process families
+        self.slo = SLOEngine(default_engine_specs(self.tenant),
+                             registries=[self.metrics],
+                             tenant=self.tenant)
         # last-seen status per SLO name: the ok->breached transition
         # detector behind the ISSUE 11 auto-capture in _health
         self._slo_status: dict = {}
@@ -266,7 +275,7 @@ class EngineServer:
                 self.handle_query_batch, max_batch=config.micro_batch,
                 max_wait_ms=config.micro_batch_wait_ms,
                 latency_budget_ms=config.micro_batch_latency_budget_ms,
-                metrics=self.metrics,
+                metrics=self.metrics, tenant=self.tenant,
                 process_batch_begin=(self.handle_query_batch_begin
                                      if single_process else None),
                 inflight=(config.serve_inflight
@@ -1159,7 +1168,8 @@ class EngineServer:
             capture_slow_query(qt, total_s, query=query_dict,
                                model_version=self.model_version,
                                serialize_s=serialize_s,
-                               batch_trace_id=batch_tid)
+                               batch_trace_id=batch_tid,
+                               tenant=self.tenant)
         except Exception:
             logger.debug("slow-query capture failed", exc_info=True)
 
@@ -1362,15 +1372,21 @@ class EngineServer:
             self._slo_status[name] = status
             if status == "breached" and prev != "breached" \
                     and s.get("kind") == "latency":
-                FLIGHT.record("slo_breach", slo=name,
-                              burnFast=s.get("burnFast"),
-                              burnSlow=s.get("burnSlow"))
-                get_incidents().capture(
-                    "slo_breach",
-                    f"latency SLO {name} breached "
-                    f"(burn fast/slow = {s.get('burnFast')}/"
-                    f"{s.get('burnSlow')})",
-                    context={"slo": s})
+                # tenant scope (None = no-op): a slot's breach record
+                # and bundle name the tenant, and the bundle's
+                # forensics keep to that tenant's slice (ISSUE 17)
+                from predictionio_tpu.obs.tenantctx import tenant_scope
+                with tenant_scope(self.tenant):
+                    FLIGHT.record("slo_breach", slo=name,
+                                  burnFast=s.get("burnFast"),
+                                  burnSlow=s.get("burnSlow"))
+                    get_incidents().capture(
+                        "slo_breach",
+                        f"latency SLO {name} breached "
+                        f"(burn fast/slow = {s.get('burnFast')}/"
+                        f"{s.get('burnSlow')})",
+                        context={"slo": s},
+                        tenant=self.tenant)
 
     # -- fleet federation (ISSUE 13) ----------------------------------------
     def _fleet_status(self, req: Request) -> Response:

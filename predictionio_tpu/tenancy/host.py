@@ -35,6 +35,7 @@ from typing import Dict, Optional
 
 from predictionio_tpu.obs import FLIGHT, MetricsRegistry, fleet, \
     get_registry
+from predictionio_tpu.obs.tenantctx import register_tenant, tenant_scope
 from predictionio_tpu.serving.server import EngineServer, ServerConfig
 from predictionio_tpu.tenancy.budget import HBMBudgetManager, _iter_tables
 from predictionio_tpu.utils import device_cache
@@ -201,6 +202,8 @@ class ServingHost:
                 metrics=self.metrics)
         self.server: Optional[HttpServer] = None
         self._fleet_id: Optional[str] = None
+        # per-tenant traffic EWMA state: key -> [t, requests, ewma]
+        self._traffic: Dict[str, list] = {}
         self.router = self._build_router()
 
     # -- tenant lifecycle ---------------------------------------------------
@@ -221,6 +224,7 @@ class ServingHost:
         routable — a tenant whose padded tables can never fit raises
         :class:`TableBudgetExceeded` and leaves no slot behind."""
         key = _check_key(spec.key)
+        register_tenant(key)   # bounded metric-label cardinality
         with self._lock:
             if key in self.slots:
                 raise ValueError(f"tenant {key!r} already admitted")
@@ -263,6 +267,7 @@ class ServingHost:
             raise ValueError(
                 f"server.tenant {server.tenant!r} != spec.key {key!r}: "
                 f"construct the EngineServer with tenant=<key>")
+        register_tenant(key)
         with self._lock:
             if key in self.slots:
                 raise ValueError(f"tenant {key!r} already admitted")
@@ -353,18 +358,24 @@ class ServingHost:
         slot = self.slots.get(key)
         if slot is None:
             return Response(404, {"message": f"unknown tenant {key!r}"})
-        self._c_requests.labels(tenant=key).inc()
-        slot.requests += 1
-        self.budget.touch(key)
-        if slot.cold:
-            # fresh admission or post-eviction readmission: make the
-            # budget hold before this tenant's tables come (back)
-            # resident — evicts the coldest neighbors if needed
-            self.budget.ensure_room(key)
-            slot.cold = False
-        req.path = "/queries.json"
-        with slot.serving():
-            resp = slot.server.router.dispatch(req)
+        # tenant attribution scope (ISSUE 17): everything this request
+        # touches on the way down — budget room-making, slowlog
+        # captures, flight records, trace roots, device dispatch — is
+        # stamped/booked under this tenant
+        with tenant_scope(key):
+            self._c_requests.labels(tenant=key).inc()
+            slot.requests += 1
+            self.budget.touch(key)
+            if slot.cold:
+                # fresh admission or post-eviction readmission: make
+                # the budget hold before this tenant's tables come
+                # (back) resident — evicts the coldest neighbors if
+                # needed
+                self.budget.ensure_room(key)
+                slot.cold = False
+            req.path = "/queries.json"
+            with slot.serving():
+                resp = slot.server.router.dispatch(req)
         if resp.status >= 500:
             slot.errors += 1
         return resp
@@ -377,7 +388,7 @@ class ServingHost:
         if slot is None:
             return Response(404, {"message": f"unknown tenant {key!r}"})
         req.path = req.path[len(f"/engines/{key}"):]
-        with slot.serving():
+        with tenant_scope(key), slot.serving():
             return slot.server.router.dispatch(req)
 
     # -- host surfaces ------------------------------------------------------
@@ -431,24 +442,132 @@ class ServingHost:
         return Response(200, {"tenant": key, "pinned": pinned})
 
     def _metrics(self, req: Request) -> Response:
+        """One scrape for the whole host: the host/process families
+        plus every slot registry's OWN families re-labeled with
+        ``tenant`` (ISSUE 17) — so serve histograms, canary counters
+        and cache stats from different slots are distinct series under
+        shared family names, and the fleet federator's ``{role,pid}``
+        relabeling stacks on top."""
+        from predictionio_tpu.obs.fleet import merge_scrapes
         from predictionio_tpu.utils.prometheus import CONTENT_TYPE
-        return Response(200, self.metrics.render(),
+        with self._lock:
+            slots = list(self.slots.values())
+        parts = [(self.metrics.render(), {})]
+        for slot in slots:
+            try:
+                parts.append(
+                    (slot.server.metrics.render(include_parent=False),
+                     {"tenant": slot.key}))
+            except Exception:
+                logger.debug("tenant %s metrics render failed",
+                             slot.key, exc_info=True)
+        return Response(200, merge_scrapes(parts),
                         content_type=CONTENT_TYPE)
 
     def _health(self, req: Request) -> Response:
-        """Worst-of rollup across tenant slots' SLO engines."""
+        """Worst-of rollup across tenant slots' SLO engines. Each
+        slot's breach transitions are noted under its tenant scope, so
+        a breached slot captures an incident bundle naming THAT tenant
+        (and only its forensics slice) — the noisy neighbor stays out
+        of the victim's postmortem and vice versa."""
         from predictionio_tpu.obs import health_response
         rank = {"ok": 0, "burning": 1, "no_data": 0, "breached": 2}
         worst, tenants = "ok", {}
         with self._lock:
             slots = list(self.slots.values())
         for slot in slots:
-            h = health_response(slot.server.slo, extra={
-                "modelVersion": slot.server.model_version})
+            with tenant_scope(slot.key):
+                h = health_response(slot.server.slo, extra={
+                    "modelVersion": slot.server.model_version,
+                    "tenant": slot.key})
+                try:
+                    slot.server._note_slo_breaches(h)
+                except Exception:
+                    logger.debug("tenant %s breach note failed",
+                                 slot.key, exc_info=True)
             tenants[slot.key] = h
             if rank.get(h.get("status"), 0) > rank.get(worst, 0):
                 worst = h["status"]
         return Response(200, {"status": worst, "tenants": tenants})
+
+    # -- per-tenant signals (ISSUE 17) --------------------------------------
+    def _traffic_ewma(self, key: str, requests: int) -> float:
+        """Lazily-updated per-tenant request-rate EWMA (alpha 0.3 per
+        observation window), advanced on each signals read from the
+        slot's cumulative request counter."""
+        now = time.monotonic()
+        st = self._traffic.get(key)
+        if st is None:
+            self._traffic[key] = [now, requests, 0.0]
+            return 0.0
+        last_t, last_n, ewma = st
+        dt = now - last_t
+        if dt >= 0.2:   # too-close reads would amplify quantization
+            inst = max(0.0, requests - last_n) / dt
+            ewma = inst if ewma == 0.0 else 0.7 * ewma + 0.3 * inst
+            self._traffic[key] = [now, requests, ewma]
+        return ewma
+
+    def tenant_signals(self) -> dict:
+        """The ``GET /tenants/signals.json`` body: one row per tenant
+        with its traffic, latency, burn, memory and device-time
+        attribution — the single surface that answers "who is eating
+        the device" (docs/operations.md)."""
+        from predictionio_tpu.obs import costmon
+        budget = self.budget.snapshot()
+        dev_share = costmon.tenant_device_time_share()
+        occ_share = costmon.tenant_occupancy_shares()
+        with self._lock:
+            slots = list(self.slots.values())
+        tenants = {}
+        for slot in slots:
+            srv = slot.server
+            row = {
+                "requests": slot.requests,
+                "errors": slot.errors,
+                "trafficEwmaRps": round(
+                    self._traffic_ewma(slot.key, slot.requests), 3),
+                "deviceTimeShare": dev_share.get(slot.key, 0.0),
+                "occupancyShare": occ_share.get(slot.key, 0.0),
+                "modelStalenessS": srv.model_staleness_s(),
+                "modelVersion": srv.model_version,
+            }
+            b = budget["tenants"].get(slot.key, {})
+            row["hbmBytes"] = b.get("hbmBytes", 0)
+            row["evictions"] = b.get("evictions", 0)
+            fam = srv.metrics.get("pio_engine_query_seconds")
+            if fam is not None and getattr(fam, "count", 0):
+                p50, p99 = fam.percentile(50), fam.percentile(99)
+                row["serveP50Ms"] = round(p50 * 1000.0, 3) \
+                    if p50 is not None else None
+                row["serveP99Ms"] = round(p99 * 1000.0, 3) \
+                    if p99 is not None else None
+            else:
+                row["serveP50Ms"] = row["serveP99Ms"] = None
+            try:
+                h = srv.slo.evaluate()
+                row["sloStatus"] = h["status"]
+                serve = next((s for s in h["slo"]
+                              if s["name"] == "serve_p99"), {})
+                row["burnFast"] = serve.get("burnFast")
+                row["burnSlow"] = serve.get("burnSlow")
+            except Exception:
+                row["sloStatus"] = "no_data"
+                row["burnFast"] = row["burnSlow"] = None
+            tenants[slot.key] = row
+        return {
+            "tenants": tenants,
+            # the full attribution maps, "" = untenanted process work:
+            # the smoke check asserts sum(deviceTimeShare) <= 1.0 over
+            # THESE (per-slot rows omit departed tenants' residue)
+            "deviceTimeShare": dev_share,
+            "occupancyShare": occ_share,
+            "budgetBytes": budget["budgetBytes"],
+            "residentBytes": budget["residentBytes"],
+        }
+
+    def _signals(self, req: Request) -> Response:
+        return Response(200, self.tenant_signals())
 
     def _status_page(self, req: Request) -> Response:
         return Response(200, {
@@ -469,6 +588,7 @@ class ServingHost:
         r.add("GET", "/engines/<key>/reload", self._delegate)
         r.add("GET", "/stats.json", self._stats)
         r.add("GET", "/tenants.json", self._tenants)
+        r.add("GET", "/tenants/signals.json", self._signals)
         r.add("POST", "/tenants/<key>/evict", self._tenant_evict)
         r.add("POST", "/tenants/<key>/pin", self._tenant_pin)
         r.add("POST", "/tenants/<key>/unpin", self._tenant_pin)

@@ -896,7 +896,9 @@ def cmd_incidents(args) -> int:
             _print(f"No incidents under {where}.")
             return 0
         for r in rows:
+            ten = r.get("tenant")
             _print(f"{r['id']:40s} {r.get('kind', '?'):18s} "
+                   f"{(ten or '-'):12s} "
                    f"{r.get('capturedAt', '')}  {r.get('reason', '')}")
         return 0
     if sub == "show":
@@ -916,6 +918,8 @@ def cmd_incidents(args) -> int:
         _print(f"Incident {bundle['id']}: {bundle['kind']} — "
                f"{bundle['reason']}")
         _print(f"  captured: {bundle.get('capturedAt')}")
+        if bundle.get("tenant"):
+            _print(f"  tenant: {bundle['tenant']}")
         for name, state in (bundle.get("providers") or {}).items():
             _print(f"  [{name}] {_json.dumps(state, default=str)}")
         flight = bundle.get("flight") or []
@@ -1137,10 +1141,11 @@ def cmd_cache(args) -> int:
 
 
 def cmd_tenants(args) -> int:
-    """`pio tenants {list,status,evict,pin,unpin}` (ISSUE 15): the
+    """`pio tenants {list,status,signals,evict,pin,unpin}`: the
     multi-tenant serving host's operator surface — which engines are
     packed on the device, what each one's factor tables cost in HBM,
-    and the evict/pin levers the packing runbook uses."""
+    the evict/pin levers the packing runbook uses, and the per-tenant
+    SLO/cost signals row (ISSUE 17)."""
     import json as _json
 
     import urllib.error
@@ -1195,11 +1200,41 @@ def cmd_tenants(args) -> int:
             return 0
         _print(_json.dumps(tenants, indent=2, default=str))
         return 0
+    if sub == "signals":
+        out = fetch_json(base + "/tenants/signals.json", timeout=10)
+        if "error" in out:
+            _print(f"serving host unreachable at {base}: "
+                   f"{out['error']}")
+            return 1
+        tenants = out.get("tenants") or {}
+        if getattr(args, "tenant", None):
+            t = tenants.get(args.tenant)
+            if t is None:
+                _print(f"unknown tenant {args.tenant!r}; admitted: "
+                       f"{sorted(tenants)}")
+                return 1
+            _print(_json.dumps(t, indent=2, default=str))
+            return 0
+        _print(f"Serving host at {base}: {len(tenants)} tenant(s), "
+               f"{out.get('residentBytes', 0)} HBM bytes resident")
+        for k in sorted(tenants):
+            t = tenants[k]
+            p99 = t.get("serveP99Ms")
+            _print(f"  {k:20s} {t.get('sloStatus', '?'):8s} "
+                   f"rps={t.get('trafficEwmaRps', 0):<8} "
+                   f"p99={'%.1fms' % p99 if p99 is not None else '-':<9} "
+                   f"burn={t.get('burnFast')}/{t.get('burnSlow')} "
+                   f"dev={t.get('deviceTimeShare', 0):<7} "
+                   f"occ={t.get('occupancyShare', 0):<7} "
+                   f"hbm={t.get('hbmBytes', 0):>10} "
+                   f"stale={t.get('modelStalenessS', 0):.0f}s "
+                   f"evictions={t.get('evictions', 0)}")
+        return 0
     if sub in ("evict", "pin", "unpin"):
         st, out = _post(f"/tenants/{args.tenant}/{sub}")
         _print(_json.dumps(out, indent=2, default=str))
         return 0 if st == 200 else 1
-    _print("tenants command must be list|status|evict|pin|unpin")
+    _print("tenants command must be list|status|evict|pin|unpin|signals")
     return 1
 
 
@@ -1608,7 +1643,13 @@ def build_parser() -> argparse.ArgumentParser:
     tnp.add_argument("tenant")
     tnu = tnsub.add_parser("unpin")
     tnu.add_argument("tenant")
-    for tsp in (tnl, tns, tne, tnp, tnu):
+    tng = tnsub.add_parser(
+        "signals", help="per-tenant SLO/cost signals: traffic, serve "
+        "p50/p99, burn rates, HBM bytes, device-time and occupancy "
+        "shares, staleness, evictions (ISSUE 17)")
+    tng.add_argument("tenant", nargs="?",
+                     help="one tenant's signals row (default: all)")
+    for tsp in (tnl, tns, tne, tnp, tnu, tng):
         tsp.add_argument("--url", default="http://localhost:8100",
                          help="serving host base URL")
     tn.set_defaults(func=cmd_tenants)

@@ -148,6 +148,7 @@ class Dashboard:
                 for st in e.get("stages", ()))
             slow_rows += (
                 f"<tr><td>{_html.escape(str(e.get('traceId', '')))}"
+                f"</td><td>{_html.escape(str(e.get('tenant') or '-'))}"
                 f"</td><td>{e.get('totalMs')}</td>"
                 f"<td>{_html.escape(waterfall)}</td></tr>")
         reg_rows = ""
@@ -170,7 +171,7 @@ class Dashboard:
 <table border=1><tr><th>kind</th><th>trace</th><th>ms</th>
 <th>links</th></tr>{trace_rows}</table>
 <h2>Slow-query waterfalls</h2>
-<table border=1><tr><th>trace</th><th>total ms</th>
+<table border=1><tr><th>trace</th><th>tenant</th><th>total ms</th>
 <th>stages</th></tr>{slow_rows}</table>
 <h2>This process's registry</h2>
 <table border=1>{reg_rows}</table>

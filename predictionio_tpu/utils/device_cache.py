@@ -32,31 +32,17 @@ _cache: Dict[Tuple[int, Any], Tuple[Any, Any]] = {}
 # lock, not threads created inside it.
 # ---------------------------------------------------------------------------
 
-_tenant_var: "contextvars.ContextVar[Optional[str]]" = \
-    contextvars.ContextVar("pio_tenant", default=None)
+# The scope itself moved to obs/tenantctx (ISSUE 17): the same
+# contextvar now also drives device-time attribution, flight/trace/
+# slowlog stamping and incident naming. These names stay re-exported —
+# every PR 15 call site (and test) keeps working unchanged.
+from predictionio_tpu.obs.tenantctx import (_tenant_var,   # noqa: F401
+                                            current_tenant, tenant_scope)
+
 # cache key -> tenant (entries whose upload ran under a tenant scope)
 _tenant_keys: Dict[Any, str] = {}
 # residency slot name -> tenant
 _tenant_slots: Dict[str, str] = {}
-
-
-def current_tenant() -> Optional[str]:
-    return _tenant_var.get()
-
-
-@contextlib.contextmanager
-def tenant_scope(tenant: Optional[str]):
-    """Attribute every upload/residency store inside the block to
-    ``tenant``. None is a no-op scope (single-tenant processes never
-    pay for the tagging)."""
-    if tenant is None:
-        yield
-        return
-    token = _tenant_var.set(str(tenant))
-    try:
-        yield
-    finally:
-        _tenant_var.reset(token)
 
 
 def _tag_key(key):

@@ -16,7 +16,11 @@
 #     models, result-cache namespaces and last-known-good pins
 #     untouched;
 #   - steady-state multi-tenant serving compiles NOTHING after the
-#     per-tenant AOT warm (tenants share one compile-plane ladder).
+#     per-tenant AOT warm (tenants share one compile-plane ladder);
+#   - GET /tenants/signals.json (ISSUE 17) attributes the device:
+#     per-tenant deviceTimeShare sums to <= 1.0 across the whole map
+#     (incl. the "" untenanted share), occupancy shares stay in
+#     [0, 1], and each row's hbmBytes equals the budget gauges.
 #
 # The test is slow-marked (never tier-1); this script is its CI /
 # operator entry point.

@@ -223,6 +223,18 @@ class TestFleetFederationLint:
                                                  Router)
         from predictionio_tpu.utils.prometheus import CONTENT_TYPE
 
+        # ISSUE 17: seed a tenant-labeled device-time series so the
+        # federation exercises the tenant dimension — the {role,pid}
+        # relabeling must PRESERVE an existing tenant label
+        from predictionio_tpu.obs import costmon
+        from predictionio_tpu.obs.tenantctx import register_tenant
+        register_tenant("lint-tenant")
+        costmon.install()
+        st = costmon._device_state("lint_exec", "lint-tenant")
+        st.device_s.inc(0.001)
+        st.dispatch_s.inc(0.001)
+        st.syncs.inc()
+
         def serve(reg):
             r = Router()
             r.add("GET", "/metrics",
@@ -302,6 +314,51 @@ class TestFleetFederationLint:
                if l.startswith("pio_fleet_member_up{")]
         assert len(ups) == 3
         assert all(l.endswith(" 1") for l in ups)
+
+    def test_tenant_label_survives_relabeling(self, federated):
+        # ISSUE 17: federation prepends {role,pid} but must PRESERVE a
+        # member's own tenant label — cost attribution has to stay
+        # queryable fleet-wide as {role,pid,tenant}.
+        rows = [l for l in federated.splitlines()
+                if l.startswith("pio_device_time_seconds_total{")
+                and 'tenant="lint-tenant"' in l]
+        assert rows, "seeded tenant series lost in federation"
+        for line in rows:
+            assert re.match(r'^\S+?\{role="[a-z_]+",pid="\d+",', line), \
+                f"role/pid not first on tenant row: {line!r}"
+            assert 'executable="lint_exec"' in line
+            assert self.SAMPLE_RE.match(line), f"unparseable: {line!r}"
+        # the engine_server member is scraped twice (real pid + fake
+        # pid 1): same tenant series, distinct after relabeling
+        assert len(set(rows)) == len(rows)
+
+
+class TestTenantLabelLint:
+    """ISSUE 17 satellite: every tenant-labeled family shares the ONE
+    label name ``tenant``, and the rendered value set stays bounded by
+    the registered tenants (plus "" for untenanted process work)."""
+
+    TENANTISH = re.compile(r"tenant", re.I)
+
+    def test_shared_label_name(self, registries):
+        for where, reg in registries.items():
+            for name, _mtype, _h, _s in _families(reg):
+                fam = reg.get(name)
+                for ln in getattr(fam, "labelnames", ()) or ():
+                    if self.TENANTISH.search(ln):
+                        assert ln == "tenant", (
+                            f"{where}:{name} labels tenants as {ln!r}; "
+                            f"the shared label name is 'tenant'")
+
+    def test_cardinality_bounded_by_registered_tenants(self, registries):
+        from predictionio_tpu.obs.tenantctx import registered_tenants
+        allowed = registered_tenants() | {""}
+        for where, reg in registries.items():
+            for m in re.finditer(r'tenant="((?:[^"\\]|\\.)*)"',
+                                 reg.render()):
+                assert m.group(1) in allowed, (
+                    f"{where}: tenant label value {m.group(1)!r} is not "
+                    f"a registered tenant — cardinality leak")
 
 
 class TestIssue6FamiliesPresent:

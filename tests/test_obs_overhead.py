@@ -160,3 +160,64 @@ def test_device_timed_sync_sampling_is_exactly_one_in_n():
     assert synced - synced_before == 5
     # sampled walls banked for percentile views
     assert costmon.device_time_percentiles(label)["samples"] >= 5
+
+
+# -- ISSUE 17: tenant attribution hot paths -------------------------------
+
+def test_tenant_scope_enter_exit_under_budget():
+    """Entering a tenant scope is one contextvar set + reset; the serve
+    path pays it once per request."""
+    from predictionio_tpu.obs.tenantctx import tenant_scope
+
+    def run(n):
+        for _ in range(n):
+            with tenant_scope("t-overhead"):
+                pass
+
+    assert _best_us(run, 50_000) < 15.0
+
+
+def test_tenant_read_and_labeled_inc_under_budget():
+    """The full per-sample attribution pattern — read the ambient
+    tenant, map it to a metric label, inc the tenant child — must stay
+    in the same budget class as a plain labeled inc."""
+    from predictionio_tpu.obs.tenantctx import (
+        current_tenant, metric_tenant_label, register_tenant,
+        tenant_scope)
+
+    register_tenant("t-overhead")
+    fam = MetricsRegistry().counter(
+        "g_tenant_total", "h", labelnames=("tenant",))
+    child = fam.labels(tenant="t-overhead")
+
+    def run(n):
+        with tenant_scope("t-overhead"):
+            for _ in range(n):
+                current_tenant()
+                metric_tenant_label()
+                child.inc()
+
+    assert _best_us(run, 50_000) < 20.0
+
+
+def test_tenant_device_state_unsampled_path_under_budget():
+    """device_timed with a tenant in scope resolves the (label, tenant)
+    state and takes the same unsampled fast path as the untenanted
+    case."""
+    from predictionio_tpu.obs import costmon
+    from predictionio_tpu.obs.tenantctx import register_tenant, \
+        tenant_scope
+
+    register_tenant("t-overhead")
+    st = costmon._device_state("overhead_probe_t", "t-overhead")
+    st.every = 0          # no syncs: pure unsampled path
+
+    def fn():
+        return None
+
+    def run(n):
+        with tenant_scope("t-overhead"):
+            for _ in range(n):
+                costmon.device_timed("overhead_probe_t", fn)
+
+    assert _best_us(run, 50_000) < 20.0

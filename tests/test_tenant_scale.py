@@ -309,6 +309,28 @@ def test_three_tenant_packing_under_budget(tmp_env, mesh8,
         tagged = {t for t in device_cache._tenant_slots.values()}
         assert "mt-rec" in tagged, device_cache._tenant_slots
 
+        # -- per-tenant signals surface (ISSUE 17) ----------------------
+        st, sig = _call(port, "/tenants/signals.json")
+        assert st == 200
+        assert set(sig["tenants"]) == {"mt-rec", "mt-sim", "mt-cls"}
+        # attribution shares are fractions of the whole device: the
+        # full map (incl. the "" untenanted share) must sum to <= 1.0
+        assert sum(sig["deviceTimeShare"].values()) <= 1.0 + 1e-6, \
+            sig["deviceTimeShare"]
+        assert all(0.0 <= v <= 1.0
+                   for v in sig["occupancyShare"].values())
+        # hbm bytes in the signals rows == the budget gauges
+        for k, row in sig["tenants"].items():
+            assert row["hbmBytes"] == host.budget.sizes().get(k, 0), \
+                (k, row)
+            assert row["requests"] > 0
+            assert row["sloStatus"] in ("ok", "burning", "breached",
+                                        "no_data")
+            assert row["serveP99Ms"] is None or row["serveP99Ms"] >= 0
+        # the ALS tenants did real device work; shares attribute it
+        assert any(sig["tenants"][k]["deviceTimeShare"] > 0
+                   for k in ("mt-rec", "mt-sim")), sig["deviceTimeShare"]
+
         # -- budget evictions actually happened under pressure ----------
         st, stats = _call(port, "/stats.json")
         assert set(stats["tenants"]) == {"mt-rec", "mt-sim", "mt-cls"}

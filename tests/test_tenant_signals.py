@@ -1,0 +1,340 @@
+"""Tenant signals plane (ISSUE 17): the attribution contextvar, cost
+booking, signal stamps (flight / trace / slowlog), incident tenant
+slices, per-tenant SLO instantiation, the host's merged-scrape helper,
+and the ``pio tenants signals`` CLI row."""
+
+import json
+import os
+import types
+
+import pytest
+
+from predictionio_tpu.obs import costmon
+from predictionio_tpu.obs.flight import FLIGHT
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tenantctx import (current_tenant,
+                                            metric_tenant_label,
+                                            register_tenant,
+                                            registered_tenants,
+                                            tenant_scope)
+from predictionio_tpu.obs.trace import TRACER
+
+
+class TestTenantScope:
+    def test_scope_nests_and_restores(self):
+        assert current_tenant() is None
+        with tenant_scope("sig-a"):
+            assert current_tenant() == "sig-a"
+            with tenant_scope("sig-b"):
+                assert current_tenant() == "sig-b"
+            assert current_tenant() == "sig-a"
+        assert current_tenant() is None
+
+    def test_none_scope_is_noop(self):
+        with tenant_scope("sig-a"):
+            with tenant_scope(None):
+                # None must NOT clear the ambient tenant — untenanted
+                # helpers run inside a tenant's request all the time
+                assert current_tenant() == "sig-a"
+
+    def test_metric_label_bounded_by_registration(self):
+        register_tenant("sig-a")
+        assert "sig-a" in registered_tenants()
+        with tenant_scope("sig-a"):
+            assert metric_tenant_label() == "sig-a"
+        with tenant_scope("never-registered-xyz"):
+            # unbounded scopes can never mint a label series
+            assert metric_tenant_label() == ""
+        assert metric_tenant_label() == ""
+        assert metric_tenant_label("sig-a") == "sig-a"
+        assert metric_tenant_label("never-registered-xyz") == ""
+
+
+class TestCostAttribution:
+    def test_device_timed_books_per_tenant_child(self):
+        register_tenant("sig-a")
+        st = costmon._device_state("sig_exec", "sig-a")
+        st.every = 0                     # unsampled path only
+        with tenant_scope("sig-a"):
+            costmon.device_timed("sig_exec", lambda: 1.0)
+        fam = costmon.get_registry().get("pio_dispatch_seconds_total")
+        booked = {lab["tenant"]: v for lab, v in fam.samples()
+                  if lab and lab.get("executable") == "sig_exec"}
+        assert booked.get("sig-a", 0) > 0
+
+        # unregistered scope books under "" — never a new series
+        costmon._device_state("sig_exec", "").every = 0
+        with tenant_scope("unregistered-xyz"):
+            costmon.device_timed("sig_exec", lambda: 1.0)
+        fam = costmon.get_registry().get("pio_dispatch_seconds_total")
+        tenants = {lab["tenant"] for lab, _ in fam.samples()
+                   if lab and lab.get("executable") == "sig_exec"}
+        assert "unregistered-xyz" not in tenants
+        assert "" in tenants
+
+    def test_device_time_share_sums_to_one(self):
+        register_tenant("sig-a")
+        register_tenant("sig-b")
+        costmon._device_state("share_exec", "sig-a").device_s.inc(3.0)
+        costmon._device_state("share_exec", "sig-b").device_s.inc(1.0)
+        by_tenant = costmon.device_time_by_tenant()
+        assert by_tenant["sig-a"] >= 3.0
+        assert by_tenant["sig-b"] >= 1.0
+        share = costmon.tenant_device_time_share()
+        assert abs(sum(share.values()) - 1.0) < 0.01
+        assert share["sig-a"] > share["sig-b"]
+
+
+class TestSignalStamps:
+    def test_flight_record_stamps_and_filters(self):
+        register_tenant("sig-a")
+        register_tenant("sig-b")
+        with tenant_scope("sig-a"):
+            FLIGHT.record("tenant_stamp_probe", marker="mine")
+        with tenant_scope("sig-b"):
+            FLIGHT.record("tenant_stamp_probe", marker="neighbor")
+        FLIGHT.record("tenant_stamp_probe", marker="shared")
+        recs = FLIGHT.snapshot(limit=500, kind="tenant_stamp_probe")
+        by_marker = {r["marker"]: r for r in recs}
+        assert by_marker["mine"]["tenant"] == "sig-a"
+        assert by_marker["neighbor"]["tenant"] == "sig-b"
+        assert "tenant" not in by_marker["shared"]
+
+        mine = FLIGHT.snapshot(limit=500, kind="tenant_stamp_probe",
+                               tenant="sig-a")
+        markers = {r["marker"] for r in mine}
+        assert "mine" in markers
+        assert "shared" in markers       # untenanted context stays
+        assert "neighbor" not in markers
+
+    def test_trace_root_stamped(self):
+        with tenant_scope("sig-a"):
+            with TRACER.trace("engine_query") as t:
+                pass
+        assert t.root.attrs.get("tenant") == "sig-a"
+        # an explicit tenant attr from the caller wins over the scope
+        with tenant_scope("sig-a"):
+            with TRACER.trace("engine_query", tenant="explicit") as t2:
+                pass
+        assert t2.root.attrs["tenant"] == "explicit"
+
+    def test_slow_query_entry_carries_tenant(self):
+        from predictionio_tpu.obs.slowlog import capture_slow_query
+        with TRACER.trace("engine_query") as q:
+            pass
+        entry = capture_slow_query(q, 1.0, tenant="sig-a")
+        assert entry["tenant"] == "sig-a"
+        with TRACER.trace("engine_query") as q2:
+            pass
+        with tenant_scope("sig-b"):
+            entry2 = capture_slow_query(q2, 1.0)
+        assert entry2["tenant"] == "sig-b"
+
+
+class TestIncidentTenantSlice:
+    def test_capture_names_tenant_and_slices(self, tmp_path):
+        from predictionio_tpu.obs.incidents import IncidentManager
+        register_tenant("sig-a")
+        register_tenant("sig-b")
+        mgr = IncidentManager(incidents_dir=str(tmp_path / "inc"),
+                              cooldown_s=0.0, flight_tail=200)
+        mgr.register_provider("engine_server.sig-a",
+                              lambda: {"who": "a"})
+        mgr.register_provider("engine_server.sig-b",
+                              lambda: {"who": "b"})
+        mgr.register_provider("scheduler", lambda: {"shared": True})
+
+        with tenant_scope("sig-a"):
+            with TRACER.trace("engine_query") as ta:
+                pass
+        with tenant_scope("sig-b"):
+            with TRACER.trace("engine_query") as tb:
+                pass
+        with tenant_scope("sig-a"):
+            FLIGHT.record("inc_slice_probe", marker="a-rec")
+        with tenant_scope("sig-b"):
+            FLIGHT.record("inc_slice_probe", marker="b-rec")
+        FLIGHT.record("inc_slice_probe", marker="shared-rec")
+
+        with tenant_scope("sig-a"):
+            iid = mgr.capture("slo_breach", "serve_p99 burn",
+                              trace_ids=(ta.trace_id, tb.trace_id),
+                              sync=True)
+        assert iid is not None
+        d = os.path.join(mgr.incidents_dir(), iid)
+        with open(os.path.join(d, "incident.json")) as f:
+            meta = json.load(f)
+        assert meta["tenant"] == "sig-a"
+        assert meta["context"]["tenant"] == "sig-a"
+        # provider slice: the neighbor's suffixed provider is dropped,
+        # shared providers stay
+        assert "engine_server.sig-a" in meta["providers"]
+        assert "scheduler" in meta["providers"]
+        assert "engine_server.sig-b" not in meta["providers"]
+        # flight slice: this tenant + untenanted only
+        with open(os.path.join(d, "flight.jsonl")) as f:
+            markers = {r.get("marker")
+                       for r in map(json.loads, f) if r}
+        assert "a-rec" in markers and "shared-rec" in markers
+        assert "b-rec" not in markers
+        # trace slice: the neighbor's trace never rides the bundle
+        with open(os.path.join(d, "traces.json")) as f:
+            ids = {t["traceId"] for t in json.load(f)["traces"]}
+        assert ta.trace_id in ids
+        assert tb.trace_id not in ids
+        # the listing row names the tenant for `pio incidents list`
+        rows = mgr.list_incidents()
+        assert any(r["id"] == iid and r.get("tenant") == "sig-a"
+                   for r in rows)
+
+
+class TestPerTenantSLO:
+    def test_tenant_engine_ignores_neighbor_burn(self):
+        from predictionio_tpu.obs.slo import SLOEngine, SLOSpec
+
+        class FakeClock:
+            t = 1000.0
+
+            def __call__(self):
+                return self.t
+
+        reg = MetricsRegistry()
+        fam = reg.histogram("pio_engine_query_seconds", "x",
+                            labelnames=("tenant",))
+        spec = SLOSpec("serve_p99", "latency",
+                       ("pio_engine_query_seconds",),
+                       objective=0.99, threshold_s=0.25,
+                       fast_window_s=60.0, slow_window_s=600.0)
+        clock = FakeClock()
+        fam.labels(tenant="ta")          # children exist at baseline
+        fam.labels(tenant="tb")
+        eng_a = SLOEngine([spec], registries=[reg], clock=clock,
+                          tenant="ta")
+        eng_b = SLOEngine([spec], registries=[reg], clock=clock,
+                          tenant="tb")
+        eng_a.evaluate()
+        eng_b.evaluate()
+        for _ in range(150):
+            fam.labels(tenant="ta").observe(0.01)   # healthy
+        for _ in range(100):
+            fam.labels(tenant="tb").observe(0.01)
+        for _ in range(50):
+            fam.labels(tenant="tb").observe(1.0)    # 33% over
+        clock.t += 45
+        out_a = eng_a.evaluate()
+        out_b = eng_b.evaluate()
+        assert out_a["tenant"] == "ta"
+        assert out_a["status"] == "ok"              # A unaffected
+        assert out_b["status"] == "breached"        # B burns alone
+        assert out_b["slo"][0]["burnFast"] > 14
+
+    def test_env_override_per_tenant(self, monkeypatch):
+        from predictionio_tpu.obs.slo import default_engine_specs
+        monkeypatch.setenv("PIO_SLO_SERVE_P99_MS__SIG_A", "50")
+
+        def serve_p99(specs):
+            return next(s for s in specs if s.name == "serve_p99")
+
+        assert serve_p99(default_engine_specs("sig-a")).threshold_s \
+            == pytest.approx(0.05)
+        # the override is scoped: fleet default and neighbors keep 250
+        assert serve_p99(default_engine_specs()).threshold_s \
+            == pytest.approx(0.25)
+        assert serve_p99(default_engine_specs("sig-b")).threshold_s \
+            == pytest.approx(0.25)
+
+
+class TestMergeScrapes:
+    def test_tenant_injected_first_one_type_per_family(self):
+        from predictionio_tpu.obs import fleet
+        host = MetricsRegistry()
+        host.counter("pio_host_requests_total", "x").inc(2)
+        slot = MetricsRegistry()
+        slot.histogram("pio_engine_query_seconds", "x").observe(0.01)
+        slot2 = MetricsRegistry()
+        slot2.histogram("pio_engine_query_seconds", "x").observe(0.02)
+        text = fleet.merge_scrapes([
+            (host.render(), {}),
+            (slot.render(), {"tenant": "ta"}),
+            (slot2.render(), {"tenant": "tb"}),
+        ])
+        # one TYPE line per family even though two slots expose it
+        assert text.count(
+            "# TYPE pio_engine_query_seconds histogram") == 1
+        # slot samples carry the tenant as FIRST label; host untouched
+        assert 'pio_engine_query_seconds_count{tenant="ta"} 1' in text
+        assert 'pio_engine_query_seconds_count{tenant="tb"} 1' in text
+        assert "pio_host_requests_total 2" in text
+        # every line still classic-parser shaped
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert " " in line, line
+
+
+class TestCLISignals:
+    @pytest.fixture
+    def signals_server(self):
+        from predictionio_tpu.utils.http import (HttpServer, Response,
+                                                 Router)
+        payload = {
+            "tenants": {
+                "rec": {"requests": 10, "errors": 0,
+                        "trafficEwmaRps": 2.5, "deviceTimeShare": 0.6,
+                        "occupancyShare": 0.4, "modelStalenessS": 12.0,
+                        "modelVersion": "v1", "hbmBytes": 4096,
+                        "evictions": 1, "serveP50Ms": 3.0,
+                        "serveP99Ms": 9.5, "sloStatus": "ok",
+                        "burnFast": 0.0, "burnSlow": 0.0},
+                "sim": {"requests": 4, "errors": 1,
+                        "trafficEwmaRps": 0.5, "deviceTimeShare": 0.2,
+                        "occupancyShare": 0.1, "modelStalenessS": 40.0,
+                        "modelVersion": "v2", "hbmBytes": 2048,
+                        "evictions": 0, "serveP50Ms": 4.0,
+                        "serveP99Ms": 20.0, "sloStatus": "breached",
+                        "burnFast": 15.2, "burnSlow": 2.0},
+            },
+            "deviceTimeShare": {"rec": 0.6, "sim": 0.2, "": 0.2},
+            "occupancyShare": {"rec": 0.4, "sim": 0.1},
+            "budgetBytes": 8192, "residentBytes": 6144,
+        }
+        r = Router()
+        r.add("GET", "/tenants/signals.json",
+              lambda req: Response(200, json.dumps(payload),
+                                   content_type="application/json"))
+        srv = HttpServer(r, "127.0.0.1", 0)
+        srv.start()
+        yield srv, payload
+        srv.stop()
+
+    def test_signals_table(self, signals_server, capsys):
+        from predictionio_tpu.tools.cli import cmd_tenants
+        srv, _ = signals_server
+        args = types.SimpleNamespace(
+            url=f"http://127.0.0.1:{srv.port}",
+            tenants_command="signals", tenant=None)
+        assert cmd_tenants(args) == 0
+        out = capsys.readouterr().out
+        assert "2 tenant(s)" in out
+        assert "rec" in out and "sim" in out
+        assert "breached" in out
+        assert "p99=20.0ms" in out
+        assert "burn=15.2/2.0" in out
+
+    def test_single_tenant_json(self, signals_server, capsys):
+        from predictionio_tpu.tools.cli import cmd_tenants
+        srv, payload = signals_server
+        args = types.SimpleNamespace(
+            url=f"http://127.0.0.1:{srv.port}",
+            tenants_command="signals", tenant="sim")
+        assert cmd_tenants(args) == 0
+        assert json.loads(capsys.readouterr().out) \
+            == payload["tenants"]["sim"]
+
+    def test_unknown_tenant_fails(self, signals_server, capsys):
+        from predictionio_tpu.tools.cli import cmd_tenants
+        srv, _ = signals_server
+        args = types.SimpleNamespace(
+            url=f"http://127.0.0.1:{srv.port}",
+            tenants_command="signals", tenant="nope")
+        assert cmd_tenants(args) == 1
+        assert "unknown tenant" in capsys.readouterr().out
