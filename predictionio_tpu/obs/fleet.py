@@ -167,6 +167,11 @@ class FleetRegistry:
             "node": _node_name(),
             "startedAt": time.time(), "heartbeatAt": time.time(),
         }
+        if rec["port"]:
+            # advertised URL (ISSUE 18 satellite): recorded once at
+            # bind time so routing and federation read the address off
+            # the record instead of re-deriving host:port per caller
+            rec["url"] = f"http://{rec['host']}:{rec['port']}"
         if stats is not None:
             rec["stats"] = bool(stats)
         if extra:
@@ -179,8 +184,10 @@ class FleetRegistry:
                            self.fleet_dir(), exc_info=True)
             return None
         stop = threading.Event()
+        # the beat thread shares THIS rec dict (not a copy): roster
+        # updates via update_member land in the next heartbeat too
         t = threading.Thread(target=self._beat_loop,
-                             args=(dict(rec), stop), daemon=True,
+                             args=(rec, stop), daemon=True,
                              name=f"pio-fleet-beat-{role}")
         with self._lock:
             # re-registering a role (server restart inside one process)
@@ -197,14 +204,45 @@ class FleetRegistry:
 
     def _beat_loop(self, rec: dict, stop: threading.Event):
         while not stop.wait(heartbeat_s()):
-            rec["heartbeatAt"] = time.time()
+            # snapshot under the registry lock: update_member mutates
+            # the shared rec concurrently, and json.dump over a dict
+            # changing size would tear the write
+            with self._lock:
+                rec["heartbeatAt"] = time.time()
+                snap = dict(rec)
             try:
-                self._write_record(rec)
+                self._write_record(snap)
             except OSError:
                 # a full/readonly disk must not kill the member; the
                 # stale heartbeat honestly reports it as unhealthy
                 logger.debug("fleet heartbeat write failed",
                              exc_info=True)
+
+    def update_member(self, member_id: Optional[str],
+                      extra: dict) -> bool:
+        """Merge ``extra`` into an own member record and re-publish it
+        immediately (the next heartbeat carries it too, since the beat
+        thread shares the dict). The serving host updates its tenant
+        roster here on every admit/remove/pin: the roster must be
+        readable off the record of a member that later dies without
+        warning — a corpse record is the failover controller's ONLY
+        source for which tenants the dead host was carrying."""
+        if not member_id or not extra:
+            return False
+        with self._lock:
+            own = self._own.get(member_id)
+            if own is None:
+                return False
+            rec = own[0]
+            rec.update(extra)
+            rec["heartbeatAt"] = time.time()
+            snap = dict(rec)
+        try:
+            self._write_record(snap)
+        except OSError:
+            logger.debug("fleet member update write failed",
+                         exc_info=True)
+        return True
 
     def deregister(self, member_id: Optional[str]):
         """Stop the heartbeat and remove the record (clean shutdown —
@@ -337,7 +375,16 @@ def deregister_member(member_id: Optional[str]):
     FLEET.deregister(member_id)
 
 
+def update_member(member_id: Optional[str], extra: dict) -> bool:
+    return FLEET.update_member(member_id, extra)
+
+
 def member_url(m: dict) -> Optional[str]:
+    # prefer the URL the member advertised at bind time (ISSUE 18);
+    # fall back to deriving it for records written by older members
+    url = m.get("url")
+    if url:
+        return str(url).rstrip("/")
     if not m.get("port"):
         return None
     return f"http://{m.get('host') or '127.0.0.1'}:{m['port']}"

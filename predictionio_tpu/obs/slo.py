@@ -180,6 +180,22 @@ def default_event_specs() -> List[SLOSpec]:
     ]
 
 
+def default_controller_specs() -> List[SLOSpec]:
+    """The placement controller's objectives (ISSUE 18): failovers and
+    placement refusals are error-budget events — the default budget of
+    0 means the FIRST one in a fast window flips the SLO to burning,
+    which is exactly when an operator should be reading the failover
+    incident bundle. Fleets that expect churn raise the budgets."""
+    return [
+        SLOSpec("placement_failovers", "counter_budget",
+                ("pio_placement_failovers_total",),
+                budget=_env_f("PIO_SLO_FAILOVER_BUDGET", 0.0)),
+        SLOSpec("placement_refusals", "counter_budget",
+                ("pio_placement_refusals_total",),
+                budget=_env_f("PIO_SLO_REFUSAL_BUDGET", 0.0)),
+    ]
+
+
 class SLOEngine:
     """Evaluates a spec set against live registries on demand (every
     ``/health.json`` scrape / ``pio status --slo`` poll). Stateful only

@@ -47,6 +47,7 @@ it read — is recorded in the ``fold_tick`` trace, the fold report
 
 from __future__ import annotations
 
+import contextlib
 import datetime as _dt
 import logging
 import threading
@@ -133,6 +134,89 @@ class SchedulerConfig:
     gate_config: GateConfig = GateConfig()
 
 
+class FoldTickGate:
+    """Per-host fold-tick fairness (ISSUE 18 satellite).
+
+    Several attached schedulers contend for ONE device: without a
+    gate, tick admission is FIFO thread wakeup — a chatty tenant whose
+    poll interval happens to phase-align with the device going idle
+    can starve a quieter tenant's folds indefinitely. Every scheduler
+    a :class:`~predictionio_tpu.tenancy.host.ServingHost` attaches
+    shares the host's gate; ``turn(tenant)`` admits exactly one tick
+    at a time, and among waiters the grant goes to the tenant whose
+    LAST grant is oldest (never-granted first, then arrival order) —
+    round-robin by staleness, so every tenant's fold lag is bounded by
+    (tenants × tick time) rather than by luck.
+
+    The queue is observable: ``pio_fold_tick_wait_seconds{tenant}``
+    records how long each tenant's tick waited for its turn — the
+    direct "is the device over-subscribed for folding" signal.
+    """
+
+    def __init__(self, registry=None):
+        reg = registry or get_registry()
+        self._h_wait = reg.histogram(
+            "pio_fold_tick_wait_seconds",
+            "Time a tenant's fold tick waited for its turn at the "
+            "shared per-host tick gate",
+            labelnames=("tenant",))
+        self._cond = threading.Condition()
+        self._busy: Optional[str] = None
+        self._seq = 0
+        self._waiters: List[tuple] = []
+        self._last_grant: Dict[str, float] = {}
+        # per-tenant histogram children resolved once (gate calls run
+        # on scheduler control threads, but there is no reason to
+        # re-resolve labels every tick either)
+        self._children: Dict[str, Any] = {}
+
+    def _child(self, tenant: str):
+        c = self._children.get(tenant)
+        if c is None:
+            if len(self._children) >= 4096:
+                self._children.clear()
+            c = self._children[tenant] = self._h_wait.labels(
+                tenant=tenant)
+        return c
+
+    def _pick(self) -> Optional[tuple]:
+        """The waiter whose tenant has gone longest without a grant
+        (never-granted first; arrival order breaks ties)."""
+        if not self._waiters:
+            return None
+        return min(self._waiters, key=lambda w: (
+            self._last_grant.get(w[0], float("-inf")), w[1]))
+
+    @contextlib.contextmanager
+    def turn(self, tenant: str):
+        tenant = tenant or ""
+        t0 = _time.monotonic()
+        with self._cond:
+            me = (tenant, self._seq)
+            self._seq += 1
+            self._waiters.append(me)
+            while self._busy is not None or self._pick() != me:
+                self._cond.wait(timeout=1.0)
+            self._waiters.remove(me)
+            self._busy = tenant
+        self._child(tenant).observe(_time.monotonic() - t0)
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._busy = None
+                if len(self._last_grant) >= 4096:
+                    self._last_grant.clear()
+                self._last_grant[tenant] = _time.monotonic()
+                self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"busy": self._busy,
+                    "waiting": [w[0] for w in sorted(
+                        self._waiters, key=lambda w: w[1])]}
+
+
 class DeltaTrainingScheduler:
     """One scheduler follows one deployed engine.
 
@@ -149,13 +233,17 @@ class DeltaTrainingScheduler:
                  server=None, registry=None, reload_url: Optional[str] = None,
                  on_retrain: Optional[Callable[[dict], None]] = None,
                  event_store=None, cursor: Optional[_dt.datetime] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None, tick_gate=None):
         # multi-tenant serving (ISSUE 15): when this scheduler follows
         # one tenant slot of a ServingHost, its fold ticks' device
         # uploads and residency slots run under the tenant's
         # device_cache attribution scope — so the HBM budget manager
         # can evict THIS tenant's fold-resident tables by name
         self.tenant = str(tenant) if tenant is not None else None
+        # shared per-host fold-tick fairness gate (ISSUE 18): when
+        # several schedulers contend for one device, background ticks
+        # take turns through it instead of racing FIFO thread wakeup
+        self._tick_gate: Optional[FoldTickGate] = tick_gate
         self.engine = engine
         self.engine_params = engine_params
         self.instance = instance
@@ -837,7 +925,11 @@ class DeltaTrainingScheduler:
                 if self._stop.wait(delay):
                     return
                 try:
-                    self.tick()
+                    if self._tick_gate is not None:
+                        with self._tick_gate.turn(self.tenant or ""):
+                            self.tick()
+                    else:
+                        self.tick()
                     self.consecutive_failures = 0
                     self.last_error = None
                     delay = cfg.poll_interval_s

@@ -16,7 +16,8 @@ crashing:
 
 from predictionio_tpu.resilience.policy import (  # noqa: F401
     TRANSIENT_ERRORS, CircuitBreaker, CircuitOpenError,
-    RetryBudgetExceeded, RetryPolicy, retry_after_hint)
+    RetryBudgetExceeded, RetryPolicy, TransientHTTPError,
+    retry_after_hint)
 from predictionio_tpu.resilience.spill import (  # noqa: F401
     SpillReplayer, SpillWAL)
 from predictionio_tpu.resilience.faults import (  # noqa: F401

@@ -58,6 +58,25 @@ class RetryBudgetExceeded(IOError):
     last underlying error as ``__cause__``."""
 
 
+class TransientHTTPError(IOError):
+    """A retryable HTTP verdict — the routing layer's bridge between
+    status codes and :data:`TRANSIENT_ERRORS`. The fleet tenant router
+    (tenancy/controller.py) raises it for responses that mean "the
+    placement you routed by is stale or the host is momentarily
+    unhappy" (404 unknown-tenant mid-failover, 409 generation fence,
+    503 shed): an ``IOError`` subclass, so a stock ``RetryPolicy``
+    retries it — after the router has refreshed its routes — and the
+    client sees slow, not 5xx. A 400 is NOT transient and must not be
+    mapped here."""
+
+    def __init__(self, message: str, status: int = 503,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.http_status = int(status)
+        if retry_after_s is not None:
+            self.retry_after_s = float(retry_after_s)
+
+
 class CircuitOpenError(IOError):
     """Fail-fast: the breaker guarding this backend is open. Maps to 503
     on HTTP surfaces; ``retry_after_s`` tells clients when the next

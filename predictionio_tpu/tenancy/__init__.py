@@ -22,14 +22,38 @@ tenants onto one device:
   ``pio_tenant_requests_total{tenant}``, a ``tenants`` block on
   ``/stats.json``, and the ``pio tenants {list,status,evict,pin}``
   CLI surfaces.
+- :mod:`tenancy.placement` + :mod:`tenancy.controller` — the FLEET
+  control plane (ISSUE 18): pure placement planning (bin-pack by HBM
+  footprint with priority pre-emption) and the PlacementController
+  that observes the member registry + per-tenant signals, fails a
+  dead host's tenants over to survivors through the generation-fenced
+  admit/remove endpoints, drives loss-free planned migrations, and
+  feeds the :class:`~predictionio_tpu.tenancy.controller.TenantRouter`
+  whose clients see slow, not 5xx, through a host kill.
+- :mod:`tenancy.props` — durable per-tenant priority/pin sidecars
+  (``pio tenants pin`` survives host restart).
+- :mod:`tenancy.auth` — the ``PIO_AUTH=on`` access-key gate over
+  ``/engines/<tenant>/queries.json`` (AccessKeys/Apps DAO validation,
+  TTL-cached).
 """
 
 from predictionio_tpu.tenancy.budget import (HBMBudgetManager,
                                              estimate_padded_bytes)
 from predictionio_tpu.tenancy.host import (HostConfig, ServingHost,
                                            TenantSlot, TenantSpec)
+from predictionio_tpu.tenancy.controller import (ControllerConfig,
+                                                 PlacementController,
+                                                 TenantRouter)
+from predictionio_tpu.tenancy.placement import (Decision, HostView,
+                                                PlacementPlan, TenantView,
+                                                plan_failover,
+                                                plan_placement,
+                                                plan_rebalance)
 
 __all__ = [
     "HBMBudgetManager", "estimate_padded_bytes",
     "HostConfig", "ServingHost", "TenantSlot", "TenantSpec",
+    "ControllerConfig", "PlacementController", "TenantRouter",
+    "Decision", "HostView", "PlacementPlan", "TenantView",
+    "plan_failover", "plan_placement", "plan_rebalance",
 ]

@@ -1,0 +1,132 @@
+"""Access-key gate for multi-tenant query routing (ISSUE 18 satellite).
+
+Original PredictionIO authenticated EVERY surface — the event API
+checked ``accessKey`` against the AccessKeys/Apps metadata tables on
+each request (PAPER.md §1). Our event server kept that; the serving
+path never had it, because a single-engine server is usually deployed
+behind something that already did. A multi-tenant host is different:
+one port fronts many tenants, and an unauthenticated
+``/engines/<tenant>/queries.json`` lets any client query any tenant.
+
+``PIO_AUTH=on`` arms this gate on the ServingHost router. The contract:
+
+- The key rides the ``accessKey`` query parameter (the classic
+  PredictionIO client convention) or the ``X-PIO-Access-Key`` header.
+- It must resolve through the AccessKeys DAO to a live App row. A
+  slot whose ``ServerConfig.accesskey`` names a specific key
+  additionally requires an exact match — that is the per-tenant
+  scoping knob (each tenant's app has its own key).
+- Failures 401 with an honest body naming WHAT was wrong (missing vs
+  unknown vs wrong-tenant), never a bare status.
+
+The hot path must stay sub-µs: DAO hits are cached per key with a TTL
+(``PIO_AUTH_CACHE_TTL_S``, default 30s), so steady-state validation is
+one dict lookup and a monotonic compare. Revocation latency equals the
+TTL — the honest trade, documented in operations.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from predictionio_tpu.utils.http import Request, Response
+
+logger = logging.getLogger(__name__)
+
+HEADER = "x-pio-access-key"
+
+
+def auth_enabled() -> bool:
+    return os.environ.get("PIO_AUTH", "").strip().lower() in (
+        "on", "1", "true", "yes")
+
+
+def cache_ttl_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get("PIO_AUTH_CACHE_TTL_S",
+                                             "30.0")))
+    except (TypeError, ValueError):
+        return 30.0
+
+
+def _deny(message: str) -> Response:
+    import json
+    return Response(401, json.dumps({"message": message}),
+                    content_type="application/json")
+
+
+class AccessKeyGate:
+    """TTL-cached access-key validator.
+
+    ``check(req, expected_key)`` returns None on success or a 401
+    ``Response`` to short-circuit the router with. The cache maps
+    key -> (appid_or_None, expiry): a *negative* entry (None appid)
+    is cached too, so a flood of bad-key requests costs one DAO read
+    per TTL, not one per request."""
+
+    def __init__(self, ttl_s: Optional[float] = None):
+        self._ttl_s = cache_ttl_s() if ttl_s is None else float(ttl_s)
+        self._lock = threading.Lock()
+        self._cache: Dict[str, Tuple[Optional[int], float]] = {}
+
+    @staticmethod
+    def _extract(req: Request) -> Optional[str]:
+        key = (req.params or {}).get("accessKey")
+        if key:
+            return str(key)
+        key = (req.headers or {}).get(HEADER)
+        return str(key) if key else None
+
+    def _resolve(self, key: str) -> Optional[int]:
+        """appid for a valid key, None for an unknown/orphaned one.
+        DAO errors deny (fail-closed: an unreachable metadata store
+        must not open every tenant to every caller)."""
+        from predictionio_tpu.data.storage.registry import Storage
+        try:
+            ak = Storage.get_meta_data_access_keys().get(key)
+            if ak is None:
+                return None
+            app = Storage.get_meta_data_apps().get(ak.appid)
+            return ak.appid if app is not None else None
+        except Exception:
+            logger.warning("auth: access-key lookup failed; denying",
+                           exc_info=True)
+            return None
+
+    def _lookup(self, key: str) -> Optional[int]:
+        now = time.monotonic()
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None and hit[1] > now:
+                return hit[0]
+        appid = self._resolve(key)
+        with self._lock:
+            if len(self._cache) >= 4096:
+                # bounded: an attacker spraying random keys must not
+                # grow the cache without limit
+                self._cache.clear()
+            self._cache[key] = (appid, now + self._ttl_s)
+        return appid
+
+    def check(self, req: Request,
+              expected_key: Optional[str] = None) -> Optional[Response]:
+        key = self._extract(req)
+        if not key:
+            return _deny("access key required: pass ?accessKey= or the "
+                         "X-PIO-Access-Key header (PIO_AUTH=on)")
+        if expected_key and key != expected_key:
+            return _deny("access key is not authorized for this tenant")
+        if self._lookup(key) is None:
+            return _deny("access key is invalid or its app is gone")
+        return None
+
+    def invalidate(self, key: Optional[str] = None):
+        with self._lock:
+            if key is None:
+                self._cache.clear()
+            else:
+                self._cache.pop(key, None)

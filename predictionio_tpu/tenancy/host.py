@@ -37,6 +37,8 @@ from predictionio_tpu.obs import FLIGHT, MetricsRegistry, fleet, \
     get_registry
 from predictionio_tpu.obs.tenantctx import register_tenant, tenant_scope
 from predictionio_tpu.serving.server import EngineServer, ServerConfig
+from predictionio_tpu.tenancy import props as tenant_props
+from predictionio_tpu.tenancy.auth import AccessKeyGate, auth_enabled
 from predictionio_tpu.tenancy.budget import HBMBudgetManager, _iter_tables
 from predictionio_tpu.utils import device_cache
 from predictionio_tpu.utils.http import (HttpServer, Request, Response,
@@ -83,6 +85,7 @@ class TenantSlot:
         self.spec = spec
         self.server = server
         self.scheduler = None
+        self.scheduler_config = None
         self.requests = 0
         self.errors = 0
         self.admitted_at = time.time()
@@ -204,6 +207,19 @@ class ServingHost:
         self._fleet_id: Optional[str] = None
         # per-tenant traffic EWMA state: key -> [t, requests, ewma]
         self._traffic: Dict[str, list] = {}
+        # placement generation fence (ISSUE 18): key -> the newest
+        # generation a control action (admit/remove) named. A stale
+        # controller retry or a router holding an old placement can
+        # never act or serve against a superseded generation. Kept
+        # monotonic even after removal, so a delayed re-admit of an
+        # already-migrated tenant is refused.
+        self._placement_gen: Dict[str, int] = {}
+        # access-key gate (PIO_AUTH=on, ISSUE 18 satellite): armed at
+        # construction so the per-request cost is one None-check
+        self._auth = AccessKeyGate() if auth_enabled() else None
+        # per-host fold-tick fairness gate, created with the first
+        # attached scheduler (online/scheduler.FoldTickGate)
+        self.tick_gate = None
         self.router = self._build_router()
 
     # -- tenant lifecycle ---------------------------------------------------
@@ -225,6 +241,7 @@ class ServingHost:
         :class:`TableBudgetExceeded` and leaves no slot behind."""
         key = _check_key(spec.key)
         register_tenant(key)   # bounded metric-label cardinality
+        self._overlay_props(spec)
         with self._lock:
             if key in self.slots:
                 raise ValueError(f"tenant {key!r} already admitted")
@@ -251,7 +268,20 @@ class ServingHost:
                       ["tenants"][key]["expectedPaddedBytes"])
         logger.info("tenant %s admitted (instance %s)", key,
                     server.model_version)
+        self._publish_roster()
         return slot
+
+    def _overlay_props(self, spec: TenantSpec):
+        """Overlay the durable per-tenant props (tenancy/props.py) on
+        the static spec: a ``pio tenants pin`` taken before a host
+        restart must survive it (ISSUE 18 satellite)."""
+        stored = tenant_props.load_props(spec.key)
+        if not stored:
+            return
+        if "priority" in stored:
+            spec.priority = int(stored["priority"])
+        if "pinned" in stored:
+            spec.pinned = bool(stored["pinned"])
 
     def admit_server(self, spec: TenantSpec,
                      server: EngineServer) -> TenantSlot:
@@ -268,6 +298,7 @@ class ServingHost:
                 f"server.tenant {server.tenant!r} != spec.key {key!r}: "
                 f"construct the EngineServer with tenant=<key>")
         register_tenant(key)
+        self._overlay_props(spec)
         with self._lock:
             if key in self.slots:
                 raise ValueError(f"tenant {key!r} already admitted")
@@ -279,6 +310,7 @@ class ServingHost:
             evictor=lambda s=slot: self._evict_slot(s))
         with self._lock:
             self.slots[key] = slot
+        self._publish_roster()
         return slot
 
     def remove_tenant(self, key: str) -> bool:
@@ -295,16 +327,28 @@ class ServingHost:
         self.budget.forget(key)
         slot.server.stop()
         FLIGHT.record("tenant_removed", tenant=key)
+        self._publish_roster()
         return True
 
     def attach_scheduler(self, key: str, config, **kw):
         """Attach a fold-in scheduler to one tenant slot — every fold
         tick runs under the tenant's device attribution scope, and its
-        publishes hot-swap only this slot."""
-        from predictionio_tpu.online.scheduler import attach_scheduler
+        publishes hot-swap only this slot. All schedulers on one host
+        share the host's :class:`FoldTickGate`, so contending tenants
+        round-robin the device by staleness instead of FIFO thread
+        wakeup (ISSUE 18 satellite)."""
+        from predictionio_tpu.online.scheduler import (FoldTickGate,
+                                                       attach_scheduler)
+        with self._lock:
+            if self.tick_gate is None:
+                self.tick_gate = FoldTickGate(registry=self.metrics)
+            gate = self.tick_gate
+        kw.setdefault("tick_gate", gate)
         slot = self._slot(key)
         sched = attach_scheduler(slot.server, config, tenant=key, **kw)
         slot.scheduler = sched
+        slot.scheduler_config = config
+        self._publish_roster()
         return sched
 
     # -- eviction mechanism -------------------------------------------------
@@ -358,6 +402,26 @@ class ServingHost:
         slot = self.slots.get(key)
         if slot is None:
             return Response(404, {"message": f"unknown tenant {key!r}"})
+        if self._auth is not None:
+            denied = self._auth.check(
+                req, getattr(slot.server.config, "accesskey", None)
+                or None)
+            if denied is not None:
+                return denied
+        # generation fence (ISSUE 18): a router that attaches the
+        # placement generation it routed by gets an honest 409 when
+        # that placement has been superseded — refresh, don't serve
+        gen_hdr = req.headers.get("x-pio-placement-gen") \
+            if req.headers else None
+        if gen_hdr is not None:
+            try:
+                if int(gen_hdr) < self._placement_gen.get(key, 0):
+                    return Response(409, {
+                        "message": "stale placement route",
+                        "tenant": key,
+                        "generation": self._placement_gen.get(key, 0)})
+            except (TypeError, ValueError):
+                pass
         # tenant attribution scope (ISSUE 17): everything this request
         # touches on the way down — budget room-making, slowlog
         # captures, flight records, trace roots, device dispatch — is
@@ -439,7 +503,192 @@ class ServingHost:
         pinned = not req.path.endswith("/unpin")
         if not self.budget.pin(key, pinned):
             return Response(404, {"message": f"unknown tenant {key!r}"})
-        return Response(200, {"tenant": key, "pinned": pinned})
+        # persist the pin as a durable tenant prop so a host restart
+        # re-admits with it (ISSUE 18 satellite); the in-memory ledger
+        # flip above is the serving truth either way
+        persisted = tenant_props.save_props(key, pinned=pinned)
+        slot = self.slots.get(key)
+        if slot is not None:
+            slot.spec.pinned = pinned
+        self._publish_roster()
+        return Response(200, {"tenant": key, "pinned": pinned,
+                              "persisted": persisted is not None})
+
+    # -- control plane (ISSUE 18): remote admit/remove + roster -------------
+    _SCHED_FIELDS = ("app_name", "channel_name", "event_names",
+                     "max_deltas", "max_staleness_s", "poll_interval_s",
+                     "tail_batch_limit", "filtered_reads")
+
+    def _sched_dict(self, cfg) -> dict:
+        out = {}
+        for k in self._SCHED_FIELDS:
+            v = getattr(cfg, k, None)
+            if v is not None:
+                out[k] = list(v) if isinstance(v, tuple) else v
+        return out
+
+    def _publish_roster(self):
+        """Re-publish this host's member record with its full tenant
+        roster (spec + generation + scheduler config). The roster must
+        live ON the record, refreshed at every admit/remove/pin: when
+        this process is SIGKILLed, the corpse record is the failover
+        controller's only source for which tenants to re-place and how
+        to rebuild them (engine coords -> registry lineage, scheduler
+        config -> fold-tail catch-up)."""
+        with self._lock:
+            fid = self._fleet_id
+            slots = list(self.slots.values())
+            gens = dict(self._placement_gen)
+        if not fid:
+            return
+        roster = {}
+        for slot in slots:
+            spec = slot.spec
+            entry = {
+                "engineId": spec.engine_id,
+                "engineVersion": spec.engine_version,
+                "engineVariant": spec.engine_variant,
+                "engineInstanceId": spec.engine_instance_id,
+                "priority": spec.priority,
+                "pinned": spec.pinned,
+                "generation": gens.get(slot.key, 0),
+            }
+            if slot.scheduler_config is not None:
+                entry["scheduler"] = self._sched_dict(
+                    slot.scheduler_config)
+            roster[slot.key] = entry
+        fleet.update_member(fid, {"tenants": roster})
+
+    def _fence(self, key: str, gen) -> Optional[Response]:
+        """409 when ``gen`` is older than the newest generation a
+        control action named for this tenant; otherwise records it."""
+        try:
+            gen = int(gen or 0)
+        except (TypeError, ValueError):
+            return Response(400, {"message": "generation must be int"})
+        with self._lock:
+            cur = self._placement_gen.get(key, 0)
+            if gen < cur:
+                return Response(409, {
+                    "message": "stale placement generation",
+                    "tenant": key, "generation": cur})
+            self._placement_gen[key] = gen
+        return None
+
+    def _tenant_admit(self, req: Request) -> Response:
+        """``POST /tenants/<key>/admit`` — the controller's remote
+        admission path. Body: engine coordinates (+ optional priority/
+        pinned/scheduler config) and the placement ``generation``.
+        Loads from registry lineage, AOT-warms before the slot becomes
+        routable (add_tenant -> EngineServer.load), attaches the fold
+        scheduler when configured (its cursor resumes from the
+        published lineage — the fold-tail catch-up), and refuses
+        honestly on budget exhaustion (409, the controller re-plans)."""
+        key = req.path_args[0]
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"message": "body must be JSON"})
+        fence = self._fence(key, body.get("generation"))
+        if fence is not None:
+            return fence
+        with self._lock:
+            if key in self.slots:
+                return Response(200, {"tenant": key,
+                                      "alreadyAdmitted": True})
+        spec = TenantSpec(
+            key=key,
+            engine_id=body.get("engineId"),
+            engine_version=str(body.get("engineVersion") or "0"),
+            engine_variant=body.get("engineVariant") or "engine.json",
+            engine_instance_id=body.get("engineInstanceId"),
+            priority=int(body.get("priority") or 0),
+            pinned=bool(body.get("pinned")))
+        from predictionio_tpu.tenancy.budget import TableBudgetExceeded
+        try:
+            self.add_tenant(spec)
+        except TableBudgetExceeded as e:
+            return Response(409, {"message": f"admission refused: {e}",
+                                  "tenant": key})
+        except ValueError as e:
+            return Response(409, {"message": str(e), "tenant": key})
+        except Exception as e:
+            logger.exception("tenant %s remote admission failed", key)
+            return Response(500, {"message": f"admission failed: {e}",
+                                  "tenant": key})
+        sched = body.get("scheduler")
+        if isinstance(sched, dict) and sched.get("app_name"):
+            try:
+                from predictionio_tpu.online.registry import \
+                    ModelVersionRegistry
+                from predictionio_tpu.online.scheduler import \
+                    SchedulerConfig
+                cfg = SchedulerConfig(**{
+                    k: sched[k] for k in self._SCHED_FIELDS
+                    if k in sched})
+                self.attach_scheduler(
+                    key, cfg, registry=ModelVersionRegistry()).start()
+            except Exception:
+                # the tenant serves; a broken fold attachment is an
+                # incident, not a failed admission
+                logger.exception("tenant %s scheduler attach failed",
+                                 key)
+        slot = self.slots.get(key)
+        return Response(200, {
+            "tenant": key,
+            "generation": self._placement_gen.get(key, 0),
+            "modelVersion": slot.server.model_version if slot else None,
+            "scheduler": bool(slot and slot.scheduler is not None)})
+
+    def _tenant_remove(self, req: Request) -> Response:
+        """``POST /tenants/<key>/remove`` — generation-fenced removal,
+        the last step of a planned migration (the target host owns the
+        newer generation by then, so a stale retry cannot re-kill)."""
+        key = req.path_args[0]
+        try:
+            body = req.json() or {}
+        except ValueError:
+            body = {}
+        fence = self._fence(key, body.get("generation"))
+        if fence is not None:
+            return fence
+        if not self.remove_tenant(key):
+            return Response(404, {"message": f"unknown tenant {key!r}"})
+        return Response(200, {"tenant": key, "removed": True,
+                              "generation":
+                                  self._placement_gen.get(key, 0)})
+
+    def _placement(self, req: Request) -> Response:
+        """``GET /placement.json`` — the host's placement truth: per
+        tenant the generation, spec and budget row the controller
+        plans against."""
+        budget = self.budget.snapshot()
+        with self._lock:
+            slots = list(self.slots.values())
+            gens = dict(self._placement_gen)
+        tenants = {}
+        for slot in slots:
+            spec = slot.spec
+            tenants[slot.key] = {
+                "generation": gens.get(slot.key, 0),
+                "engineId": spec.engine_id,
+                "engineVersion": spec.engine_version,
+                "engineVariant": spec.engine_variant,
+                "engineInstanceId": spec.engine_instance_id,
+                "priority": spec.priority,
+                "pinned": spec.pinned,
+                "cold": slot.cold,
+                "scheduler": slot.scheduler is not None,
+                "expectedPaddedBytes": budget["tenants"].get(
+                    slot.key, {}).get("expectedPaddedBytes", 0),
+            }
+        return Response(200, {
+            "memberId": self._fleet_id,
+            "budgetBytes": budget["budgetBytes"],
+            "residentBytes": budget["residentBytes"],
+            "generations": gens,
+            "tenants": tenants,
+        })
 
     def _metrics(self, req: Request) -> Response:
         """One scrape for the whole host: the host/process families
@@ -589,7 +838,10 @@ class ServingHost:
         r.add("GET", "/stats.json", self._stats)
         r.add("GET", "/tenants.json", self._tenants)
         r.add("GET", "/tenants/signals.json", self._signals)
+        r.add("GET", "/placement.json", self._placement)
         r.add("POST", "/tenants/<key>/evict", self._tenant_evict)
+        r.add("POST", "/tenants/<key>/admit", self._tenant_admit)
+        r.add("POST", "/tenants/<key>/remove", self._tenant_remove)
         r.add("POST", "/tenants/<key>/pin", self._tenant_pin)
         r.add("POST", "/tenants/<key>/unpin", self._tenant_pin)
         r.add("GET", "/metrics", self._metrics)
@@ -609,6 +861,10 @@ class ServingHost:
                                         host=self.config.ip)
             with self._lock:
                 self._fleet_id = fid
+            # the record now exists with the advertised url; stamp the
+            # current roster on it so a crash any time after bind
+            # leaves a forensically-complete corpse
+            self._publish_roster()
             logger.info("Serving host started on %s:%d (%d tenants)",
                         self.config.ip, s.port, len(self.slots))
 

@@ -1,0 +1,106 @@
+"""Durable per-tenant placement props (ISSUE 18 satellite).
+
+``pio tenants pin`` used to flip a bit on the in-memory
+``HBMBudgetManager`` ledger — gone on host restart, which made pinning
+a tenant through a maintenance window impossible. This module is the
+tiny lineage-props store that makes priority/pinned survive the
+process: one crash-atomic JSON sidecar per tenant key under
+``base_dir()/tenancy/props/``, written with the same
+temp + fsync + os.replace discipline as the deploy guard's
+last-good pin (online/registry.py), read back as an overlay on the
+static ``TenantSpec`` at admit time.
+
+Why sidecars and not an EngineInstances column: props describe the
+TENANT (the serving placement identity), not any one trained instance
+— a pin must survive retrains, rollbacks, and lineage republishes,
+none of which should have to re-write placement intent. The store is
+deliberately dumb: no locking beyond atomic replace (last writer wins,
+and writers are the host's control endpoints, not the serve path).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+#: props any caller may set; unknown keys are dropped on save so a
+#: future reader never chokes on a foreign writer's experiment
+_FIELDS = ("priority", "pinned")
+
+
+def _props_dir() -> str:
+    from predictionio_tpu.data.storage.registry import base_dir
+    return os.path.join(base_dir(), "tenancy", "props")
+
+
+def _path(tenant: str) -> str:
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", tenant or "_")
+    return os.path.join(_props_dir(), f"{safe}.json")
+
+
+def load_props(tenant: str) -> Optional[dict]:
+    """The stored props for one tenant, or None when never written
+    (callers then keep the spec's static defaults)."""
+    try:
+        with open(_path(tenant), encoding="utf-8") as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def save_props(tenant: str, *, priority: Optional[int] = None,
+               pinned: Optional[bool] = None) -> Optional[dict]:
+    """Merge the given fields into the tenant's props sidecar,
+    crash-atomically. Returns the record written, or None when the
+    write failed (fail-soft: a read-only base_dir must not break the
+    pin endpoint — the in-memory ledger still flips)."""
+    rec = load_props(tenant) or {"tenant": tenant}
+    if priority is not None:
+        rec["priority"] = int(priority)
+    if pinned is not None:
+        rec["pinned"] = bool(pinned)
+    rec = {k: rec[k] for k in ("tenant", *_FIELDS) if k in rec}
+    rec["updatedAt"] = time.time()
+    path = _path(tenant)
+    tmp = path + f".tmp{os.getpid()}"
+    try:
+        os.makedirs(_props_dir(), exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rec, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        logger.warning("tenancy: cannot persist props for %r under %s",
+                       tenant, _props_dir(), exc_info=True)
+        return None
+    return rec
+
+
+def all_props() -> Dict[str, dict]:
+    """Every stored props record, keyed by tenant (for ``pio placement
+    status`` and the controller's priority-aware planning)."""
+    out: Dict[str, dict] = {}
+    try:
+        names = sorted(os.listdir(_props_dir()))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(_props_dir(), name),
+                      encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and rec.get("tenant"):
+            out[rec["tenant"]] = rec
+    return out

@@ -985,11 +985,14 @@ def cmd_fleet(args) -> int:
                    "with this PIO_FS_BASEDIR?)")
             return 1
         for m in st["members"]:
+            url = m.get("url") or (F.member_url(m) or "-")
             _print(f"  {m.get('memberId', '?'):28s} "
                    f"{'UP' if m.get('alive') else 'DEAD':5s} "
                    f"pid={m.get('pid')} "
-                   f"port={m.get('port') or '-':<6} "
-                   f"beat {m.get('ageS', 0):.1f}s ago")
+                   f"url={url:<28} "
+                   f"beat {m.get('ageS', 0):.1f}s ago"
+                   + (f" tenants={','.join(sorted(m['tenants']))}"
+                      if m.get("tenants") else ""))
         _print(f"  {st['alive']} alive, {st['dead']} dead")
         return 0 if st["dead"] == 0 else 1
     if sub == "metrics":
@@ -1235,6 +1238,87 @@ def cmd_tenants(args) -> int:
         _print(_json.dumps(out, indent=2, default=str))
         return 0 if st == 200 else 1
     _print("tenants command must be list|status|evict|pin|unpin|signals")
+    return 1
+
+
+def cmd_placement(args) -> int:
+    """`pio placement {status,plan,apply}` (ISSUE 18): the fleet
+    tenant control plane's operator surface — where every tenant is
+    placed (and under which generation), what the planner would do
+    about budget pressure, and the lever that executes the planned
+    migrations one observed step at a time."""
+    import json as _json
+
+    from predictionio_tpu.obs import fleet as F
+    from predictionio_tpu.tenancy.controller import PlacementController
+    reg = F.FleetRegistry(fleet_dir=getattr(args, "dir", None)) \
+        if getattr(args, "dir", None) else F.get_fleet()
+    ctl = PlacementController(registry=reg)
+    sub = args.placement_command
+    if sub == "status":
+        st = ctl.status()
+        if getattr(args, "json", False):
+            _print(_json.dumps(st, indent=2, default=str))
+            return 0
+        hosts = st["hosts"]
+        if not hosts:
+            _print("no serving hosts registered (are they running "
+                   "with this PIO_FS_BASEDIR?)")
+            return 1
+        for h in hosts:
+            bb = h.get("budgetBytes")
+            _print(f"{h['memberId']:28s} "
+                   f"{'UP' if h['alive'] else 'DEAD':5s} "
+                   f"{h.get('url') or '-':<26} "
+                   f"hbm={h['usedBytes']}"
+                   + (f"/{bb}" if bb else " (no budget)"))
+            for k, t in h["tenants"].items():
+                pin = " pinned" if t.get("pinned") else ""
+                _print(f"    {k:20s} gen={t['generation']:<4} "
+                       f"prio={t['priority']:<3} "
+                       f"hbm={t['hbmBytes']:>10} "
+                       f"rps={t['trafficEwmaRps']:<8} "
+                       f"slo={t['sloStatus']}{pin}")
+        slo = st.get("slo") or {}
+        _print(f"controller SLO: {slo.get('status', 'no_data')}")
+        dead_with_tenants = [h["memberId"] for h in hosts
+                             if not h["alive"] and h["tenants"]]
+        if dead_with_tenants:
+            _print(f"DEAD hosts still holding tenants: "
+                   f"{dead_with_tenants} (run a controller, or "
+                   f"`pio placement apply` after it fails them over)")
+            return 1
+        return 0
+    if sub == "plan":
+        out = ctl.plan()
+        decisions = out["rebalance"]["decisions"]
+        if getattr(args, "json", False):
+            _print(_json.dumps(out, indent=2, default=str))
+            return 0
+        if not decisions:
+            _print("nothing to do: no live host is under budget "
+                   "pressure")
+            return 0
+        for d in decisions:
+            _print(f"  {d['action']:8s} {d['tenant']:20s} "
+                   f"{d.get('fromHost', '-')} -> {d.get('host', '-')} "
+                   f"({d.get('reason', '')})")
+        return 0
+    if sub == "apply":
+        # one failover pass first (a dead host's stranded tenants are
+        # more urgent than budget pressure), then the rebalance moves
+        step = ctl.step()
+        for a in step.get("actions", ()):
+            _print(f"failover executed for {a['failover']}")
+        moves = ctl.apply_rebalance()
+        if not moves and not step.get("actions"):
+            _print("nothing to do")
+            return 0
+        for m in moves:
+            _print(f"migrated {m['tenant']}: {m['from']} -> {m['to']} "
+                   f"(generation {m['generation']})")
+        return 0
+    _print("placement command must be status|plan|apply")
     return 1
 
 
@@ -1751,6 +1835,23 @@ def build_parser() -> argparse.ArgumentParser:
     pftr.add_argument("--ip", default="127.0.0.1")
     pftr.add_argument("--port", type=int, default=8000)
     pf.set_defaults(func=cmd_profile)
+
+    pl = sub.add_parser(
+        "placement", help="fleet tenant control plane (ISSUE 18): "
+        "per-host placements and generations, the rebalance plan, and "
+        "one-shot failover + migration execution")
+    plsub = pl.add_subparsers(dest="placement_command", required=True)
+    pls = plsub.add_parser("status")
+    pls.add_argument("--dir", help="fleet registry dir (default: "
+                     "<PIO_FS_BASEDIR>/fleet)")
+    pls.add_argument("--json", action="store_true",
+                     help="full machine-readable status")
+    plp = plsub.add_parser("plan")
+    plp.add_argument("--dir")
+    plp.add_argument("--json", action="store_true")
+    pla = plsub.add_parser("apply")
+    pla.add_argument("--dir")
+    pl.set_defaults(func=cmd_placement)
 
     fl = sub.add_parser(
         "faults", help="chaos-harness control: validate a PIO_FAULTS "

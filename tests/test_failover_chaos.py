@@ -1,0 +1,293 @@
+"""Host-kill chaos proof (ISSUE 18 tentpole; scripts/failover_smoke.sh):
+two serving-host processes + an event server on one base_dir, tenants
+admitted onto host A with a fold scheduler attached, then A is
+SIGKILLed. The placement controller (running in the test process) must
+re-place every stranded tenant onto host B within 60s — reloaded from
+registry lineage, scheduler resumed from the published cursor — while
+clients hammering through the TenantRouter see added latency but ZERO
+errors, and the episode lands as one failover incident bundle naming
+the dead member and each re-placed tenant."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import pytest
+
+HOST_CHILD = textwrap.dedent("""
+    import json, os, signal
+    from predictionio_tpu.data.storage import registry
+    registry.clear_cache()
+    from predictionio_tpu.tenancy import HostConfig, ServingHost
+    h = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+    h.start()
+    print(json.dumps({"port": h.config.port, "pid": os.getpid(),
+                      "memberId": f"serving_host-{os.getpid()}"}),
+          flush=True)
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    h.stop()
+""")
+
+EVENT_CHILD = textwrap.dedent("""
+    import json, os, signal
+    from predictionio_tpu.data.storage import registry
+    registry.clear_cache()
+    from predictionio_tpu.data.api.event_server import (EventServer,
+                                                        EventServerConfig)
+    es = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                       stats=True))
+    es.start()
+    print(json.dumps({"port": es.config.port, "pid": os.getpid()}),
+          flush=True)
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    es.stop()
+""")
+
+
+def _spawn(code, env):
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line:
+        raise RuntimeError("child died: " + proc.stderr.read()[-2000:])
+    return proc, json.loads(line)
+
+
+def _post(url, body, timeout=180):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _insert_events(app_id, start, count):
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.storage import Storage
+    ev = Storage.get_events()
+    for n in range(start, start + count):
+        ev.insert(Event(
+            event="rate", entity_type="user",
+            entity_id=f"u{n % 6}", target_entity_type="item",
+            target_entity_id=f"i{n % 6}",
+            properties=DataMap({"rating": float(1 + n % 5)})), app_id)
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_failover_replaces_stranded_tenants(tmp_path, mesh8,
+                                            monkeypatch):
+    base = str(tmp_path / "pio")
+    env = dict(
+        os.environ, PIO_FS_BASEDIR=base, JAX_PLATFORMS="cpu",
+        PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="SQLITE",
+        PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="SQLITE",
+        PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="LOCALFS",
+        PIO_STORAGE_SOURCES_SQLITE_TYPE="sqlite",
+        PIO_STORAGE_SOURCES_SQLITE_URL=str(tmp_path / "shared.db"),
+        PIO_STORAGE_SOURCES_LOCALFS_TYPE="localfs",
+        PIO_STORAGE_SOURCES_LOCALFS_HOSTS=str(tmp_path / "models"))
+    for k, v in env.items():
+        if k.startswith("PIO_"):
+            monkeypatch.setenv(k, v)
+    from predictionio_tpu.data.storage import registry as sreg
+    sreg.clear_cache()
+
+    from predictionio_tpu.core import EngineParams
+    from predictionio_tpu.data.storage import AccessKey, App, Storage
+    from predictionio_tpu.models import recommendation as R
+    from predictionio_tpu.obs import fleet
+    from predictionio_tpu.resilience import RetryPolicy
+    from predictionio_tpu.tenancy.controller import (ControllerConfig,
+                                                     PlacementController,
+                                                     TenantRouter)
+    from predictionio_tpu.workflow import run_train
+
+    app_id = Storage.get_meta_data_apps().insert(App(0, "smokeapp"))
+    Storage.get_events().init(app_id)
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("smokekey", app_id, []))
+    _insert_events(app_id, 0, 36)
+    ep = EngineParams(
+        data_source_params=("", R.DataSourceParams(
+            app_name="smokeapp")),
+        preparator_params=("", R.PreparatorParams()),
+        algorithm_params_list=[("als", R.ALSAlgorithmParams(
+            rank=4, num_iterations=2, lam=0.1, seed=1))],
+        serving_params=("", None))
+    run_train(R.RecommendationEngineFactory.apply(), ep,
+              engine_id="smoke", engine_version="1",
+              engine_variant="v1", engine_factory="recommendation")
+    instances = Storage.get_meta_data_engine_instances()
+
+    def latest_id():
+        inst = instances.get_latest_completed("smoke", "1", "v1")
+        return inst.id if inst else None
+
+    procs = []
+    ctl = None
+    hammer_stop = threading.Event()
+    try:
+        es_proc, _es = _spawn(EVENT_CHILD, env)
+        procs.append(es_proc)
+        a_proc, a = _spawn(HOST_CHILD, env)
+        procs.append(a_proc)
+        b_proc, b = _spawn(HOST_CHILD, env)
+        procs.append(b_proc)
+
+        reg = fleet.FleetRegistry(
+            fleet_dir=os.path.join(base, "fleet"))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            live = {m["memberId"] for m in reg.live_members()}
+            if {a["memberId"], b["memberId"]} <= live:
+                break
+            for p in procs:
+                assert p.poll() is None, (
+                    "a member died during boot: "
+                    + p.stderr.read()[-2000:])
+            time.sleep(0.5)
+        assert {a["memberId"], b["memberId"]} <= {
+            m["memberId"] for m in reg.live_members()}
+
+        # two tenants land on host A: t1 with a fold scheduler
+        # following the event tail, t2 serve-only
+        coords = {"engineId": "smoke", "engineVersion": "1",
+                  "engineVariant": "v1"}
+        st, body = _post(
+            f"http://127.0.0.1:{a['port']}/tenants/t1/admit",
+            dict(coords, generation=1, scheduler={
+                "app_name": "smokeapp", "max_deltas": 2,
+                "max_staleness_s": 1.0, "poll_interval_s": 0.5}))
+        assert st == 200 and body["scheduler"], body
+        st, body = _post(
+            f"http://127.0.0.1:{a['port']}/tenants/t2/admit",
+            dict(coords, generation=1))
+        assert st == 200, body
+
+        ctl = PlacementController(
+            ControllerConfig(interval_s=0.5, admit_timeout_s=180.0),
+            registry=reg)
+        ctl.step()
+        assert ctl.route_for("t1")[1] == a["memberId"]
+        router = TenantRouter(ctl, policy=RetryPolicy(
+            max_attempts=200, base_delay_s=0.2, max_delay_s=1.0,
+            deadline_s=120.0))
+        q = {"user": "u1", "num": 3}
+        assert router.query("t1", q)["itemScores"]
+        assert router.query("t2", q)["itemScores"]
+
+        # prove the fold tail is live on A: fresh events must surface
+        # as a new published instance in the registry lineage
+        base_inst = latest_id()
+        _insert_events(app_id, 100, 8)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if latest_id() != base_inst:
+                break
+            time.sleep(0.5)
+        pre_kill_inst = latest_id()
+        assert pre_kill_inst != base_inst, "fold tail never published"
+
+        # hammer both tenants through the router for the whole episode:
+        # every attempt must eventually answer — slow is fine, an
+        # exception (incl. any surfaced 5xx) is a failed client
+        errors, answers = [], []
+
+        def hammer():
+            while not hammer_stop.is_set():
+                for key in ("t1", "t2"):
+                    try:
+                        out = router.query(key, q)
+                        answers.append((key, out["itemScores"]))
+                    except Exception as e:   # noqa: BLE001
+                        errors.append((key, repr(e)))
+                time.sleep(0.05)
+
+        ht = threading.Thread(target=hammer, daemon=True)
+        ht.start()
+        ctl.start()
+        time.sleep(1.0)
+
+        # SIGKILL host A: no deregistration, no goodbye
+        os.kill(a["pid"], signal.SIGKILL)
+        a_proc.wait(timeout=10)   # reap: the pid probe must see ESRCH
+        t_kill = time.monotonic()
+
+        # every stranded tenant must answer from host B within 60s
+        moved = set()
+        deadline = t_kill + 60
+        while time.monotonic() < deadline and moved != {"t1", "t2"}:
+            for key in ("t1", "t2"):
+                r = ctl.route_for(key)
+                if r and r[1] == b["memberId"]:
+                    moved.add(key)
+            time.sleep(0.5)
+        took = time.monotonic() - t_kill
+        assert moved == {"t1", "t2"}, (
+            f"stranded tenants not re-placed after {took:.1f}s "
+            f"(moved={moved}, errors={errors[:3]})")
+
+        # host B's placement surface owns both tenants, with the fold
+        # scheduler re-attached to t1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{b['port']}/placement.json",
+                timeout=10) as resp:
+            plc = json.loads(resp.read())
+        assert {"t1", "t2"} <= set(plc["tenants"])
+        assert plc["tenants"]["t1"]["scheduler"] is True
+
+        # fold-tail catch-up: B's scheduler resumed from the published
+        # cursor — new events still become new published instances
+        _insert_events(app_id, 200, 8)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if latest_id() != pre_kill_inst:
+                break
+            time.sleep(0.5)
+        assert latest_id() != pre_kill_inst, (
+            "fold tail did not catch up on the survivor")
+
+        hammer_stop.set()
+        ht.join(timeout=30)
+        assert not errors, errors[:5]
+        assert answers, "hammer never completed a query"
+
+        # exactly one failover incident bundle, naming the dead member
+        # and every re-placed tenant
+        from predictionio_tpu.obs.incidents import get_incidents
+        inc_root = get_incidents().incidents_dir()
+        bundles = []
+        for name in sorted(os.listdir(inc_root)):
+            p = os.path.join(inc_root, name, "incident.json")
+            if os.path.exists(p):
+                with open(p) as f:
+                    bundles.append(json.load(f))
+        ours = [x for x in bundles if x["kind"] == "host_failover"]
+        assert len(ours) == 1, [x["kind"] for x in bundles]
+        assert ours[0]["context"]["deadMember"] == a["memberId"]
+        replaced = {r["tenant"] for r in ours[0]["context"]["replaced"]}
+        assert replaced == {"t1", "t2"}
+        assert not ours[0]["context"]["failed"]
+        for key in ("t1", "t2"):
+            assert key in ours[0]["reason"]
+    finally:
+        hammer_stop.set()
+        if ctl is not None:
+            ctl.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        sreg.clear_cache()

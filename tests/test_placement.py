@@ -1,0 +1,585 @@
+"""Fleet tenant control plane (ISSUE 18): placement-planner golden
+tables, fold-tick fairness, the access-key gate, durable tenant props,
+fleet member URLs + rosters, and the migration generation fence —
+including the regression that a stale route can never hit an evicted
+tenant."""
+
+import datetime as dt
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import FirstServing
+from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap
+from predictionio_tpu.data.storage.base import EngineInstance
+from predictionio_tpu.models import recommendation as R
+from predictionio_tpu.obs import fleet
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.online.scheduler import FoldTickGate
+from predictionio_tpu.serving import EngineServer, ServerConfig
+from predictionio_tpu.tenancy import (HostConfig, ServingHost,
+                                      TenantSpec)
+from predictionio_tpu.tenancy import props as tenant_props
+from predictionio_tpu.tenancy.auth import AccessKeyGate
+from predictionio_tpu.tenancy.controller import (PlacementController,
+                                                 TenantRouter)
+from predictionio_tpu.tenancy.placement import (HostView, TenantView,
+                                                plan_failover,
+                                                plan_placement,
+                                                plan_rebalance)
+
+RANK = 8
+
+
+# -- helpers (mirrors tests/test_tenancy.py's synthetic-slot idiom) ----------
+
+def _rec_model(n_users=64, n_items=128, const=None):
+    from predictionio_tpu.ops.als import ALSModel
+    if const is not None:
+        u = np.full((n_users, RANK), const, dtype=np.float32)
+        v = np.ones((n_items, RANK), dtype=np.float32)
+    else:
+        rng = np.random.default_rng(0)
+        u = rng.standard_normal((n_users, RANK)).astype(np.float32)
+        v = rng.standard_normal((n_items, RANK)).astype(np.float32)
+    als = ALSModel(user_factors=u, item_factors=v, rank=RANK)
+    user_ix = EntityIdIxMap(BiMap({f"u{i}": i for i in range(n_users)}))
+    item_ix = EntityIdIxMap(BiMap({f"i{i}": i for i in range(n_items)}))
+    return R.RecommendationModel(als, user_ix, item_ix)
+
+
+def _slot_server(host, key, model=None, config=None):
+    srv = EngineServer(
+        config or ServerConfig(ip="127.0.0.1", port=0),
+        engine=R.RecommendationEngineFactory.apply(), tenant=key,
+        shared_result_cache=host.result_cache)
+    now = dt.datetime.now(dt.timezone.utc)
+    srv.engine_instance = EngineInstance(
+        id=f"inst-{key}", status="COMPLETED", start_time=now,
+        end_time=now, engine_id=key, engine_version="0",
+        engine_variant="t", engine_factory="recommendation")
+    srv.algorithms = [R.ALSAlgorithm(R.ALSAlgorithmParams(rank=RANK))]
+    srv.models = [model or _rec_model()]
+    srv.serving = FirstServing()
+    srv.model_version = f"inst-{key}"
+    srv.last_good_version = f"inst-{key}"
+    return srv
+
+
+def _call(port, path, body=None, method=None, headers=None):
+    """HTTP helper that returns (status, parsed) for ERROR statuses
+    too — the fence/auth tests assert on 401/404/409 bodies."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method or ("POST" if body is not None else "GET"),
+        headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            raw, ct = resp.read(), resp.headers.get("Content-Type", "")
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        raw, ct = e.read(), e.headers.get("Content-Type", "")
+        status = e.code
+    return status, (json.loads(raw) if "json" in ct else raw.decode())
+
+
+@pytest.fixture
+def host(mesh8):
+    h = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+    yield h
+    h.stop()
+
+
+def _t(key, hbm, prio=0, pinned=False, traffic=0.0):
+    return TenantView(key=key, hbm_bytes=hbm, priority=prio,
+                      pinned=pinned, traffic_ewma=traffic)
+
+
+def _h(mid, budget, tenants=(), alive=True):
+    return HostView(member_id=mid, url=f"http://x/{mid}",
+                    budget_bytes=budget, alive=alive,
+                    tenants={t.key: t for t in tenants})
+
+
+# -- placement planner golden tables -----------------------------------------
+
+class TestPlacementPlanner:
+    def test_spread_picks_most_free_host(self):
+        hosts = [_h("h1", 100, [_t("a", 60)]), _h("h2", 100, [_t("b", 10)])]
+        plan = plan_placement(hosts, [_t("c", 30)])
+        assert [d.as_dict() for d in plan.decisions] == [
+            {"action": "admit", "tenant": "c", "host": "h2",
+             "reason": "fits free budget"}]
+
+    def test_unbounded_host_always_fits(self):
+        hosts = [_h("h1", 10), _h("h2", None)]
+        plan = plan_placement(hosts, [_t("big", 10 ** 12)])
+        assert plan.admits[0].host == "h2" and not plan.refusals
+
+    def test_priority_then_size_ordering(self):
+        # highest priority places first; within a priority, biggest
+        # first (bin-pack: don't strand the whale behind the minnows)
+        hosts = [_h("h1", 100)]
+        plan = plan_placement(hosts, [
+            _t("small-hi", 10, prio=5), _t("big-lo", 80, prio=0),
+            _t("big-hi", 40, prio=5)])
+        assert [d.tenant for d in plan.decisions] == [
+            "big-hi", "small-hi", "big-lo"]
+        # 40 + 10 fit; the low-priority whale is refused honestly
+        assert plan.refusals[0].tenant == "big-lo"
+        assert "no feasible host" in plan.refusals[0].reason
+
+    def test_preemption_evicts_coldest_lower_priority(self):
+        hosts = [_h("h1", 100, [
+            _t("cold", 40, prio=0, traffic=0.1),
+            _t("hot", 40, prio=0, traffic=50.0)]),
+            _h("h2", 100, [_t("z", 60)])]
+        plan = plan_placement(hosts, [_t("vip", 50, prio=9)])
+        acts = {(d.action, d.tenant): d for d in plan.decisions}
+        # the colder resident goes, the hotter one stays
+        assert ("preempt", "cold") in acts
+        assert ("preempt", "hot") not in acts
+        assert acts[("admit", "vip")].host == "h1"
+        # the displaced tenant is re-placed, not dropped: h2 has room
+        assert acts[("admit", "cold")].host == "h2"
+
+    def test_preemption_never_touches_pinned_or_equal_priority(self):
+        hosts = [_h("h1", 100, [
+            _t("pinned", 60, prio=0, pinned=True),
+            _t("peer", 40, prio=5)])]
+        plan = plan_placement(hosts, [_t("vip", 50, prio=5)])
+        assert [d.action for d in plan.decisions] == ["refuse"]
+
+    def test_displaced_tenant_cannot_cascade(self):
+        # the displaced tenant re-enters the queue once; with nowhere
+        # to go it becomes a refusal, it must NOT preempt someone else
+        hosts = [_h("h1", 100, [_t("mid", 90, prio=5)]),
+                 _h("h2", 100, [_t("low", 90, prio=1)])]
+        plan = plan_placement(hosts, [_t("vip", 90, prio=9)])
+        acts = [(d.action, d.tenant) for d in plan.decisions]
+        assert ("admit", "vip") in acts
+        # exactly one preemption happened; its victim was refused
+        preempted = [t for a, t in acts if a == "preempt"]
+        assert len(preempted) == 1
+        assert ("refuse", preempted[0]) in acts
+
+    def test_refusal_is_honest_and_plan_pure(self):
+        hosts = [_h("h1", 10, [_t("a", 5)])]
+        plan = plan_placement(hosts, [_t("big", 50, prio=9)])
+        assert plan.refusals and "50 bytes" in plan.refusals[0].reason
+        # the planner simulated on copies: caller's views unchanged
+        assert set(hosts[0].tenants) == {"a"}
+
+    def test_failover_places_only_on_survivors(self):
+        dead = _h("dead", 100, [_t("a", 30), _t("b", 30)], alive=False)
+        hosts = [dead, _h("s1", 100, [_t("c", 80)]), _h("s2", 100)]
+        plan = plan_failover(hosts, dead)
+        assert {d.host for d in plan.admits} == {"s2"}
+        assert {d.tenant for d in plan.admits} == {"a", "b"}
+
+    def test_rebalance_moves_coldest_unpinned_off_pressured_host(self):
+        hosts = [_h("h1", 100, [
+            _t("pinned-cold", 30, pinned=True, traffic=0.0),
+            _t("cold", 30, traffic=1.0),
+            _t("hot", 35, traffic=99.0)]),
+            _h("h2", 100, [_t("z", 10)])]
+        plan = plan_rebalance(hosts, pressure_ratio=0.9)
+        assert len(plan.decisions) == 1
+        d = plan.decisions[0]
+        assert (d.action, d.tenant, d.from_host, d.host) == (
+            "migrate", "cold", "h1", "h2")
+
+    def test_rebalance_quiet_fleet_plans_nothing(self):
+        hosts = [_h("h1", 100, [_t("a", 30)]), _h("h2", 100)]
+        assert plan_rebalance(hosts).decisions == []
+
+
+# -- fold-tick fairness gate --------------------------------------------------
+
+class TestFoldTickGate:
+    def _drain(self, gate, tenants, order):
+        threads = []
+        for name in tenants:
+            def run(n=name):
+                with gate.turn(n):
+                    order.append(n)
+            t = threading.Thread(target=run)
+            t.start()
+            threads.append(t)
+            # deterministic arrival order: wait until queued
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if name in gate.stats()["waiting"] or not t.is_alive():
+                    break
+                time.sleep(0.005)
+        return threads
+
+    def test_grants_go_to_stalest_tenant_first(self):
+        reg = MetricsRegistry()
+        gate = FoldTickGate(registry=reg)
+        order = []
+        with gate.turn("holder"):
+            # both queue while the holder keeps the gate busy; "a"
+            # arrives first but "b" has the older last grant
+            gate._last_grant.update({"a": 100.0, "b": 50.0})
+            threads = self._drain(gate, ["a", "b"], order)
+        for t in threads:
+            t.join(timeout=10)
+        assert order == ["b", "a"]
+        # the wait is observable per tenant
+        out = reg.render()
+        assert "pio_fold_tick_wait_seconds" in out
+        assert 'tenant="b"' in out
+
+    def test_never_granted_tenant_beats_recently_granted(self):
+        gate = FoldTickGate(registry=MetricsRegistry())
+        order = []
+        with gate.turn("holder"):
+            gate._last_grant["veteran"] = time.monotonic()
+            threads = self._drain(gate, ["veteran", "newcomer"], order)
+        for t in threads:
+            t.join(timeout=10)
+        assert order == ["newcomer", "veteran"]
+
+    def test_contending_tenants_alternate(self):
+        gate = FoldTickGate(registry=MetricsRegistry())
+        order = []
+
+        def run(name, n=6):
+            for _ in range(n):
+                with gate.turn(name):
+                    order.append(name)
+                    # a tick long enough that the peer (which re-queues
+                    # within microseconds of finishing its own) is
+                    # always waiting when this one ends — so the test
+                    # exercises contended grants, not lucky timing
+                    time.sleep(0.02)
+        # both loops queue while the holder keeps the gate busy
+        with gate.turn("holder"):
+            ts = [threading.Thread(target=run, args=(n,))
+                  for n in ("a", "b")]
+            for t in ts:
+                t.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if set(gate.stats()["waiting"]) >= {"a", "b"}:
+                    break
+                time.sleep(0.005)
+        for t in ts:
+            t.join(timeout=30)
+        # staleness round-robin: no tenant takes three consecutive
+        # ticks while the other still has work queued
+        runs = worst = 1
+        for prev, cur in zip(order, order[1:]):
+            runs = runs + 1 if prev == cur else 1
+            worst = max(worst, runs)
+        assert worst <= 2, order
+        assert sorted(order) == ["a"] * 6 + ["b"] * 6
+
+
+# -- access-key gate -----------------------------------------------------------
+
+class TestAccessKeyGate:
+    def _seed_keys(self):
+        from predictionio_tpu.data.storage import AccessKey, App, Storage
+        apps = Storage.get_meta_data_apps()
+        keys = Storage.get_meta_data_access_keys()
+        app_id = apps.insert(App(0, "authapp"))
+        keys.insert(AccessKey("goodkey", app_id, []))
+        keys.insert(AccessKey("otherkey", app_id, []))
+        return app_id
+
+    def test_gate_armed_by_env_checks_dao(self, tmp_env, mesh8,
+                                          monkeypatch):
+        self._seed_keys()
+        monkeypatch.setenv("PIO_AUTH", "on")
+        h = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+        try:
+            h.admit_server(TenantSpec(key="a", engine_id="a"),
+                           _slot_server(h, "a"))
+            h.start()
+            port = h.config.port
+            q = {"user": "u1", "num": 2}
+            st, body = _call(port, "/engines/a/queries.json", q)
+            assert st == 401 and "access key required" in body["message"]
+            st, body = _call(port,
+                             "/engines/a/queries.json?accessKey=nope", q)
+            assert st == 401 and "invalid" in body["message"]
+            st, out = _call(port,
+                            "/engines/a/queries.json?accessKey=goodkey",
+                            q)
+            assert st == 200 and out["itemScores"]
+            st, out = _call(port, "/engines/a/queries.json", q,
+                            headers={"X-PIO-Access-Key": "goodkey"})
+            assert st == 200 and out["itemScores"]
+        finally:
+            h.stop()
+
+    def test_tenant_scoped_key_must_match(self, tmp_env, mesh8,
+                                          monkeypatch):
+        self._seed_keys()
+        monkeypatch.setenv("PIO_AUTH", "on")
+        h = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+        try:
+            cfg = ServerConfig(ip="127.0.0.1", port=0,
+                               accesskey="goodkey")
+            h.admit_server(TenantSpec(key="a", engine_id="a"),
+                           _slot_server(h, "a", config=cfg))
+            h.start()
+            q = {"user": "u1", "num": 1}
+            # a VALID key for the wrong tenant still 401s
+            st, body = _call(
+                h.config.port,
+                "/engines/a/queries.json?accessKey=otherkey", q)
+            assert st == 401
+            assert "not authorized for this tenant" in body["message"]
+            st, _ = _call(
+                h.config.port,
+                "/engines/a/queries.json?accessKey=goodkey", q)
+            assert st == 200
+        finally:
+            h.stop()
+
+    def test_auth_off_by_default(self, tmp_env, host):
+        host.admit_server(TenantSpec(key="a", engine_id="a"),
+                          _slot_server(host, "a"))
+        host.start()
+        st, out = _call(host.config.port, "/engines/a/queries.json",
+                        {"user": "u1", "num": 1})
+        assert st == 200 and out["itemScores"]
+
+    def test_ttl_cache_bounds_dao_reads(self, monkeypatch):
+        gate = AccessKeyGate(ttl_s=60.0)
+        calls = []
+        monkeypatch.setattr(
+            gate, "_resolve",
+            lambda key: calls.append(key) or (
+                7 if key == "goodkey" else None))
+        assert gate._lookup("badkey") is None
+        assert gate._lookup("badkey") is None   # negative entry cached
+        assert calls == ["badkey"]
+        assert gate._lookup("goodkey") == 7
+        assert gate._lookup("goodkey") == 7
+        assert calls == ["badkey", "goodkey"]
+        gate.invalidate("goodkey")
+        assert gate._lookup("goodkey") == 7
+        assert calls == ["badkey", "goodkey", "goodkey"]
+
+
+# -- fleet member URL + roster -------------------------------------------------
+
+class TestFleetUrlAndRoster:
+    def test_register_records_advertised_url(self, tmp_path):
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "fleet"))
+        mid = reg.register("serving_host", port=8123)
+        try:
+            (m,) = [x for x in reg.members()
+                    if x["memberId"] == mid]
+            assert m["url"] == "http://127.0.0.1:8123"
+        finally:
+            reg.deregister(mid)
+
+    def test_member_url_prefers_record_over_derivation(self):
+        assert fleet.member_url(
+            {"url": "http://10.0.0.9:77/", "host": "x", "port": 1}
+        ) == "http://10.0.0.9:77"
+        assert fleet.member_url(
+            {"host": "10.0.0.9", "port": 77}) == "http://10.0.0.9:77"
+        assert fleet.member_url({"host": "10.0.0.9"}) is None
+
+    def test_update_member_publishes_roster_immediately(self, tmp_path):
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "fleet"))
+        mid = reg.register("serving_host", port=8123)
+        try:
+            roster = {"a": {"engineId": "a", "generation": 3}}
+            assert reg.update_member(mid, {"tenants": roster})
+            (m,) = [x for x in reg.members()
+                    if x["memberId"] == mid]
+            assert m["tenants"] == roster
+            # unknown member: fail-soft False, nothing written
+            assert not reg.update_member("nope-1", {"tenants": {}})
+        finally:
+            reg.deregister(mid)
+
+
+# -- durable tenant props ------------------------------------------------------
+
+class TestDurableProps:
+    def test_roundtrip_merge_and_index(self, tmp_env):
+        assert tenant_props.load_props("a") is None
+        rec = tenant_props.save_props("a", pinned=True)
+        assert rec["pinned"] is True and "priority" not in rec
+        rec = tenant_props.save_props("a", priority=7)
+        # merge: the earlier pin survives the later priority write
+        assert rec == {k: rec[k] for k in rec}
+        stored = tenant_props.load_props("a")
+        assert stored["pinned"] is True and stored["priority"] == 7
+        tenant_props.save_props("weird/key:x", pinned=True)
+        idx = tenant_props.all_props()
+        assert idx["a"]["priority"] == 7
+        assert idx["weird/key:x"]["pinned"] is True
+
+    def test_pin_survives_host_restart(self, tmp_env, mesh8):
+        h1 = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+        try:
+            h1.admit_server(TenantSpec(key="a", engine_id="a"),
+                            _slot_server(h1, "a"))
+            h1.start()
+            st, body = _call(h1.config.port, "/tenants/a/pin", {})
+            assert st == 200 and body["pinned"] and body["persisted"]
+        finally:
+            h1.stop()
+        assert tenant_props.load_props("a")["pinned"] is True
+        # "restart": a fresh host re-admits from a STATIC spec; the
+        # durable prop overlays it at admission
+        h2 = ServingHost(HostConfig(ip="127.0.0.1", port=0))
+        try:
+            slot = h2.admit_server(TenantSpec(key="a", engine_id="a"),
+                                   _slot_server(h2, "a"))
+            assert slot.spec.pinned is True
+            assert h2.budget.snapshot()["tenants"]["a"]["pinned"]
+        finally:
+            h2.stop()
+
+
+# -- generation fence ----------------------------------------------------------
+
+class TestGenerationFence:
+    def test_stale_route_cannot_hit_evicted_tenant(self, tmp_env, host):
+        """The migration regression: after a fenced removal, a router
+        still holding the old generation gets 409/404 — never a stale
+        answer from a tenant that moved away."""
+        host.admit_server(TenantSpec(key="a", engine_id="a"),
+                          _slot_server(host, "a"))
+        host.start()
+        port = host.config.port
+        q = {"user": "u1", "num": 2}
+        # a control action (admit at gen 5) sets the fence
+        host._placement_gen["a"] = 5
+        st, body = _call(port, "/engines/a/queries.json", q,
+                         headers={"X-PIO-Placement-Gen": "4"})
+        assert st == 409 and body["message"] == "stale placement route"
+        assert body["generation"] == 5
+        st, out = _call(port, "/engines/a/queries.json", q,
+                        headers={"X-PIO-Placement-Gen": "5"})
+        assert st == 200 and out["itemScores"]
+        # un-fenced clients (no header) are not broken by the fence
+        st, _ = _call(port, "/engines/a/queries.json", q)
+        assert st == 200
+        # stale REMOVE is fenced too: the slot survives a late retry
+        st, body = _call(port, "/tenants/a/remove", {"generation": 4})
+        assert st == 409
+        assert body["message"] == "stale placement generation"
+        st, plc = _call(port, "/placement.json")
+        assert st == 200 and "a" in plc["tenants"]
+        assert plc["tenants"]["a"]["generation"] == 5
+        # the real removal carries the newer generation
+        st, body = _call(port, "/tenants/a/remove", {"generation": 6})
+        assert st == 200 and body["removed"]
+        st, _ = _call(port, "/engines/a/queries.json", q,
+                      headers={"X-PIO-Placement-Gen": "5"})
+        assert st == 404
+
+    def test_stale_admit_generation_409s(self, tmp_env, host):
+        host.start()
+        port = host.config.port
+        host._placement_gen["a"] = 6
+        st, body = _call(port, "/tenants/a/admit", {"generation": 3})
+        assert st == 409
+        assert body["message"] == "stale placement generation"
+        st, body = _call(port, "/tenants/a/admit",
+                         {"generation": "wat"})
+        assert st == 400
+
+
+# -- controller + router (in-process, single live host) ------------------------
+
+class TestControllerAndRouter:
+    def _fabricate(self, reg, member_id, port, tenants=None,
+                   heartbeat_at=None, pid=None):
+        rec = {"memberId": member_id, "role": "serving_host",
+               "pid": pid or os.getpid(), "host": "127.0.0.1",
+               "port": port, "url": f"http://127.0.0.1:{port}",
+               "node": os.uname().nodename,
+               "startedAt": time.time() - 60,
+               "heartbeatAt": heartbeat_at or time.time()}
+        if tenants is not None:
+            rec["tenants"] = tenants
+        os.makedirs(reg.fleet_dir(), exist_ok=True)
+        reg._write_record(rec)
+
+    def test_router_rides_generation_bump(self, tmp_env, host,
+                                          monkeypatch, tmp_path):
+        from predictionio_tpu.resilience import RetryPolicy
+        monkeypatch.setenv("PIO_FLEET_LIVENESS_S", "3600")
+        host.admit_server(TenantSpec(key="a", engine_id="a"),
+                          _slot_server(host, "a", _rec_model(const=1.0)))
+        host.start()
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "ctlfleet"))
+        self._fabricate(reg, "serving_host-one", host.config.port)
+        ctl = PlacementController(registry=reg)
+        hosts = ctl.observe()
+        assert [h.member_id for h in hosts] == ["serving_host-one"]
+        assert hosts[0].alive and "a" in hosts[0].tenants
+        routes = ctl.refresh_routes(hosts)
+        assert routes["a"][1] == "serving_host-one"
+        router = TenantRouter(ctl, policy=RetryPolicy(
+            max_attempts=4, base_delay_s=0.01, max_delay_s=0.05,
+            deadline_s=10.0))
+        out = router.query("a", {"user": "u1", "num": 2})
+        assert {s["score"] for s in out["itemScores"]} == {RANK * 1.0}
+        # a control action bumps the generation on the host: the
+        # router's cached route is now stale — it must refresh and
+        # retry to a byte-identical answer, never surface the 409
+        host._placement_gen["a"] = 3
+        out2 = router.query("a", {"user": "u1", "num": 2})
+        assert out2 == out
+        assert ctl.route_for("a")[2] == 3
+
+    def test_step_handles_corpse_once_and_captures_incident(
+            self, tmp_env, monkeypatch, tmp_path):
+        monkeypatch.setenv("PIO_FLEET_LIVENESS_S", "3600")
+        reg = fleet.FleetRegistry(fleet_dir=str(tmp_path / "ctlfleet"))
+        # a corpse: fresh-looking heartbeat, dead same-node pid — the
+        # registry's pid probe closes the SIGKILL window; its record
+        # still carries the roster of stranded tenants
+        self._fabricate(
+            reg, "serving_host-dead", 1,
+            tenants={"a": {"engineId": "a", "engineVersion": "0",
+                           "generation": 2, "priority": 0}},
+            pid=999999)
+        ctl = PlacementController(registry=reg)
+        res = ctl.step()
+        assert res["alive"] == 0
+        assert [a["failover"] for a in res["actions"]] == [
+            "serving_host-dead"]
+        # no survivors: the plan refuses honestly (never drops)
+        plan = res["actions"][0]["plan"]["decisions"]
+        assert plan == [{"action": "refuse", "tenant": "a",
+                         "reason": plan[0]["reason"]}]
+        assert "no feasible host" in plan[0]["reason"]
+        # the death is handled exactly once per (member, startedAt)
+        assert ctl.step()["actions"] == []
+        # one incident bundle names the dead member and the tenant
+        from predictionio_tpu.obs.incidents import get_incidents
+        inc_dir = get_incidents().incidents_dir()
+        bundles = []
+        for name in os.listdir(inc_dir):
+            p = os.path.join(inc_dir, name, "incident.json")
+            if os.path.exists(p):
+                with open(p) as f:
+                    bundles.append(json.load(f))
+        ours = [b for b in bundles if b["kind"] == "host_failover"]
+        assert len(ours) == 1
+        assert "serving_host-dead" in ours[0]["reason"]
+        assert "a" in ours[0]["reason"]
+        ctx = ours[0]["context"]
+        assert ctx["deadMember"] == "serving_host-dead"
+        assert ctx["failed"][0]["tenant"] == "a"
