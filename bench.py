@@ -1585,7 +1585,8 @@ def bench_cold_start(full_scale: bool):
 
 
 def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3,
-                       openloop=True, result_cache=True):
+                       openloop=True, result_cache=True,
+                       inflight=None):
     """p50 of POST /queries.json against the trained model via the real
     engine server (loopback HTTP). `wait_ms` sets the micro-batcher's
     coalescing window — swept by main() to pick the default from data;
@@ -1622,7 +1623,8 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3,
     server = EngineServer(ServerConfig(ip="127.0.0.1", port=0,
                                        micro_batch=32,
                                        micro_batch_wait_ms=wait_ms,
-                                       result_cache=result_cache),
+                                       result_cache=result_cache,
+                                       serve_inflight=inflight),
                           engine=engine)
     now = dt.datetime.now(dt.timezone.utc)
     server.engine_instance = EngineInstance(
@@ -1685,11 +1687,18 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3,
             # hundreds of single-query batches that would dilute a
             # cumulative average)
             pre = json.loads(client.get("/stats.json"))
+            # readback-plane window marker (ISSUE 19): overlap frac +
+            # bytes/window over the timed concurrent bursts only (the
+            # serial loop's windows never have a neighbor to hide
+            # their d2h wall behind)
+            from predictionio_tpu.ops import readback as _readback
+            rb_pre = _readback.stats_snapshot()
             qps_reps = []
             for _ in range(max(1, reps)):
                 t0 = time.perf_counter()
                 list(ex.map(worker, jobs))
                 qps_reps.append(n_total / (time.perf_counter() - t0))
+            rb_post = _readback.stats_snapshot()
         pool.close_all()
         # server-side latency split: device/score time vs serve+HTTP
         stats = json.loads(client.get("/stats.json"))
@@ -1713,6 +1722,17 @@ def bench_rest_latency(model, n_queries=200, wait_ms=None, reps=3,
         # pipelined executor + result cache attribution (ISSUE 14,
         # schema-additive): what fraction of the headline throughput
         # the cache answered, and whether windows actually overlapped
+        # readback plane (ISSUE 19, schema-additive): how much of the
+        # serve d2h span hid behind neighboring windows' work, and the
+        # payload each window actually moved (packed: k x batch x 6
+        # bytes; the d2h floor is latency-bound, so small+overlapped
+        # is the whole win)
+        rb_windows = rb_post["windows"] - rb_pre["windows"]
+        if rb_windows > 0:
+            out["serve_d2h_overlap_frac"] = round(
+                _readback.overlap_frac(rb_post, rb_pre), 4)
+            out["serve_readback_bytes_per_window"] = round(
+                (rb_post["bytes"] - rb_pre["bytes"]) / rb_windows, 1)
         rc = stats.get("resultCache") or {}
         if rc.get("hitRate") is not None:
             out["serve_cache_hit_rate"] = round(float(rc["hitRate"]), 4)
@@ -2275,6 +2295,25 @@ def main():
             # window must not lose the finished rows
             _beat(f"serve_sweep wait={w:g} done",
                   serve_wait_sweep_ms=dict(serve_sweep))
+    # in-flight transfer-depth sweep (ISSUE 19): with d2h copies in
+    # flight at dispatch, PIO_SERVE_INFLIGHT is the number of serve
+    # windows whose readback walls may overlap — the knob that beats
+    # the fixed d2h floor on a real chip. Swept closed-loop on the
+    # headline wait; each point carries its measured overlap fraction.
+    inflight_sweep = {}
+    if not os.environ.get("PIO_BENCH_SKIP_INFLIGHT_SWEEP"):
+        for depth in (1, 2, 3, 4):
+            _beat(f"serve_inflight_sweep depth={depth}")
+            s = bench_rest_latency(model, n_queries=100,
+                                   openloop=False, result_cache=False,
+                                   inflight=depth)
+            row = {"p50_ms": round(s["p50_ms"], 3),
+                   "qps_concurrent16": round(s["qps_concurrent16"], 1)}
+            if "serve_d2h_overlap_frac" in s:
+                row["d2h_overlap_frac"] = s["serve_d2h_overlap_frac"]
+            inflight_sweep[str(depth)] = row
+            _beat(f"serve_inflight_sweep depth={depth} done",
+                  serve_inflight_sweep=dict(inflight_sweep))
     product_stats = {}
     if not os.environ.get("PIO_BENCH_SKIP_PRODUCT"):
         _beat("bench_product_path")
@@ -2350,6 +2389,20 @@ def main():
             value / baseline_stats["baseline_measured_ratings_per_sec"], 3)
     if serve_sweep:
         out["serve_wait_sweep_ms"] = serve_sweep
+        # regression guard (ISSUE 19 satellite): surface the sweep's
+        # winner so a capture where the configured default loses to
+        # another window setting is visible in one key — the live TPU
+        # capture measured wait=10ms LOSING 22% QPS vs wait=2ms (44.5
+        # vs 57.4), a cliff operators copying CPU-box defaults miss
+        best = max(serve_sweep,
+                   key=lambda w: serve_sweep[w]["qps_concurrent16"])
+        out["serve_wait_best_ms"] = float(best)
+        out["serve_wait_best_qps"] = serve_sweep[best]["qps_concurrent16"]
+    if inflight_sweep:
+        out["serve_inflight_sweep"] = inflight_sweep
+        best_d = max(inflight_sweep,
+                     key=lambda d: inflight_sweep[d]["qps_concurrent16"])
+        out["serve_inflight_best"] = int(best_d)
     if os.environ.get("PIO_BENCH_CPU_FALLBACK"):
         out["note"] = fallback_note()
         try:

@@ -75,7 +75,12 @@ JAX006 = register_rule(
     "pipeline and silently gives back the overlap. The costmon "
     "1-in-N sampled sync lives in obs/costmon.py, outside this zone "
     "by construction; result readbacks belong in the ops-layer "
-    "finish() callables, not in serving/ modules.")
+    "finish() callables, not in serving/ modules. The ONE sanctioned "
+    "serve d2h site is ops/readback.py (ISSUE 19): begin_fetch() "
+    "initiates copy_to_host_async at dispatch and its wait() closure "
+    "attributes every second and byte — serving/ code that wants "
+    "readback timing samples readback.thread_wait_s() deltas instead "
+    "of touching a device handle.")
 
 _HOT_SEGMENTS = {"serving", "ops", "guard"}
 

@@ -3,9 +3,14 @@ tracing at serve time.
 
 The SNIPPETS.md [1] ``Lowered`` -> ``.lower().compile()`` path, made a
 subsystem. An **executable spec** is a builder that, given a bucket-dim
-dict (``{"u": 1024, "i": 2048, "b": 16, "k": 16, "r": 10}``), returns
-``(jit_fn, example_args, static_kwargs)``; the registry lowers and
-compiles it once per bucket and holds the resulting ``jax.Compiled``.
+dict (``{"u": 1024, "i": 2048, "b": 16, "k": 16, "r": 10, "p": 1}``),
+returns ``(jit_fn, example_args, static_kwargs)``; the registry lowers
+and compiles it once per bucket and holds the resulting
+``jax.Compiled``. Output avals are whatever the builder's program
+emits — the readback plane (ISSUE 19) leans on this: packed buckets
+(``p`` > 0) compile programs whose ONE output is the contiguous
+ids+quantized-scores payload, so steady-state packing costs zero
+serve-time compiles exactly like every other warmed bucket.
 A warmed dispatch site then calls the held executable DIRECTLY — zero
 Python re-trace, zero XLA compile, zero jit-cache probe on the request
 path. Unwarmed buckets fall back to the plain jitted function (whose

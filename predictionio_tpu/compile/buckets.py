@@ -74,7 +74,14 @@ def next_bucket(bucket: int) -> int:
 
 
 def bucket_key(dims: Dict[str, int]) -> Tuple[Tuple[str, int], ...]:
-    """Canonical hashable key for a bucket-dim dict (sorted items)."""
+    """Canonical hashable key for a bucket-dim dict (sorted items).
+
+    Dims need not all be sizes: flag dims ride the same key — ``s``
+    (shard count, sharded vs replicated layout), ``fp`` (positive-
+    score filter), and ``p`` (readback pack mode, ISSUE 19: the packed
+    variant's single-payload output aval is a different program). Each
+    flag value owns its own warmed executables, so flipping a flag at
+    runtime never invalidates the other value's buckets."""
     return tuple(sorted((str(k), int(v)) for k, v in dims.items()))
 
 

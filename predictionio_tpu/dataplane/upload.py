@@ -17,6 +17,12 @@ The actual device touches (submit, completion wait) live in
 ``ops/staging.py``: this module is in the pipelined zone, where no
 host sync may appear (JAX006) — the overlap the stager buys must not
 be re-serializable by a stray sync here.
+
+The serve path's d2h dual of this pattern is ``ops/readback.py``
+(ISSUE 19): per-window device OUTPUT slots with ``copy_to_host_async``
+in flight at dispatch, bounded by the pipelined executor's
+``PIO_SERVE_INFLIGHT`` window instead of a stager deque, with the same
+``overlap_frac`` accounting convention as :class:`StageStats`.
 """
 
 from __future__ import annotations

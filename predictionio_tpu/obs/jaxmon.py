@@ -134,15 +134,23 @@ def record_h2d(nbytes: int):
 
 
 def record_d2h(nbytes: int):
-    """Count an instrumented device->host fetch."""
+    """Count an instrumented device->host fetch (the serve readback
+    plane routes every window through here — ops/readback, ISSUE 19)."""
     if nbytes:
         _ensure()
         _m_d2h.inc(float(nbytes))
+        _tls.d2h = getattr(_tls, "d2h", 0.0) + float(nbytes)
 
 
 def h2d_total() -> float:
     _ensure()
     return _m_h2d.value
+
+
+def thread_d2h_total() -> float:
+    """Bytes fetched device->host BY THE CALLING THREAD — the d2h
+    mirror of :func:`thread_h2d_total`, same delta-snapshot contract."""
+    return getattr(_tls, "d2h", 0.0)
 
 
 def thread_h2d_total() -> float:

@@ -121,6 +121,16 @@ def build_waterfall(query_trace, batch_trace=None,
                     max(s.duration_s - float(device_ms) / 1000.0, 0.0))
                 add("device_sync", float(device_ms) / 1000.0)
                 continue
+        if stage == "readback":
+            d2h_ms = s.attrs.get("d2hWaitMs")
+            if d2h_ms is not None:
+                # readback plane (ISSUE 19): the copy went in flight at
+                # dispatch, so the span decomposes into the blocked
+                # wait on that copy vs host-side unpack + fan-out
+                add("d2h_wait", float(d2h_ms) / 1000.0)
+                add("unpack",
+                    max(s.duration_s - float(d2h_ms) / 1000.0, 0.0))
+                continue
         add(stage, s.duration_s)
     add("serialize", serialize_s)
     return stages

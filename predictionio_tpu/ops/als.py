@@ -787,14 +787,11 @@ def _users_topk(user_factors, item_factors, user_ixs, k: int):
     return jax.lax.top_k(scores, k)
 
 
-@functools.partial(__import__("jax").jit, static_argnames=("k",))
-def _users_topk_b(user_factors, item_factors, user_ixs, n_items, k: int):
-    """Bucket-stable serve kernel (ISSUE 9 compile plane): the factor
-    tables arrive padded to their vocab shape-buckets, so vocabulary
-    growth inside a bucket changes NO traced shape — ``n_items`` rides
-    along as a device scalar masking the padding rows (-inf, sorted
-    last, filtered by the caller). k is a pow2 bucket, so client-chosen
-    ``num`` never mints a program either."""
+def _users_topk_impl(user_factors, item_factors, user_ixs, n_items,
+                     k: int):
+    """Traced body shared by the packed and unpacked serve executables
+    (unjitted — always composed under one of the two jit wrappers
+    below, so both variants rank identically)."""
     import jax
     import jax.numpy as jnp
     u = user_factors[user_ixs]                                # [B, R]
@@ -805,8 +802,35 @@ def _users_topk_b(user_factors, item_factors, user_ixs, n_items, k: int):
     return jax.lax.top_k(scores, k)
 
 
+@functools.partial(__import__("jax").jit, static_argnames=("k",))
+def _users_topk_b(user_factors, item_factors, user_ixs, n_items, k: int):
+    """Bucket-stable serve kernel (ISSUE 9 compile plane): the factor
+    tables arrive padded to their vocab shape-buckets, so vocabulary
+    growth inside a bucket changes NO traced shape — ``n_items`` rides
+    along as a device scalar masking the padding rows (-inf, sorted
+    last, filtered by the caller). k is a pow2 bucket, so client-chosen
+    ``num`` never mints a program either."""
+    return _users_topk_impl(user_factors, item_factors, user_ixs,
+                            n_items, k=k)
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("k", "p"))
+def _users_topk_b_packed(user_factors, item_factors, user_ixs, n_items,
+                         k: int, p: int):
+    """:func:`_users_topk_b` with the readback-plane pack fused on
+    (ISSUE 19): same ranking, but the executable's ONE output is the
+    contiguous ids+quantized-scores payload — k x batch x 6 bytes
+    instead of two full-width arrays, so each serve window pays one
+    small d2h wall. ``p`` (the pack mode) is a static bucket dim."""
+    from predictionio_tpu.ops import readback
+    scores, idx = _users_topk_impl(user_factors, item_factors,
+                                   user_ixs, n_items, k=k)
+    return readback.pack_device(scores, idx, p)
+
+
 def _aot_batch_predict_builder(u: int = 0, i: int = 0, b: int = 0,
-                               k: int = 0, r: int = 0, s: int = 0):
+                               k: int = 0, r: int = 0, s: int = 0,
+                               p: int = 0):
     """(jit_fn, example avals, statics) for one batch_predict bucket —
     what the AOT registry lowers+compiles at deploy/swap time.
 
@@ -814,7 +838,12 @@ def _aot_batch_predict_builder(u: int = 0, i: int = 0, b: int = 0,
     the item table's aval carries a NamedSharding over the ``s``-wide
     model axis and the program is the two-phase per-shard top-k +
     cross-shard merge (ops/topk) — so the bucket ladder and swap-time
-    warmup cover both layouts through one label."""
+    warmup cover both layouts through one label.
+
+    ``p`` > 0 selects the packed-readback variant (ISSUE 19): the pack
+    is fused into the SAME executable, so the bucket's output aval IS
+    the contiguous payload and steady-state packing compiles nothing —
+    each (layout, pack-mode) pair owns its own warmed programs."""
     import jax
     sds = jax.ShapeDtypeStruct
     if s:
@@ -826,17 +855,19 @@ def _aot_batch_predict_builder(u: int = 0, i: int = 0, b: int = 0,
         k_local, k_final = sharded_k_split(k, i, s)
         fn = make_batched_sharded_topk(mesh, k_local, k_final,
                                        has_mask=False,
-                                       filter_positive=False)
+                                       filter_positive=False,
+                                       pack=p)
         return (fn,
                 (sharded_aval((b, r), np.float32, mesh=mesh),
                  sharded_aval((i, r), np.float32, "model", None,
                               mesh=mesh),
                  sds((), np.int32)),
             {})
-    return (_users_topk_b,
-            (sds((u, r), np.float32), sds((i, r), np.float32),
-             sds((b,), np.int32), sds((), np.int32)),
-            {"k": k})
+    avals = (sds((u, r), np.float32), sds((i, r), np.float32),
+             sds((b,), np.int32), sds((), np.int32))
+    if p:
+        return (_users_topk_b_packed, avals, {"k": k, "p": p})
+    return (_users_topk_b, avals, {"k": k})
 
 
 _aot_specs_registered = False
@@ -863,19 +894,21 @@ def batch_predict_dims(model: "ALSModel", batch: int, k: int) -> dict:
     dim — query vectors come from the host shard mirrors), so the same
     warm path covers both layouts."""
     from predictionio_tpu.compile import buckets as B
+    from predictionio_tpu.ops import readback
     from predictionio_tpu.parallel.sharded_table import is_sharded
+    p = readback.pack_flag()
     if is_sharded(model.item_factors):
         V = model.item_factors
         i_b = max(V.padded_rows,
                   B.bucket_rows_sharded(model.n_items, V.n_shards))
         return {"i": i_b, "b": B.bucket_batch(batch),
                 "k": min(B.bucket_batch(k, floor=B.K_FLOOR), i_b),
-                "r": model.rank, "s": V.n_shards}
+                "r": model.rank, "s": V.n_shards, "p": p}
     i_b = B.bucket_rows(model.n_items)
     return {"u": B.bucket_rows(model.n_users), "i": i_b,
             "b": B.bucket_batch(batch),
             "k": min(B.bucket_batch(k, floor=B.K_FLOOR), i_b),
-            "r": model.rank}
+            "r": model.rank, "p": p}
 
 
 def users_topk_serve(model: "ALSModel", user_ixs, k: int
@@ -896,11 +929,15 @@ def users_topk_serve_begin(model: "ALSModel", user_ixs, k: int):
     returns as soon as the work is queued) and defer the device->host
     readback to the returned ``finish() -> (scores, idx)`` callable,
     so batch formation / supplement / serialization of neighboring
-    windows overlap this window's device compute. ``finish`` is safe
+    windows overlap this window's device compute. The d2h copy is
+    initiated here too (ops/readback ``copy_to_host_async`` — packed
+    to ids + quantized scores under ``PIO_SERVE_PACK``), so ``finish``
+    only waits on an already-in-flight transfer. ``finish`` is safe
     to call from another thread; calling it is the only sync."""
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.compile.aot import get_aot
     from predictionio_tpu.obs import costmon
+    from predictionio_tpu.ops import readback
     from predictionio_tpu.parallel.sharded_table import is_sharded
     from predictionio_tpu.utils.device_cache import cached_put_rows
     register_aot_specs()
@@ -913,11 +950,21 @@ def users_topk_serve_begin(model: "ALSModel", user_ixs, k: int):
     ixs[:n] = user_ixs
     U = cached_put_rows(model.user_factors, dims["u"])
     V = cached_put_rows(model.item_factors, dims["i"])
-    k_b = dims["k"]
-    scores, idx = get_aot().dispatch(
-        costmon.BATCH_PREDICT, dims,
-        lambda *a: _users_topk_b(*a, k=k_b),
-        U, V, ixs, np.int32(model.n_items))
+    k_b, p = dims["k"], dims["p"]
+    if p:
+        packed = get_aot().dispatch(
+            costmon.BATCH_PREDICT, dims,
+            lambda *a: _users_topk_b_packed(*a, k=k_b, p=p),
+            U, V, ixs, np.int32(model.n_items))
+        fetch = readback.begin_fetch_packed(packed, p)
+    else:
+        scores, idx = get_aot().dispatch(
+            costmon.BATCH_PREDICT, dims,
+            lambda *a: _users_topk_b(*a, k=k_b),
+            U, V, ixs, np.int32(model.n_items))
+        # packing off still pays ONE d2h wall: both copies go in
+        # flight now, the finish() below only waits
+        fetch = readback.begin_fetch(scores, idx)
     # bucket promotion: a vocab nearing its bucket pre-compiles the
     # next bucket's executable in the background, BEFORE growth needs it
     aot = get_aot()
@@ -932,7 +979,8 @@ def users_topk_serve_begin(model: "ALSModel", user_ixs, k: int):
                    background=True)
 
     def finish() -> Tuple[np.ndarray, np.ndarray]:
-        return np.asarray(scores)[:n], np.asarray(idx)[:n]
+        scores_h, idx_h = fetch()
+        return scores_h[:n], idx_h[:n]
     return finish
 
 

@@ -31,14 +31,11 @@ def _cosine_topk(query_vecs, item_norms, allowed, k: int):
     return jax.lax.top_k(scores, k)
 
 
-@functools.partial(__import__("jax").jit,
-                   static_argnames=("k", "filter_positive"))
-def _batched_masked_topk(query_mat, item_table, allowed, k: int,
-                         filter_positive: bool):
-    """query_mat [B, R], item_table [I, R], allowed [B, I] bool.
-    Score = query_mat @ item_table.T; not-allowed items (and, when
-    filter_positive, items with score <= 0 — the cosine templates' rule)
-    are excluded (score -> -inf). One device call for the whole batch."""
+def _masked_topk_impl(query_mat, item_table, allowed, k: int,
+                      filter_positive: bool):
+    """Traced body shared by the packed and unpacked masked-top-k
+    executables (unjitted — always composed under one of the two jit
+    wrappers below, so both variants rank identically)."""
     import jax
     import jax.numpy as jnp
     scores = jnp.einsum("br,ir->bi", query_mat, item_table,
@@ -49,13 +46,43 @@ def _batched_masked_topk(query_mat, item_table, allowed, k: int,
     return jax.lax.top_k(scores, k)
 
 
+@functools.partial(__import__("jax").jit,
+                   static_argnames=("k", "filter_positive"))
+def _batched_masked_topk(query_mat, item_table, allowed, k: int,
+                         filter_positive: bool):
+    """query_mat [B, R], item_table [I, R], allowed [B, I] bool.
+    Score = query_mat @ item_table.T; not-allowed items (and, when
+    filter_positive, items with score <= 0 — the cosine templates' rule)
+    are excluded (score -> -inf). One device call for the whole batch."""
+    return _masked_topk_impl(query_mat, item_table, allowed, k=k,
+                             filter_positive=filter_positive)
+
+
+@functools.partial(__import__("jax").jit,
+                   static_argnames=("k", "filter_positive", "p"))
+def _batched_masked_topk_packed(query_mat, item_table, allowed, k: int,
+                                filter_positive: bool, p: int):
+    """:func:`_batched_masked_topk` with the readback-plane pack fused
+    on (ISSUE 19): identical ranking, one contiguous ids+quantized-
+    scores output payload per window."""
+    from predictionio_tpu.ops import readback
+    scores, idx = _masked_topk_impl(query_mat, item_table, allowed,
+                                    k=k,
+                                    filter_positive=filter_positive)
+    return readback.pack_device(scores, idx, p)
+
+
 def _aot_masked_topk_builder(b: int = 0, i: int = 0, r: int = 0,
-                             k: int = 0, fp: int = 0, s: int = 0):
+                             k: int = 0, fp: int = 0, s: int = 0,
+                             p: int = 0):
     """(jit_fn, example avals, statics) for one masked-top-k bucket
     (the compile plane's batch_predict executable for the cosine /
     filtered model families). ``s`` > 0 lowers the model-sharded
     variant with sharding-aware avals (item table over the model axis,
-    masks sharded on the item dim)."""
+    masks sharded on the item dim). ``p`` > 0 lowers the packed-
+    readback variant (ISSUE 19) whose single output aval is the
+    contiguous payload — warmed packed buckets compile nothing at
+    serve time."""
     import jax
     sds = jax.ShapeDtypeStruct
     if s:
@@ -67,7 +94,8 @@ def _aot_masked_topk_builder(b: int = 0, i: int = 0, r: int = 0,
         k_local, k_final = sharded_k_split(k, i, s)
         fn = make_batched_sharded_topk(mesh, k_local, k_final,
                                        has_mask=True,
-                                       filter_positive=bool(fp))
+                                       filter_positive=bool(fp),
+                                       pack=p)
         return (fn,
                 (sharded_aval((b, r), np.float32, mesh=mesh),
                  sharded_aval((i, r), np.float32, "model", None,
@@ -75,9 +103,12 @@ def _aot_masked_topk_builder(b: int = 0, i: int = 0, r: int = 0,
                  sds((), np.int32),
                  sharded_aval((b, i), bool, None, "model", mesh=mesh)),
                 {})
-    return (_batched_masked_topk,
-            (sds((b, r), np.float32), sds((i, r), np.float32),
-             sds((b, i), bool)),
+    avals = (sds((b, r), np.float32), sds((i, r), np.float32),
+             sds((b, i), bool))
+    if p:
+        return (_batched_masked_topk_packed, avals,
+                {"k": k, "filter_positive": bool(fp), "p": p})
+    return (_batched_masked_topk, avals,
             {"k": k, "filter_positive": bool(fp)})
 
 
@@ -102,10 +133,12 @@ def masked_topk_dims(n_items: int, rank: int, batch: int, k: int,
     """Shape-bucket dims for one masked-top-k call — shared by the
     serve dispatch and the deploy/swap warm path."""
     from predictionio_tpu.compile import buckets as B
+    from predictionio_tpu.ops import readback
     i_b = B.bucket_rows(n_items)
     return {"b": B.bucket_batch(batch), "i": i_b, "r": int(rank),
             "k": min(B.bucket_batch(k, floor=B.K_FLOOR), i_b),
-            "fp": int(bool(filter_positive))}
+            "fp": int(bool(filter_positive)),
+            "p": readback.pack_flag()}
 
 
 def masked_top_k_batch(item_table: np.ndarray, query_vecs: np.ndarray,
@@ -142,6 +175,7 @@ def masked_top_k_batch_begin(item_table: np.ndarray,
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.compile.aot import get_aot
     from predictionio_tpu.obs import costmon
+    from predictionio_tpu.ops import readback
     from predictionio_tpu.parallel.sharded_table import is_sharded
     from predictionio_tpu.utils.device_cache import cached_put_rows
     register_aot_specs()
@@ -157,13 +191,22 @@ def masked_top_k_batch_begin(item_table: np.ndarray,
     # padding rows of the bucketed table stay masked False -> -inf
     mp = np.zeros((dims["b"], dims["i"]), dtype=bool)
     mp[:n, :n_items] = masks
-    k_eff = dims["k"]
+    k_eff, p = dims["k"], dims["p"]
     item_dev = cached_put_rows(item_table, dims["i"])
-    scores, idx = get_aot().dispatch(
-        costmon.BATCH_PREDICT_MASKED, dims,
-        lambda *a: _batched_masked_topk(
-            *a, k=k_eff, filter_positive=filter_positive),
-        qp, item_dev, mp)
+    if p:
+        packed = get_aot().dispatch(
+            costmon.BATCH_PREDICT_MASKED, dims,
+            lambda *a: _batched_masked_topk_packed(
+                *a, k=k_eff, filter_positive=filter_positive, p=p),
+            qp, item_dev, mp)
+        fetch = readback.begin_fetch_packed(packed, p)
+    else:
+        scores, idx = get_aot().dispatch(
+            costmon.BATCH_PREDICT_MASKED, dims,
+            lambda *a: _batched_masked_topk(
+                *a, k=k_eff, filter_positive=filter_positive),
+            qp, item_dev, mp)
+        fetch = readback.begin_fetch(scores, idx)
     if B.should_promote(n_items, dims["i"]):
         get_aot().ensure(
             costmon.BATCH_PREDICT_MASKED,
@@ -172,7 +215,8 @@ def masked_top_k_batch_begin(item_table: np.ndarray,
             background=True)
 
     def finish() -> Tuple[np.ndarray, np.ndarray]:
-        return np.asarray(scores)[:n], np.asarray(idx)[:n]
+        scores_h, idx_h = fetch()
+        return scores_h[:n], idx_h[:n]
     return finish
 
 
@@ -189,6 +233,7 @@ def _masked_top_k_batch_sharded_begin(item_table,
     Returns the pipelined ``finish()`` readback callable."""
     from predictionio_tpu.compile import buckets as B
     from predictionio_tpu.obs import costmon
+    from predictionio_tpu.ops import readback
     from predictionio_tpu.ops.topk import batched_sharded_top_k_begin
     from predictionio_tpu.parallel.mesh import model_mesh
     mesh = model_mesh(item_table.n_shards)
@@ -200,7 +245,8 @@ def _masked_top_k_batch_sharded_begin(item_table,
             "r": int(query_vecs.shape[1]),
             "k": min(B.bucket_batch(k, floor=B.K_FLOOR), i_b),
             "fp": int(bool(filter_positive)),
-            "s": item_table.n_shards}
+            "s": item_table.n_shards,
+            "p": readback.pack_flag()}
     qp = np.zeros((dims["b"], query_vecs.shape[1]), dtype=np.float32)
     qp[:n] = query_vecs
     mp_ = np.zeros((dims["b"], dims["i"]), dtype=bool)

@@ -109,7 +109,7 @@ def sharded_k_split(k: int, padded_rows: int,
 
 def make_batched_sharded_topk(mesh: MeshContext, k_local: int,
                               k_final: int, has_mask: bool,
-                              filter_positive: bool):
+                              filter_positive: bool, pack: int = 0):
     """The jitted batched two-phase top-k for one (mesh, statics)
     combination, resolved through the compile plane's shared-jit
     surface (one process-wide jit per key; the AOT registry lowers the
@@ -118,7 +118,11 @@ def make_batched_sharded_topk(mesh: MeshContext, k_local: int,
     Signature of the returned callable:
     ``(q [B, R] replicated, v_shard [I, R] model-sharded, n_items ()
     int32[, mask [B, I] bool sharded on dim 1]) -> (scores [B, k_final],
-    global_indices [B, k_final])``."""
+    global_indices [B, k_final])`` — or, with ``pack`` > 0 (the
+    readback plane, ISSUE 19), ONE replicated ``[B, k_final, slot]``
+    uint8 payload: the ids+quantized-scores pack is fused after the
+    cross-shard merge inside the same program, so the sharded serve
+    window also pays a single small d2h wall."""
     import jax
     import jax.numpy as jnp
     from predictionio_tpu.compile.aot import get_aot
@@ -128,9 +132,10 @@ def make_batched_sharded_topk(mesh: MeshContext, k_local: int,
     in_specs = [P(), P("model", None), P()]
     if has_mask:
         in_specs.append(P(None, "model"))
+    out_specs = P() if pack else (P(), P())
 
     @functools.partial(shard_map, mesh=mesh.mesh,
-                       in_specs=tuple(in_specs), out_specs=(P(), P()),
+                       in_specs=tuple(in_specs), out_specs=out_specs,
                        **vma_kw)
     def _kernel(q, v_shard, n_items, *mask):
         scores = jnp.einsum("br,ir->bi", q, v_shard,
@@ -154,14 +159,18 @@ def make_batched_sharded_topk(mesh: MeshContext, k_local: int,
             jax.lax.all_gather(local_i, "model"), 0, 1
         ).reshape(local_i.shape[0], -1)
         top_s, pos = jax.lax.top_k(all_s, k_final)
-        return top_s, jnp.take_along_axis(all_i, pos, axis=1)
+        top_i = jnp.take_along_axis(all_i, pos, axis=1)
+        if pack:
+            from predictionio_tpu.ops import readback
+            return readback.pack_device(top_s, top_i, pack)
+        return top_s, top_i
 
     # one process-wide jit per (mesh, statics) key: the compile plane
     # constructs and holds it (shared_jit), so repeated calls here only
     # rebuild the cheap shard_map wrapper, never a fresh jit closure
     key = (f"topk.sharded_batched:{id(mesh.mesh)}:"
            f"{mesh.model_parallelism}:{k_local}:{k_final}:"
-           f"{int(has_mask)}:{int(filter_positive)}")
+           f"{int(has_mask)}:{int(filter_positive)}:{int(pack)}")
     return get_aot().shared_jit(key, _kernel)
 
 
@@ -196,15 +205,19 @@ def batched_sharded_top_k_begin(item_dev, query_vecs: np.ndarray,
     sharded ranking NOW and returns ``finish() -> (scores, idx)``
     which performs the deferred device->host readback — so the
     cross-shard merge of window N overlaps window N+1's host-side
-    batch formation."""
+    batch formation. The d2h copy of the (packed) result goes in
+    flight HERE via the readback plane, so ``finish`` only waits."""
     import jax
     from predictionio_tpu.obs import jaxmon
+    from predictionio_tpu.ops import readback
 
     padded_rows = int(item_dev.shape[0])
     k_local, k_final = sharded_k_split(k_bucket, padded_rows,
                                        mesh.model_parallelism)
+    p = dims["p"] if dims and "p" in dims else readback.pack_flag()
     fn = make_batched_sharded_topk(mesh, k_local, k_final,
-                                   masks is not None, filter_positive)
+                                   masks is not None, filter_positive,
+                                   pack=p)
     q = np.ascontiguousarray(query_vecs, dtype=np.float32)
     args = [q, item_dev, np.int32(n_items)]
     if masks is not None:
@@ -214,11 +227,16 @@ def batched_sharded_top_k_begin(item_dev, query_vecs: np.ndarray,
     jaxmon.record_h2d(q.nbytes)
     if label is not None and dims is not None:
         from predictionio_tpu.compile.aot import get_aot
-        scores, idx = get_aot().dispatch(label, dims, fn, *args)
+        out = get_aot().dispatch(label, dims, fn, *args)
     else:
         from predictionio_tpu.obs.costmon import device_timed
-        scores, idx = device_timed(label or "sharded_topk", fn, *args)
+        out = device_timed(label or "sharded_topk", fn, *args)
+    if p:
+        return readback.begin_fetch_packed(out, p)
+    scores, idx = out
+    fetch = readback.begin_fetch(scores, idx)
 
     def finish() -> Tuple[np.ndarray, np.ndarray]:
-        return np.asarray(scores), np.asarray(idx)
+        scores_h, idx_h = fetch()
+        return scores_h, idx_h
     return finish

@@ -65,6 +65,12 @@ logger = logging.getLogger(__name__)
 
 
 def _inflight_default() -> int:
+    """Dispatched-not-completed window cap. Since the readback plane
+    (ISSUE 19) every in-flight window's d2h copy is already in flight
+    at dispatch, so this is also the TRANSFER-depth knob: K windows'
+    d2h walls overlap instead of serialize, and values > 2 genuinely
+    deepen the pipeline on a real accelerator (bench sweeps it as
+    ``serve_inflight_sweep``)."""
     try:
         return max(1, int(os.environ.get("PIO_SERVE_INFLIGHT", 2)))
     except (TypeError, ValueError):
@@ -253,14 +259,15 @@ class MicroBatcher:
                 "Per-window wall time by pipeline stage (formation = "
                 "first dequeue -> dispatch, dispatch = async enqueue, "
                 "completion_wait = dispatched -> completion thread "
-                "pickup, completion = readback + post-process + "
-                "waiter wakeup)",
+                "pickup, readback = blocked on the in-flight d2h copy "
+                "(ops/readback wait), completion = post-process + "
+                "waiter wakeup minus the readback wait)",
                 labelnames=("stage",))
             # children resolved eagerly (the ISSUE 6 self-metrics
             # precedent): a quiet server scrapes zeroed stage series,
             # not an empty family
             for st in ("formation", "dispatch", "completion_wait",
-                       "completion"):
+                       "readback", "completion"):
                 self.stage_hist.labels(stage=st)
             metrics.counter_func(
                 "pio_engine_batches_total", "Micro-batch dispatches",
@@ -678,9 +685,17 @@ class MicroBatcher:
         dedicated completion thread — overlapping the formation
         thread's next window and the device's current one."""
         from predictionio_tpu.obs import TRACER
+        from predictionio_tpu.ops import readback as _readback
         batch, finish, bt = item.batch, item.finish, item.trace
         t_c0 = time.perf_counter()
         wait_s = t_c0 - item.t_ready
+        # readback decomposition (ISSUE 19): finish() internally waits
+        # on the window's already-in-flight d2h copy through the
+        # ops/readback plane; sampling this thread's cumulative wait
+        # around the call splits completion into wait-for-copy vs
+        # post-process without this module touching a device handle
+        # (the JAX006 contract)
+        rb0 = _readback.thread_wait_s()
         try:
             if bt is not None:
                 bt.root.attrs["completionWaitMs"] = round(
@@ -709,10 +724,13 @@ class MicroBatcher:
             p.result = r
             p.event.set()
         if self.stage_hist is not None:
+            rb_s = max(0.0, _readback.thread_wait_s() - rb0)
+            total_s = time.perf_counter() - t_c0
             self.stage_hist.labels(stage="completion_wait").observe(
                 wait_s)
+            self.stage_hist.labels(stage="readback").observe(rb_s)
             self.stage_hist.labels(stage="completion").observe(
-                time.perf_counter() - t_c0)
+                max(0.0, total_s - rb_s))
         self._note_service_time(time.perf_counter() - item.t_dispatch)
 
     def _run_batch(self, batch, formation_s: float = 0.0):

@@ -875,13 +875,22 @@ class EngineServer:
 
         def finish() -> List[dict]:
             try:
+                from predictionio_tpu.ops import readback as _rb
                 tr = time.perf_counter()
-                with TRACER.span("readback"):
-                    # the deferred device->host fetch — the pipeline's
-                    # ONE inherent sync (results must reach the host to
+                rb_w0, rb_b0 = _rb.thread_wait_s(), _rb.thread_d2h_bytes()
+                with TRACER.span("readback") as rb_span:
+                    # the window's d2h copy went in flight at dispatch
+                    # (ops/readback, ISSUE 19) — this is the wait on
+                    # that copy + host unpack, the pipeline's ONE
+                    # inherent sync (results must reach the host to
                     # serialize); costmon's 1-in-N sampled sync inside
                     # the dispatch stays the only other deliberate one
                     per_algo = [dict(f()) for f in fetchers]
+                    if rb_span is not None:
+                        rb_span.attrs["d2hWaitMs"] = round(
+                            (_rb.thread_wait_s() - rb_w0) * 1000.0, 3)
+                        rb_span.attrs["d2hBytes"] = (
+                            _rb.thread_d2h_bytes() - rb_b0)
                 readback_dt = time.perf_counter() - tr
             except BaseException as e:
                 _exit_guard(sys.exc_info())

@@ -763,9 +763,19 @@ class ServingHost:
         attribution — the single surface that answers "who is eating
         the device" (docs/operations.md)."""
         from predictionio_tpu.obs import costmon
+        from predictionio_tpu.obs.metrics import get_registry
         budget = self.budget.snapshot()
         dev_share = costmon.tenant_device_time_share()
         occ_share = costmon.tenant_occupancy_shares()
+        # per-tenant serve readback bytes (ISSUE 19): the packed d2h
+        # plane attributes every fetched byte to the obs-plane tenant
+        # context, so the bill decomposes transfer cost too
+        d2h_bytes = {}
+        fam = get_registry().get("pio_tenant_serve_d2h_bytes_total")
+        if fam is not None:
+            for labels, value in fam.samples():
+                if labels:
+                    d2h_bytes[labels.get("tenant", "")] = int(value)
         with self._lock:
             slots = list(self.slots.values())
         tenants = {}
@@ -778,6 +788,7 @@ class ServingHost:
                     self._traffic_ewma(slot.key, slot.requests), 3),
                 "deviceTimeShare": dev_share.get(slot.key, 0.0),
                 "occupancyShare": occ_share.get(slot.key, 0.0),
+                "serveD2hBytes": d2h_bytes.get(slot.key, 0),
                 "modelStalenessS": srv.model_staleness_s(),
                 "modelVersion": srv.model_version,
             }

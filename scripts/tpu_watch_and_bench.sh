@@ -13,6 +13,13 @@
 #                Copy <session dir>/bench.json over the round's
 #                BENCH_r<N>.json and update docs/benchmarks.md.
 #   watch.log  — probe attempts and session outcomes
+#
+# The banked bench.json now carries the readback-plane capture the
+# ISSUE 19 push waits on: `serve_qps_openloop`, `serve_wait_best_ms`
+# (+ the wait sweep), `serve_inflight_sweep` (transfer-depth 1-4 with
+# per-depth d2h overlap), `serve_d2h_overlap_frac`, and
+# `serve_readback_bytes_per_window` — read them against `d2h_floor_ms`
+# (target: serve p50 under the floor, >=1k QPS/chip).
 set -u
 cd "$(dirname "$0")/.."
 WATCH=${1:-/tmp/tpu_watch}

@@ -1,7 +1,12 @@
 """JAX006 true negative: the pipelined executor's idiomatic shape —
 serving-zone code enqueues via the ops-layer begin kernel and hands
 the deferred finish() (which owns the readback, outside this zone) to
-the completion stage; no sync appears here."""
+the completion stage; no sync appears here. The completion stage may
+decompose its time into wait-for-copy vs post-process by sampling
+readback.thread_wait_s() deltas (ISSUE 19) — reading a counter, not
+a device handle."""
+
+from predictionio_tpu.ops import readback
 
 
 def dispatch_window(begin, queries):
@@ -11,3 +16,11 @@ def dispatch_window(begin, queries):
 
 def complete_window(finish):
     return finish()
+
+
+def complete_window_timed(finish, stage_hist):
+    rb0 = readback.thread_wait_s()
+    out = finish()
+    rb_s = readback.thread_wait_s() - rb0
+    stage_hist.labels(stage="readback").observe(rb_s)
+    return out

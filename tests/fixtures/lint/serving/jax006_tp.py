@@ -1,9 +1,14 @@
 """JAX006 true positive: a deliberate device sync inside the
 pipelined serve zone — block_until_ready on the dispatch result
 re-serializes the executor's stage overlap (the readback belongs in
-the completion stage's finish() closure, in the ops layer)."""
+the completion stage's finish() closure, in the ops layer), and a
+raw device_get in a finish() path (ISSUE 19: the one sanctioned
+serve d2h site is ops/readback.py — serving code never np.asarray's
+a device handle itself)."""
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 
 def _impl(y):
@@ -14,3 +19,10 @@ def complete_window(fn, x):
     out = fn(x)
     jax.block_until_ready(out)
     return out
+
+
+def finish_window(x):
+    # a hand-rolled finish(): syncs on the device result right here in
+    # the serve zone instead of routing through readback.begin_fetch()
+    scores = jnp.square(x)
+    return np.asarray(scores)

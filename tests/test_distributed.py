@@ -243,9 +243,12 @@ def test_two_process_http_serving_matches_host(tmp_path):
 
     procs = []
     for pid in range(2):
+        # PIO_SERVE_PACK=exact: this asserts SPMD-vs-host score equality
+        # at f32 precision, so take the bit-exact packed readback (the
+        # f16 wire default is parity-tested in tests/test_readback.py)
         env = dict(os.environ, PIO_COORDINATOR="127.0.0.1:19885",
                    PIO_NUM_PROCESSES="2", PIO_PROCESS_ID=str(pid),
-                   PALLAS_AXON_POOL_IPS="")
+                   PALLAS_AXON_POOL_IPS="", PIO_SERVE_PACK="exact")
         procs.append(subprocess.Popen(
             [sys.executable, "-c", prog], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))

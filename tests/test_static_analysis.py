@@ -322,6 +322,15 @@ class TestZoneCoverage:
         assert in_pipelined_zone("predictionio_tpu/serving/batcher.py")
         assert not in_pipelined_zone("predictionio_tpu/obs/costmon.py")
 
+    def test_readback_plane_outside_pipelined_zone(self):
+        """ISSUE 19: ops/readback.py is the ONE sanctioned serve d2h
+        site — its begin_fetch()/wait() closures legitimately
+        np.asarray device results, so it must sit outside the JAX006
+        zone (like ops/staging.py for h2d)."""
+        from predictionio_tpu.analysis.rules_jax import \
+            in_pipelined_zone
+        assert not in_pipelined_zone("predictionio_tpu/ops/readback.py")
+
     def test_tenancy_modules_have_zero_findings(self):
         """The shipped tenancy modules stay clean under their new zone
         membership (no baseline entries were added for them)."""
